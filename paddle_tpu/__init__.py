@@ -1,0 +1,14 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of 2017-era PaddlePaddle
+(reference: QingshuChen/Paddle): layer library, sequence models without padding
+waste, CRF/CTC structured costs, beam search, a full optimizer/LR-schedule suite,
+sparse embedding training, evaluators, checkpoint/resume, and distributed training
+via device meshes + XLA collectives instead of a parameter-server tier.
+"""
+
+__version__ = "0.1.0"
+
+from . import core
+from .core import (Module, Sequential, SeqBatch, initializers, make_mesh,
+                   default_mesh, use_mesh)

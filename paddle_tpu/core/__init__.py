@@ -1,0 +1,10 @@
+"""Core: module system, mesh/device abstraction, sequence representation, dtypes."""
+
+from . import initializers
+from .dtypes import Policy, bfloat16_compute, current_policy, float32, use_policy
+from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+                   default_mesh, local_mesh, make_mesh, named_sharding,
+                   replicated, shard_batch, single_device_mesh, use_mesh)
+from .module import Module, Sequential, current_rng, no_params
+from .sequence import (SeqBatch, causal_mask, length_mask, pack_sequences,
+                       positions_from_segments, segment_mask, unpack_sequences)

@@ -1,0 +1,65 @@
+"""Dtype policy for TPU execution.
+
+The reference is float32-everywhere (``real`` typedef, ``paddle/math``). On TPU the
+MXU natively multiplies bfloat16 with float32 accumulation, so the framework uses a
+*policy*: params kept in float32, compute optionally cast to bfloat16, reductions
+and losses in float32. This is the standard mixed-precision recipe and is what the
+benchmarks run with.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+
+import jax.numpy as jnp
+
+__all__ = ["Policy", "float32", "bfloat16_compute", "current_policy", "use_policy",
+           "canonicalize"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: object = jnp.float32
+    compute_dtype: object = jnp.float32
+    accum_dtype: object = jnp.float32
+
+    def cast_compute(self, x):
+        return jnp.asarray(x, self.compute_dtype)
+
+    def cast_accum(self, x):
+        return jnp.asarray(x, self.accum_dtype)
+
+
+float32 = Policy()
+bfloat16_compute = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+                          accum_dtype=jnp.float32)
+
+_tls = __import__("threading").local()
+
+
+def _stack() -> list:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = [float32]
+    return _tls.stack
+
+
+def current_policy() -> Policy:
+    return _stack()[-1]
+
+
+@contextlib.contextmanager
+def use_policy(policy: Policy):
+    _stack().append(policy)
+    try:
+        yield policy
+    finally:
+        _stack().pop()
+
+
+def canonicalize(name) -> object:
+    if isinstance(name, str):
+        return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                "float16": jnp.float16, "int32": jnp.int32,
+                "int8": jnp.int8}[name]
+    return name

@@ -1,0 +1,102 @@
+"""Parameter initializers.
+
+Mirrors the reference's init strategies on ``ParameterConfig``
+(``/root/reference/paddle/parameter/Parameter.h:60-340``,
+``proto/ParameterConfig.proto:34`` — initial_mean/initial_std/initial_strategy):
+normal, uniform, xavier (the reference's default 1/sqrt(fan_in)), msra, constant.
+Implemented as pure ``(rng, shape, dtype) -> array`` functions for the module system.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "zeros", "ones", "constant", "normal", "uniform", "xavier_uniform",
+    "xavier_normal", "msra_normal", "lecun_normal", "orthogonal", "fan_in_uniform",
+]
+
+
+def zeros(rng, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(rng, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def constant(value):
+    def _init(rng, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+    return _init
+
+
+def normal(stddev=0.01, mean=0.0):
+    def _init(rng, shape, dtype=jnp.float32):
+        return mean + stddev * jax.random.normal(rng, shape, dtype)
+    return _init
+
+
+def uniform(scale=0.01):
+    def _init(rng, shape, dtype=jnp.float32):
+        return jax.random.uniform(rng, shape, dtype, -scale, scale)
+    return _init
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels HWIO: receptive field * channels
+    receptive = int(np.prod(shape[:-2]))
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def fan_in_uniform(rng, shape, dtype=jnp.float32):
+    """The reference's default: U(-s, s), s = 1/sqrt(fan_in)
+    (``config_parser.py`` default initial_strategy with initial_std=1/sqrt(size))."""
+    fan_in, _ = _fans(shape)
+    s = 1.0 / np.sqrt(max(1, fan_in))
+    return jax.random.uniform(rng, shape, dtype, -s, s)
+
+
+def xavier_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    s = np.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, dtype, -s, s)
+
+
+def xavier_normal(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    s = np.sqrt(2.0 / (fan_in + fan_out))
+    return s * jax.random.normal(rng, shape, dtype)
+
+
+def msra_normal(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    s = np.sqrt(2.0 / max(1, fan_in))
+    return s * jax.random.normal(rng, shape, dtype)
+
+
+def lecun_normal(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    s = np.sqrt(1.0 / max(1, fan_in))
+    return s * jax.random.normal(rng, shape, dtype)
+
+
+def orthogonal(scale=1.0):
+    def _init(rng, shape, dtype=jnp.float32):
+        if len(shape) < 2:
+            return normal(0.01)(rng, shape, dtype)
+        rows, cols = int(np.prod(shape[:-1])), shape[-1]
+        a = jax.random.normal(rng, (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        return (scale * q[:rows, :cols]).reshape(shape).astype(dtype)
+    return _init

@@ -1,0 +1,135 @@
+"""Device & mesh abstraction — the TPU-native ``Place``.
+
+The reference models devices as ``Place = variant<CPUPlace, GPUPlace, FPGAPlace>``
+(``/root/reference/paddle/platform/place.h:46-98``) with per-place allocators,
+device contexts, and kernel registries. On TPU the device model is a *mesh*: a
+logical N-D array of chips over which arrays are sharded and collectives run on
+ICI. This module owns mesh construction and the standard logical axis names used
+throughout the framework:
+
+  - ``data``  : batch (data parallel; grads psum over this axis)
+  - ``model`` : tensor parallel (weight shards; activations all-gather/reduce)
+  - ``seq``   : sequence/context parallel (ring attention over ppermute)
+  - ``pipe``  : pipeline stages
+  - ``expert``: MoE expert parallel
+
+Single-chip runs use a trivial 1-device mesh so every training step is written
+once, mesh-polymorphic (the analog of the reference compiling CPU+GPU from one
+kernel template, ``paddle/math/BaseMatrix.h:131``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
+    "make_mesh", "single_device_mesh", "local_mesh", "default_mesh",
+    "current_mesh", "use_mesh", "named_sharding", "replicated", "shard_batch",
+    "host_count", "host_id",
+]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+_tls = __import__("threading").local()
+
+
+def _mesh_stack() -> list:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh from {axis_name: size}. Sizes must multiply to #devices
+    (a size of -1 is inferred). Axis order follows dict order; put the
+    fastest-communicating axis (model/tensor) innermost so it rides ICI.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    sizes = dict(axes)
+    n = len(devs)
+    infer = [k for k, v in sizes.items() if v == -1]
+    if len(infer) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = int(np.prod([v for v in sizes.values() if v != -1]))
+    if infer:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[infer[0]] = n // known
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(f"mesh {sizes} needs {total} devices, have {n}")
+    arr = np.array(devs).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def single_device_mesh(device=None) -> Mesh:
+    dev = device or jax.devices()[0]
+    return Mesh(np.array([dev]).reshape(1), (DATA_AXIS,))
+
+
+def local_mesh(data: int = -1, model: int = 1, seq: int = 1) -> Mesh:
+    """Mesh over all visible devices: data-parallel outer, model-parallel inner."""
+    axes = {DATA_AXIS: data}
+    if seq != 1:
+        axes[SEQ_AXIS] = seq
+    if model != 1:
+        axes[MODEL_AXIS] = model
+    return make_mesh(axes)
+
+
+def default_mesh() -> Mesh:
+    """All devices on the data axis (pure DP) — the common small-model default."""
+    return make_mesh({DATA_AXIS: -1})
+
+
+def current_mesh() -> Optional[Mesh]:
+    stack = _mesh_stack()
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    stack = _mesh_stack()
+    stack.append(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        stack.pop()
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch, axis: str = DATA_AXIS):
+    """Device-put a host batch with leading dim sharded over the data axis."""
+    def _put(x):
+        if np.ndim(x) == 0:
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        spec = (axis,) + (None,) * (np.ndim(x) - 1)
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    return jax.tree_util.tree_map(_put, batch)
+
+
+def host_count() -> int:
+    return jax.process_count()
+
+
+def host_id() -> int:
+    return jax.process_index()

@@ -1,0 +1,177 @@
+"""Variable-length sequence representation — successor of the reference's ``Argument``.
+
+The reference carries ragged batches as a flat value matrix plus
+``sequenceStartPositions`` / ``subSequenceStartPositions``
+(``/root/reference/paddle/parameter/Argument.h:70-93``) so no padding is ever
+materialized, and reorders time-steps for RNNs with ``SequenceToBatch``
+(``paddle/gserver/layers/SequenceToBatch.h``).
+
+XLA wants static shapes, so the TPU-native equivalent is *packing + segment IDs*:
+
+  - :class:`SeqBatch` — padded ``[B, T, ...]`` data with ``lengths [B]``; masks are
+    derived on device. This is the simple path for mildly ragged data.
+  - :func:`pack_sequences` — bin-pack many ragged sequences into few fixed
+    ``[rows, T]`` slots with ``segment_ids``/``positions``; attention and losses
+    mask across segment boundaries. This recovers the reference's "no padding
+    waste" property with fully static shapes (see SURVEY.md §5 long-context row).
+
+Nested (sub-)sequences (the reference's ``subSequenceStartPositions``) are carried
+as a second segment level: ``sub_segment_ids``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "SeqBatch", "length_mask", "segment_mask", "causal_mask",
+    "pack_sequences", "unpack_sequences", "positions_from_segments",
+]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(eq=False)
+class SeqBatch:
+    """Padded batch of sequences: ``data [B, T, ...]``, ``lengths [B]`` (int32).
+
+    Optional ``segment_ids [B, T]`` marks packed sub-sequences (0 = padding,
+    1..k = packed sequences); when present, ``lengths`` is the per-row used length.
+    """
+    data: jax.Array
+    lengths: jax.Array
+    segment_ids: Optional[jax.Array] = None
+    positions: Optional[jax.Array] = None
+
+    # pytree protocol -------------------------------------------------------
+    def tree_flatten(self):
+        children = (self.data, self.lengths, self.segment_ids, self.positions)
+        return children, None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # conveniences ----------------------------------------------------------
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.data.shape[1]
+
+    def mask(self) -> jax.Array:
+        """[B, T] float32 1/0 validity mask."""
+        if self.segment_ids is not None:
+            return (self.segment_ids > 0).astype(jnp.float32)
+        return length_mask(self.lengths, self.max_len)
+
+    def attn_mask(self, causal: bool = False) -> jax.Array:
+        """[B, T, T] attention mask honoring padding and packing boundaries."""
+        if self.segment_ids is not None:
+            m = segment_mask(self.segment_ids, self.segment_ids)
+        else:
+            v = self.mask()
+            m = v[:, :, None] * v[:, None, :]
+        if causal:
+            m = m * causal_mask(self.max_len)[None]
+        return m
+
+    @staticmethod
+    def from_list(seqs: Sequence[np.ndarray], max_len: Optional[int] = None,
+                  pad_value=0) -> "SeqBatch":
+        """Pad a list of [len, ...] arrays to a dense [B, T, ...] batch (host-side)."""
+        n = len(seqs)
+        t = max_len or max(len(s) for s in seqs)
+        tail = np.asarray(seqs[0]).shape[1:]
+        data = np.full((n, t) + tail, pad_value,
+                       dtype=np.asarray(seqs[0]).dtype)
+        lengths = np.zeros((n,), np.int32)
+        for i, s in enumerate(seqs):
+            s = np.asarray(s)[:t]
+            data[i, :len(s)] = s
+            lengths[i] = len(s)
+        return SeqBatch(jnp.asarray(data), jnp.asarray(lengths))
+
+
+def length_mask(lengths: jax.Array, max_len: int) -> jax.Array:
+    """[B] lengths -> [B, T] float 1/0 mask."""
+    pos = jnp.arange(max_len)[None, :]
+    return (pos < lengths[:, None]).astype(jnp.float32)
+
+
+def segment_mask(q_seg: jax.Array, kv_seg: jax.Array) -> jax.Array:
+    """[B, Tq], [B, Tk] segment ids -> [B, Tq, Tk] same-segment mask (0 is pad)."""
+    same = (q_seg[:, :, None] == kv_seg[:, None, :])
+    valid = (q_seg[:, :, None] > 0) & (kv_seg[:, None, :] > 0)
+    return (same & valid).astype(jnp.float32)
+
+
+def causal_mask(t: int) -> jax.Array:
+    return jnp.tril(jnp.ones((t, t), jnp.float32))
+
+
+def positions_from_segments(segment_ids: np.ndarray) -> np.ndarray:
+    """Per-token position within its own segment (host-side, numpy)."""
+    b, t = segment_ids.shape
+    out = np.zeros((b, t), np.int32)
+    for i in range(b):
+        pos, prev = 0, 0
+        for j in range(t):
+            s = segment_ids[i, j]
+            pos = pos + 1 if (s == prev and s != 0) else 0
+            out[i, j] = pos
+            prev = s
+    return out
+
+
+def pack_sequences(seqs: Sequence[np.ndarray], row_len: int,
+                   pad_value=0) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """First-fit bin-pack ragged sequences into ``[rows, row_len]`` slots.
+
+    Returns ``(data, segment_ids, positions)`` (host-side numpy). Sequences longer
+    than ``row_len`` are truncated. ``segment_ids`` are 1-based per row; 0 = pad.
+    """
+    order = np.argsort([-len(s) for s in seqs], kind="stable")
+    tail = np.asarray(seqs[0]).shape[1:]
+    dtype = np.asarray(seqs[0]).dtype
+    rows: List[np.ndarray] = []
+    segs: List[np.ndarray] = []
+    free: List[int] = []   # free space per row
+    nseg: List[int] = []
+    for idx in order:
+        s = np.asarray(seqs[idx])[:row_len]
+        L = len(s)
+        slot = -1
+        for r in range(len(rows)):
+            if free[r] >= L:
+                slot = r
+                break
+        if slot < 0:
+            rows.append(np.full((row_len,) + tail, pad_value, dtype))
+            segs.append(np.zeros((row_len,), np.int32))
+            free.append(row_len)
+            nseg.append(0)
+            slot = len(rows) - 1
+        off = row_len - free[slot]
+        rows[slot][off:off + L] = s
+        nseg[slot] += 1
+        segs[slot][off:off + L] = nseg[slot]
+        free[slot] -= L
+    data = np.stack(rows)
+    segment_ids = np.stack(segs)
+    return data, segment_ids, positions_from_segments(segment_ids)
+
+
+def unpack_sequences(data: np.ndarray, segment_ids: np.ndarray) -> List[np.ndarray]:
+    """Inverse of :func:`pack_sequences` (order not preserved)."""
+    out = []
+    for row, seg in zip(np.asarray(data), np.asarray(segment_ids)):
+        for s in range(1, int(seg.max(initial=0)) + 1):
+            out.append(row[seg == s])
+    return out

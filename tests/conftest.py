@@ -1,0 +1,26 @@
+"""Test harness: force an 8-device CPU platform so sharding/collective tests run
+without TPU hardware — the analog of the reference's in-process localhost pserver
+tests (``/root/reference/paddle/gserver/tests/test_CompareSparse.cpp:64``)."""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def nprng():
+    return np.random.RandomState(0)

@@ -1,0 +1,137 @@
+"""Module system tests: init/apply purity, naming, sharing, state, rngs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core import Module, Sequential, initializers as I
+from paddle_tpu.core.module import current_rng, ModuleError
+
+
+class Dense(Module):
+    def __init__(self, features, name=None):
+        super().__init__(name=name)
+        self.features = features
+
+    def forward(self, x):
+        w = self.param("w", I.xavier_uniform, (x.shape[-1], self.features))
+        b = self.param("b", I.zeros, (self.features,))
+        return x @ w + b
+
+
+class MLP(Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = Dense(8)
+        self.fc2 = Dense(4)
+
+    def forward(self, x):
+        return self.fc2(jax.nn.relu(self.fc1(x)))
+
+
+def test_init_apply_roundtrip(rng):
+    m = MLP()
+    x = jnp.ones((2, 16))
+    vs = m.init(rng, x)
+    assert set(vs["params"].keys()) == {"MLP_0"}
+    inner = vs["params"]["MLP_0"]
+    assert set(inner.keys()) == {"fc1", "fc2"}
+    assert inner["fc1"]["w"].shape == (16, 8)
+    y = m.apply(vs, x)
+    assert y.shape == (2, 4)
+    # pure: same inputs -> same outputs
+    np.testing.assert_array_equal(y, m.apply(vs, x))
+
+
+def test_jit_grad_compose(rng):
+    m = MLP()
+    x = jnp.ones((2, 16))
+    vs = m.init(rng, x)
+
+    @jax.jit
+    def loss(params, x):
+        return jnp.sum(m.apply({"params": params, "state": {}}, x) ** 2)
+
+    g = jax.grad(loss)(vs["params"], x)
+    assert jax.tree_util.tree_structure(g) == jax.tree_util.tree_structure(
+        vs["params"])
+
+
+def test_param_sharing(rng):
+    shared = Dense(4, name="shared")
+
+    class Net(Module):
+        def __init__(self):
+            super().__init__()
+            self.d = shared
+
+        def forward(self, x):
+            return self.d(x) + self.d(x)
+
+    n = Net()
+    x = jnp.ones((1, 4))
+    vs = n.init(rng, x)
+    flat = jax.tree_util.tree_leaves(vs["params"])
+    assert len(flat) == 2  # one w, one b — shared across both calls
+
+
+def test_autonaming_deterministic(rng):
+    class Net(Module):
+        def forward(self, x):
+            a = Dense(3)
+            b = Dense(3)
+            return b(a(x))
+
+    n = Net()
+    x = jnp.ones((1, 3))
+    vs = n.init(rng, x)
+    y1 = n.apply(vs, x)
+    y2 = Net().apply(vs, x)
+    np.testing.assert_allclose(y1, y2)
+
+
+def test_state_mutation(rng):
+    class Counter(Module):
+        def forward(self, x):
+            c = self.state("count", lambda: jnp.zeros(()))
+            self.update_state("count", c + 1)
+            return x
+
+    m = Counter()
+    vs = m.init(rng, jnp.ones(()))
+    assert vs["state"]["Counter_0"]["count"] == 1
+    out, new = m.apply(vs, jnp.ones(()), mutable=("state",))
+    assert new["state"]["Counter_0"]["count"] == 2
+    # without mutable: writes are dropped, vs unchanged
+    m.apply(vs, jnp.ones(()))
+    assert vs["state"]["Counter_0"]["count"] == 1
+
+
+def test_rng_streams(rng):
+    class Noisy(Module):
+        def forward(self, x):
+            return x + jax.random.normal(current_rng("noise"), x.shape)
+
+    m = Noisy()
+    x = jnp.zeros((4,))
+    vs = m.init(rng, x, rngs={"noise": rng})
+    a = m.apply(vs, x, rngs={"noise": jax.random.PRNGKey(1)})
+    b = m.apply(vs, x, rngs={"noise": jax.random.PRNGKey(2)})
+    assert not np.allclose(a, b)
+    with pytest.raises(ModuleError):
+        m.apply(vs, x)  # missing rng
+
+
+def test_sequential(rng):
+    m = Sequential(Dense(8), Dense(2))
+    x = jnp.ones((3, 5))
+    vs = m.init(rng, x)
+    assert m.apply(vs, x).shape == (3, 2)
+
+
+def test_missing_param_raises(rng):
+    m = Dense(4)
+    vs = m.init(rng, jnp.ones((1, 3)))
+    with pytest.raises(Exception):
+        m.apply(vs, jnp.ones((1, 5)))  # shape mismatch -> matmul error or missing
