@@ -80,9 +80,15 @@ def current_rng(kind: str = "dropout") -> jax.Array:
     """Fetch a fresh RNG key of the given kind inside forward()."""
     fr = _frame()
     if kind not in fr.rngs:
-        raise ModuleError(
-            f"rng '{kind}' requested but not provided; pass rngs={{'{kind}': key}} "
-            f"to init/apply")
+        if fr.mode == "init" and "params" in fr.rngs:
+            # During init any stream derives from the main key — init(train=True)
+            # with dropout must not force the caller to thread extra rngs.
+            fr.rngs[kind] = jax.random.fold_in(
+                fr.rngs["params"], zlib.crc32(kind.encode()) & 0x7FFFFFFF)
+        else:
+            raise ModuleError(
+                f"rng '{kind}' requested but not provided; pass "
+                f"rngs={{'{kind}': key}} to init/apply")
     path = tuple(fr.path)
     cnt = fr.rng_counters.get((kind,) + path, 0)
     fr.rng_counters[(kind,) + path] = cnt + 1

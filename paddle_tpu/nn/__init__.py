@@ -1,0 +1,8 @@
+"""Layer library: core layers, activations, costs, sequence ops, recurrent nets,
+attention — the TPU-native successor of paddle/gserver/layers (+ fluid operators)."""
+
+from . import activations, costs
+from .layers import *  # noqa: F401,F403
+from .layers import __all__ as _layers_all
+
+__all__ = list(_layers_all) + ["activations", "costs"]

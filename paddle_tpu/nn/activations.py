@@ -1,0 +1,149 @@
+"""Activation functions.
+
+The reference registers 17 activations by name
+(``/root/reference/paddle/gserver/activations/ActivationFunction.cpp:97-472``):
+sigmoid, softmax, sequence_softmax, relu, brelu, tanh, stanh, softrelu, abs,
+square, exp, log, sqrt, reciprocal, softsign (+ identity/linear). All are pure
+jnp functions here — XLA fuses them into adjacent matmuls on TPU, so there is no
+kernel registry to mirror; the name->fn map keeps the reference's string-config
+surface for the model-IR frontend.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["get", "ACTIVATIONS", "sequence_softmax"]
+
+
+def identity(x):
+    return x
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def brelu(x, t_min=0.0, t_max=24.0):
+    # bounded relu (ActivationFunction.cpp BRelu: clip to [0, 24])
+    return jnp.clip(x, t_min, t_max)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def stanh(x, a=1.7159, b=2.0 / 3.0):
+    # scaled tanh (LeCun): a * tanh(b * x)
+    return a * jnp.tanh(b * x)
+
+
+def softrelu(x, threshold=40.0):
+    # log(1 + exp(x)) with overflow clamp, as the reference does
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+def abs_act(x):
+    return jnp.abs(x)
+
+
+def square(x):
+    return x * x
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def log_act(x):
+    return jnp.log(x)
+
+
+def sqrt_act(x):
+    return jnp.sqrt(x)
+
+
+def reciprocal(x):
+    return 1.0 / x
+
+
+def softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+def gelu(x):  # beyond the reference set; standard for transformer models
+    return jax.nn.gelu(x)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def leaky_relu(x, alpha=0.01):
+    return jax.nn.leaky_relu(x, alpha)
+
+
+def elu(x):
+    return jax.nn.elu(x)
+
+
+def sequence_softmax(x, lengths=None, mask=None):
+    """Softmax over the time axis of [B, T] (or [B, T, 1]) scores honoring
+    sequence validity — the reference's ``sequence_softmax`` activation
+    (ActivationFunction.cpp SequenceSoftmax) used by attention weights."""
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    if squeeze:
+        x = x[..., 0]
+    if mask is None and lengths is not None:
+        t = x.shape[1]
+        mask = (jnp.arange(t)[None, :] < lengths[:, None]).astype(x.dtype)
+    if mask is not None:
+        x = jnp.where(mask > 0, x, -1e9)
+    out = jax.nn.softmax(x, axis=1)
+    if mask is not None:
+        out = out * mask
+        out = out / jnp.maximum(out.sum(axis=1, keepdims=True), 1e-9)
+    return out[..., None] if squeeze else out
+
+
+ACTIVATIONS = {
+    "": identity,
+    "linear": identity,
+    "identity": identity,
+    "sigmoid": sigmoid,
+    "softmax": softmax,
+    "relu": relu,
+    "brelu": brelu,
+    "tanh": tanh,
+    "stanh": stanh,
+    "softrelu": softrelu,
+    "abs": abs_act,
+    "square": square,
+    "exp": exp,
+    "log": log_act,
+    "sqrt": sqrt_act,
+    "reciprocal": reciprocal,
+    "softsign": softsign,
+    "gelu": gelu,
+    "silu": silu,
+    "leaky_relu": leaky_relu,
+    "elu": elu,
+}
+
+
+def get(name):
+    """Resolve an activation by name (the config-string surface) or pass through."""
+    if callable(name):
+        return name
+    if name not in ACTIVATIONS:
+        raise KeyError(f"unknown activation '{name}'; have {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[name]

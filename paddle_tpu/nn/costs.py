@@ -1,0 +1,222 @@
+"""Cost (loss) functions — the reference's cost-layer family.
+
+Reference: ``/root/reference/paddle/gserver/layers/CostLayer.cpp`` (multi-class
+cross-entropy, soft CE, SVM, Huber, rank cost, lambda rank, smooth-L1, MSE,
+multi-binary-label CE) plus ``NCELayer.cpp`` and ``HierarchicalSigmoidLayer.cpp``.
+All are pure functions ``(logits/outputs, labels, ...) -> per-example loss`` with
+an optional ``weight``; reductions happen in the trainer so data-parallel psum
+averages correctly. Losses compute in float32 regardless of activation dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "softmax_cross_entropy", "cross_entropy_with_probs", "soft_binary_ce",
+    "multi_binary_ce", "mse", "smooth_l1", "huber_regression",
+    "huber_classification", "hinge", "rank_cost", "lambda_rank_ndcg",
+    "sum_cost", "nce_loss", "hsigmoid_loss", "reduce",
+]
+
+
+def _weight(loss, weight):
+    return loss if weight is None else loss * weight
+
+
+def reduce(per_example, mask=None, how: str = "mean"):
+    """Masked reduction to a scalar; use inside train steps."""
+    x = per_example.astype(jnp.float32)
+    if mask is not None:
+        m = mask.astype(jnp.float32)
+        x = x * m
+        if how == "mean":
+            return x.sum() / jnp.maximum(m.sum(), 1.0)
+    if how == "mean":
+        return x.mean()
+    if how == "sum":
+        return x.sum()
+    raise ValueError(how)
+
+
+def softmax_cross_entropy(logits, labels, weight=None):
+    """Multi-class CE from logits, int labels (reference:
+    ``MultiClassCrossEntropy``, CostLayer.cpp; ``oneHotCrossEntropy`` in
+    paddle/math/Matrix.cpp). Stable log-softmax; label -1 masks the example."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    valid = labels >= 0
+    safe = jnp.clip(labels, 0, logits.shape[-1] - 1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return _weight(nll * valid.astype(nll.dtype), weight)
+
+
+def cross_entropy_with_probs(logits, target_probs, weight=None):
+    """Soft-label CE (reference: ``SoftBinaryClassCrossEntropy`` /
+    soft_cross_entropy)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return _weight(-(target_probs * logp).sum(-1), weight)
+
+
+def soft_binary_ce(probs, targets, weight=None, eps=1e-7):
+    """Binary CE on probabilities (post-sigmoid)."""
+    p = jnp.clip(probs.astype(jnp.float32), eps, 1 - eps)
+    l = -(targets * jnp.log(p) + (1 - targets) * jnp.log1p(-p))
+    return _weight(l.sum(-1) if l.ndim > 1 else l, weight)
+
+
+def multi_binary_ce(logits, targets, weight=None):
+    """Multi-label binary CE from logits (reference:
+    ``MultiBinaryLabelCrossEntropy``, CostLayer.cpp)."""
+    x = logits.astype(jnp.float32)
+    l = jnp.maximum(x, 0) - x * targets + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return _weight(l.sum(-1), weight)
+
+
+def mse(output, target, weight=None):
+    """Sum-of-squares cost (reference: ``SumOfSquaresCostLayer``)."""
+    d = (output - target).astype(jnp.float32)
+    return _weight(0.5 * (d * d).sum(-1), weight)
+
+
+def smooth_l1(output, target, weight=None, delta: float = 1.0):
+    """Smooth-L1 (reference: ``SmoothL1CostLayer``; fluid smooth_l1_op)."""
+    d = jnp.abs((output - target).astype(jnp.float32))
+    l = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _weight(l.sum(-1), weight)
+
+
+def huber_regression(output, target, weight=None, delta: float = 1.0):
+    """Huber regression cost (reference: ``HuberRegressionLoss``)."""
+    d = jnp.abs((output - target).astype(jnp.float32))
+    l = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _weight(l.sum(-1), weight)
+
+
+def huber_classification(score, label01, weight=None):
+    """Huberized hinge for binary classification, y in {0,1}
+    (reference: ``HuberTwoClassification``, CostLayer.cpp)."""
+    y = (2.0 * label01 - 1.0).astype(jnp.float32)
+    z = y * score[..., 0].astype(jnp.float32)
+    l = jnp.where(z < -1, -4.0 * z, jnp.where(z < 1, (1 - z) ** 2, 0.0))
+    return _weight(l, weight)
+
+
+def hinge(score, label01, weight=None):
+    """Two-class SVM hinge (reference: ``MultiClassHingeLoss`` binary case)."""
+    y = 2.0 * label01 - 1.0
+    return _weight(jnp.maximum(0.0, 1.0 - y * score[..., 0]), weight)
+
+
+def rank_cost(left, right, label, weight=None):
+    """Pairwise rank cost (RankNet; reference: ``RankingCost``,
+    CostLayer.cpp): -o*t + log(1+exp(o)), o = left-right, t in [0,1]."""
+    o = (left - right).astype(jnp.float32)[..., 0]
+    l = jnp.log1p(jnp.exp(-jnp.abs(o))) + jnp.maximum(o, 0) - o * label
+    return _weight(l, weight)
+
+
+def lambda_rank_ndcg(scores, relevance, lengths=None, sigma: float = 1.0,
+                     ndcg_k: int = 5):
+    """ListWise LambdaRank gradient-compatible cost (reference:
+    ``LambdaCost``, CostLayer.cpp — NDCG-weighted pairwise logistic).
+    ``scores``/``relevance``: [B, T]; returns per-list loss [B]."""
+    s = scores.astype(jnp.float32)
+    r = relevance.astype(jnp.float32)
+    t = s.shape[1]
+    if lengths is not None:
+        valid = (jnp.arange(t)[None, :] < lengths[:, None])
+    else:
+        valid = jnp.ones_like(s, bool)
+    diff_s = s[:, :, None] - s[:, None, :]
+    gain = (2.0 ** r - 1.0)
+    # ideal DCG for normalization
+    sorted_r = jnp.sort(jnp.where(valid, r, -jnp.inf), axis=1)[:, ::-1]
+    disc = 1.0 / jnp.log2(jnp.arange(t) + 2.0)
+    topk = (jnp.arange(t) < ndcg_k)
+    idcg = ((2.0 ** jnp.where(jnp.isfinite(sorted_r), sorted_r, 0.0) - 1.0)
+            * disc * topk).sum(1)
+    # rank by current scores for discounts
+    order = jnp.argsort(-jnp.where(valid, s, -jnp.inf), axis=1)
+    ranks = jnp.argsort(order, axis=1)
+    d = jnp.take(disc, jnp.clip(ranks, 0, t - 1))
+    delta = jnp.abs(gain[:, :, None] - gain[:, None, :]) * \
+        jnp.abs(d[:, :, None] - d[:, None, :]) / \
+        jnp.maximum(idcg, 1e-9)[:, None, None]
+    pair_valid = valid[:, :, None] & valid[:, None, :] & \
+        (r[:, :, None] > r[:, None, :])
+    logistic = jnp.log1p(jnp.exp(-sigma * diff_s))
+    return (delta * logistic * pair_valid).sum((1, 2))
+
+
+def sum_cost(output, weight=None):
+    """Sum of outputs as a cost (reference: ``SumCostLayer`` — used to expose
+    arbitrary expressions as objectives)."""
+    return _weight(output.astype(jnp.float32).sum(-1), weight)
+
+
+def nce_loss(hidden, labels, table_w, table_b, noise_ids, noise_logprob=None,
+             num_classes: Optional[int] = None):
+    """Noise-contrastive estimation (reference: ``NCELayer.cpp``) — binary
+    logistic on the true class vs K sampled noise classes.
+
+    hidden: [B, D]; labels: [B]; table_w: [V, D]; table_b: [V];
+    noise_ids: [B, K] pre-sampled noise class ids.
+    """
+    h = hidden.astype(jnp.float32)
+    pos_w = jnp.take(table_w, labels, axis=0)          # [B, D]
+    pos_b = jnp.take(table_b, labels)
+    pos_logit = jnp.einsum("bd,bd->b", h, pos_w) + pos_b
+    neg_w = jnp.take(table_w, noise_ids, axis=0)       # [B, K, D]
+    neg_b = jnp.take(table_b, noise_ids)
+    neg_logit = jnp.einsum("bd,bkd->bk", h, neg_w) + neg_b
+
+    def softplus(x):  # stable log(1+exp(x))
+        return jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(x, 0.0)
+
+    pos_l = softplus(-pos_logit)
+    neg_l = softplus(neg_logit).sum(-1)
+    return pos_l + neg_l
+
+
+def hsigmoid_loss(hidden, labels, codes, signs, node_w, node_b):
+    """Hierarchical sigmoid (reference: ``HierarchicalSigmoidLayer.cpp``,
+    ``paddle/math/MatrixBitCode.cpp``) with a *complete binary tree* over
+    classes, matching the reference's bit-code addressing.
+
+    hidden: [B, D]; codes: [B, L] int node ids (-1 pad); signs: [B, L] ±1/0;
+    node_w: [num_nodes, D]; node_b: [num_nodes].
+    Use :func:`build_hsigmoid_codes` to derive codes/signs from labels.
+    """
+    h = hidden.astype(jnp.float32)
+    safe = jnp.maximum(codes, 0)
+    w = jnp.take(node_w, safe, axis=0)                 # [B, L, D]
+    b = jnp.take(node_b, safe)
+    logit = jnp.einsum("bd,bld->bl", h, w) + b
+    z = signs * logit
+    l = jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(-z, 0.0)
+    return (l * (codes >= 0)).sum(-1)
+
+
+def build_hsigmoid_codes(labels, num_classes: int):
+    """Host/jit helper: complete-binary-tree path codes for each label.
+
+    Mirrors the reference's ``SimpleCode`` (``paddle/math/MatrixBitCode.cpp``):
+    code(c) = c + num_classes maps the label into heap order; internal nodes are
+    indices [1, num_classes); sign is +1 when the path goes left (bit 0).
+    Returns (codes [B, L], signs [B, L]) with -1/0 padding; L = ceil(log2(C)).
+    """
+    depth = max(1, int(jnp.ceil(jnp.log2(num_classes))))
+    c = labels + num_classes
+    codes, signs = [], []
+    for _ in range(depth):
+        parent = c // 2
+        bit = c % 2
+        valid = parent >= 1
+        codes.append(jnp.where(valid, parent - 1, -1))
+        signs.append(jnp.where(valid, 1.0 - 2.0 * bit, 0.0))
+        c = parent
+    return jnp.stack(codes, -1), jnp.stack(signs, -1)

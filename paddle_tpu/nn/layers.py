@@ -1,0 +1,727 @@
+"""Core layer library — TPU-native equivalents of the reference's gserver layers.
+
+The reference implements ~110 C++ ``Layer`` classes
+(``/root/reference/paddle/gserver/layers/``; Python surface
+``python/paddle/trainer_config_helpers/layers.py``). Here each layer is a thin
+:class:`~paddle_tpu.core.module.Module` emitting jax.numpy/lax ops; XLA handles
+fusion and MXU tiling, so layers carry no device-specific code (the analog of the
+reference's CPU/GPU kernel pairs collapsing into one implementation).
+
+Conventions:
+  - Images are NHWC (TPU-native layout; the reference is NCHW — transposed at
+    the data boundary). Conv kernels are HWIO.
+  - Dense compute may run in bf16 per the active dtype policy; params stay f32.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import initializers as I
+from ..core.dtypes import current_policy
+from ..core.module import Module, current_rng
+from . import activations
+
+__all__ = [
+    "Linear", "Embedding", "Conv2D", "Conv2DTranspose", "DepthwiseConv2D",
+    "Pool2D", "GlobalPool", "BatchNorm", "LayerNorm", "GroupNorm", "Dropout",
+    "Maxout", "Bias", "ScaleShift", "CrossChannelNorm", "SpatialPyramidPool",
+    "FeatureMapExpand", "BlockExpand", "Interpolation", "Multiplex", "RowL2Norm",
+    "SumToOneNorm", "DataNorm", "L2Distance", "CosSim", "OuterProd", "ConvShift",
+    "SlopeIntercept", "Pad2D", "Crop2D", "Resize", "Rotate", "Addto", "Concat",
+    "MixedLayer", "FullMatrixProjection", "TableProjection", "IdentityProjection",
+    "DotMulProjection", "ContextProjection",
+]
+
+Pair = Union[int, Tuple[int, int]]
+
+
+def _pair(v: Pair) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class Linear(Module):
+    """Fully-connected layer (reference: ``FullyConnectedLayer``,
+    ``gserver/layers/FullyConnectedLayer.cpp``; fluid ``mul_op`` + bias)."""
+
+    def __init__(self, features: int, act="", use_bias: bool = True,
+                 w_init=I.fan_in_uniform, b_init=I.zeros, name=None):
+        super().__init__(name=name)
+        self.features = features
+        self.act = activations.get(act)
+        self.use_bias = use_bias
+        self.w_init = w_init
+        self.b_init = b_init
+
+    def forward(self, x):
+        pol = current_policy()
+        w = self.param("w", self.w_init, (x.shape[-1], self.features))
+        y = jnp.dot(pol.cast_compute(x), pol.cast_compute(w),
+                    preferred_element_type=pol.accum_dtype)
+        if self.use_bias:
+            b = self.param("b", self.b_init, (self.features,))
+            y = y + b
+        return self.act(y)
+
+
+class Embedding(Module):
+    """Embedding lookup (reference: ``TableProjection``,
+    ``gserver/layers/TableProjection.cpp``; fluid ``lookup_table_op``).
+    ``ids`` may be any-int shape; output appends the embedding dim.
+    Out-of-range ids (e.g. padding = -1) return zeros."""
+
+    def __init__(self, vocab: int, dim: int, w_init=None, name=None):
+        super().__init__(name=name)
+        self.vocab = vocab
+        self.dim = dim
+        self.w_init = w_init or I.normal(1.0 / np.sqrt(dim))
+
+    def table(self):
+        """Fetch the table from within this module's own scope (callable from a
+        parent's forward — pushes this module's path so the param is shared
+        with lookups, enabling tied softmax weights)."""
+        from ..core.module import _frame
+        fr = _frame()
+        name = self._ensure_name(fr)
+        fr.path.append(name)
+        try:
+            return self.param("w", self.w_init, (self.vocab, self.dim))
+        finally:
+            fr.path.pop()
+
+    def forward(self, ids):
+        w = self.param("w", self.w_init, (self.vocab, self.dim))
+        valid = (ids >= 0) & (ids < self.vocab)
+        safe = jnp.clip(ids, 0, self.vocab - 1)
+        out = jnp.take(w, safe, axis=0)
+        return out * valid[..., None].astype(out.dtype)
+
+    def attend(self, x):
+        """Project activations back onto the table (tied softmax weights)."""
+        return jnp.dot(x, self.table().T)
+
+
+class Conv2D(Module):
+    """2-D convolution, NHWC/HWIO (reference: ``ExpandConvLayer`` /
+    ``CudnnConvLayer``, ``gserver/layers/ExpandConvLayer.cpp``; function-layer
+    ``GemmConvOp``). XLA lowers this onto the MXU directly."""
+
+    def __init__(self, features: int, kernel: Pair, stride: Pair = 1,
+                 padding="SAME", dilation: Pair = 1, groups: int = 1, act="",
+                 use_bias: bool = True, w_init=I.msra_normal, name=None):
+        super().__init__(name=name)
+        self.features = features
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        self.padding = padding if isinstance(padding, str) else \
+            [_pair(p) for p in (padding if isinstance(padding, (list, tuple))
+                                and isinstance(padding[0], (list, tuple))
+                                else [padding, padding])]
+        self.dilation = _pair(dilation)
+        self.groups = groups
+        self.act = activations.get(act)
+        self.use_bias = use_bias
+        self.w_init = w_init
+
+    def forward(self, x):
+        pol = current_policy()
+        kh, kw = self.kernel
+        cin = x.shape[-1]
+        w = self.param("w", self.w_init,
+                       (kh, kw, cin // self.groups, self.features))
+        y = lax.conv_general_dilated(
+            pol.cast_compute(x), pol.cast_compute(w),
+            window_strides=self.stride, padding=self.padding,
+            rhs_dilation=self.dilation, feature_group_count=self.groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=pol.accum_dtype)
+        if self.use_bias:
+            y = y + self.param("b", I.zeros, (self.features,))
+        return self.act(y)
+
+
+class DepthwiseConv2D(Conv2D):
+    """Depthwise conv (reference: ``DepthwiseConvOp``, function layer)."""
+
+    def __init__(self, multiplier: int, kernel: Pair, stride: Pair = 1,
+                 padding="SAME", act="", use_bias=True, name=None):
+        # features resolved at call time: cin * multiplier, groups = cin
+        super().__init__(features=multiplier, kernel=kernel, stride=stride,
+                         padding=padding, act=act, use_bias=use_bias, name=name)
+        self.multiplier = multiplier
+
+    def forward(self, x):
+        pol = current_policy()
+        kh, kw = self.kernel
+        cin = x.shape[-1]
+        features = cin * self.multiplier
+        w = self.param("w", self.w_init, (kh, kw, 1, features))
+        y = lax.conv_general_dilated(
+            pol.cast_compute(x), pol.cast_compute(w),
+            window_strides=self.stride, padding=self.padding,
+            rhs_dilation=self.dilation, feature_group_count=cin,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=pol.accum_dtype)
+        if self.use_bias:
+            y = y + self.param("b", I.zeros, (features,))
+        return self.act(y)
+
+
+class Conv2DTranspose(Module):
+    """Transposed conv (reference: ``ExpandConvTransLayer``, ``DeConv3DLayer``)."""
+
+    def __init__(self, features: int, kernel: Pair, stride: Pair = 1,
+                 padding="SAME", act="", use_bias=True,
+                 w_init=I.msra_normal, name=None):
+        super().__init__(name=name)
+        self.features = features
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        self.padding = padding
+        self.act = activations.get(act)
+        self.use_bias = use_bias
+        self.w_init = w_init
+
+    def forward(self, x):
+        pol = current_policy()
+        kh, kw = self.kernel
+        w = self.param("w", self.w_init, (kh, kw, x.shape[-1], self.features))
+        y = lax.conv_transpose(
+            pol.cast_compute(x), pol.cast_compute(w),
+            strides=self.stride, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + self.param("b", I.zeros, (self.features,))
+        return self.act(pol.cast_accum(y))
+
+
+class Pool2D(Module):
+    """Max/avg pooling (reference: ``PoolLayer``/``CudnnPoolLayer``,
+    ``gserver/layers/PoolLayer.cpp``; function ``Pool2DOp``)."""
+
+    def __init__(self, kind: str, window: Pair, stride: Optional[Pair] = None,
+                 padding="VALID", name=None):
+        super().__init__(name=name)
+        assert kind in ("max", "avg")
+        self.kind = kind
+        self.window = _pair(window)
+        self.stride = _pair(stride if stride is not None else window)
+        self.padding = padding
+
+    def forward(self, x):
+        wh, ww = self.window
+        sh, sw = self.stride
+        dims = (1, wh, ww, 1)
+        strides = (1, sh, sw, 1)
+        if self.kind == "max":
+            return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides,
+                                     self.padding)
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, self.padding)
+        if self.padding == "VALID":
+            return s / (wh * ww)
+        ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, self.padding)
+        return s / jnp.maximum(cnt, 1.0)
+
+
+class GlobalPool(Module):
+    """Global spatial pooling to [N, C]."""
+
+    def __init__(self, kind: str = "avg", name=None):
+        super().__init__(name=name)
+        self.kind = kind
+
+    def forward(self, x):
+        return (jnp.max if self.kind == "max" else jnp.mean)(x, axis=(1, 2))
+
+
+class BatchNorm(Module):
+    """Batch normalization with running stats (reference:
+    ``BatchNormalizationLayer``/``CudnnBatchNormLayer``,
+    ``gserver/layers/BatchNormalizationLayer.cpp``; running mean/var kept as
+    non-trainable state, the analog of PARAMETER_VALUE-typed stat buffers)."""
+
+    def __init__(self, momentum: float = 0.9, eps: float = 1e-5,
+                 use_scale_shift: bool = True, name=None):
+        super().__init__(name=name)
+        self.momentum = momentum
+        self.eps = eps
+        self.use_scale_shift = use_scale_shift
+
+    def forward(self, x, train: bool = False):
+        c = x.shape[-1]
+        axes = tuple(range(x.ndim - 1))
+        mean_s = self.state("mean", I.zeros, (c,))
+        var_s = self.state("var", I.ones, (c,))
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            m = self.momentum
+            self.update_state("mean", m * mean_s + (1 - m) * mean)
+            self.update_state("var", m * var_s + (1 - m) * var)
+        else:
+            mean, var = mean_s, var_s
+        y = (x - mean) * lax.rsqrt(var + self.eps)
+        if self.use_scale_shift:
+            y = y * self.param("scale", I.ones, (c,)) + \
+                self.param("shift", I.zeros, (c,))
+        return y
+
+
+class LayerNorm(Module):
+    """Layer normalization (beyond the reference's set; required by the modern
+    attention stack — SURVEY.md §5 notes transformer-era additions)."""
+
+    def __init__(self, eps: float = 1e-6, use_scale: bool = True,
+                 use_bias: bool = True, name=None):
+        super().__init__(name=name)
+        self.eps = eps
+        self.use_scale = use_scale
+        self.use_bias = use_bias
+
+    def forward(self, x):
+        dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * lax.rsqrt(var + self.eps)
+        c = x.shape[-1]
+        if self.use_scale:
+            y = y * self.param("scale", I.ones, (c,))
+        if self.use_bias:
+            y = y + self.param("bias", I.zeros, (c,))
+        return y.astype(dtype)
+
+
+class GroupNorm(Module):
+    def __init__(self, groups: int = 32, eps: float = 1e-5, name=None):
+        super().__init__(name=name)
+        self.groups = groups
+        self.eps = eps
+
+    def forward(self, x):
+        c = x.shape[-1]
+        g = min(self.groups, c)
+        if c % g:
+            raise ValueError(f"GroupNorm: {c} channels not divisible by "
+                             f"{g} groups")
+        shape = x.shape[:-1] + (g, c // g)
+        xg = x.reshape(shape)
+        axes = tuple(range(1, x.ndim - 1)) + (x.ndim,)
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        y = ((xg - mean) * lax.rsqrt(var + self.eps)).reshape(x.shape)
+        return y * self.param("scale", I.ones, (c,)) + \
+            self.param("bias", I.zeros, (c,))
+
+
+class Dropout(Module):
+    """Inverted dropout (reference: ``drop_rate`` layer attr applied via
+    ``Layer::forwardDropOut``, ``gserver/layers/Layer.cpp``)."""
+
+    def __init__(self, rate: float, name=None):
+        super().__init__(name=name)
+        self.rate = rate
+
+    def forward(self, x, train: bool = False):
+        if not train or self.rate <= 0.0:
+            return x
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(current_rng("dropout"), keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class Maxout(Module):
+    """Maxout over channel groups (reference: ``MaxOutLayer``)."""
+
+    def __init__(self, groups: int, name=None):
+        super().__init__(name=name)
+        self.groups = groups
+
+    def forward(self, x):
+        c = x.shape[-1]
+        return jnp.max(x.reshape(x.shape[:-1] + (c // self.groups, self.groups)),
+                       axis=-1)
+
+
+class Bias(Module):
+    """Standalone bias (reference: ``BiasLayer`` / shared biases)."""
+
+    def forward(self, x):
+        return x + self.param("b", I.zeros, (x.shape[-1],))
+
+
+class ScaleShift(Module):
+    """Per-channel learned scale+shift (reference: ``ScaleShiftLayer``)."""
+
+    def forward(self, x):
+        return x * self.param("scale", I.ones, (x.shape[-1],)) + \
+            self.param("shift", I.zeros, (x.shape[-1],))
+
+
+class CrossChannelNorm(Module):
+    """L2 norm across channels with learned per-channel scale
+    (reference: ``CrossChannelNormLayer``, SSD's Norm layer)."""
+
+    def forward(self, x):
+        scale = self.param("scale", I.constant(20.0), (x.shape[-1],))
+        norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True) + 1e-12)
+        return x / norm * scale
+
+
+class SpatialPyramidPool(Module):
+    """SPP (reference: ``SpatialPyramidPoolLayer.cpp``) — concat of pyramid
+    max-pools to a fixed-size vector regardless of input HW."""
+
+    def __init__(self, levels: int = 3, kind: str = "max", name=None):
+        super().__init__(name=name)
+        self.levels = levels
+        self.kind = kind
+
+    def forward(self, x):
+        n, h, w, c = x.shape
+        outs = []
+        for lvl in range(self.levels):
+            bins = 2 ** lvl
+            # Static pyramid: split into bins x bins cells (requires h, w >= bins)
+            hs = [h * i // bins for i in range(bins + 1)]
+            ws = [w * i // bins for i in range(bins + 1)]
+            for i in range(bins):
+                for j in range(bins):
+                    cell = x[:, hs[i]:hs[i + 1], ws[j]:ws[j + 1], :]
+                    red = jnp.max if self.kind == "max" else jnp.mean
+                    outs.append(red(cell, axis=(1, 2)))
+        return jnp.concatenate(outs, axis=-1)
+
+
+class FeatureMapExpand(Module):
+    """Expand [N, C] vector across spatial dims of a reference map
+    (reference: ``FeatureMapExpandLayer``)."""
+
+    def __init__(self, as_map_of=None, name=None):
+        super().__init__(name=name)
+
+    def forward(self, x, like):
+        return jnp.broadcast_to(x[:, None, None, :],
+                                like.shape[:3] + (x.shape[-1],))
+
+
+class BlockExpand(Module):
+    """im2col as a layer (reference: ``BlockExpandLayer`` — conv patches to
+    sequence, used for OCR)."""
+
+    def __init__(self, block: Pair, stride: Pair, padding="VALID", name=None):
+        super().__init__(name=name)
+        self.block = _pair(block)
+        self.stride = _pair(stride)
+        self.padding = padding
+
+    def forward(self, x):
+        bh, bw = self.block
+        patches = lax.conv_general_dilated_patches(
+            x, filter_shape=(bh, bw), window_strides=self.stride,
+            padding=self.padding, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        n, oh, ow, d = patches.shape
+        return patches.reshape(n, oh * ow, d)
+
+
+class Interpolation(Module):
+    """out = w*a + (1-w)*b with per-sample weight (reference:
+    ``InterpolationLayer``)."""
+
+    def forward(self, w, a, b):
+        w = w.reshape(w.shape[0], *([1] * (a.ndim - 1)))
+        return w * a + (1.0 - w) * b
+
+
+class Multiplex(Module):
+    """Row-wise select among K inputs by index (reference: ``MultiplexLayer``)."""
+
+    def forward(self, index, *xs):
+        stacked = jnp.stack(xs, axis=0)          # [K, N, ...]
+        return jnp.take_along_axis(
+            stacked, index.reshape(1, -1, *([1] * (stacked.ndim - 2))),
+            axis=0)[0]
+
+
+class RowL2Norm(Module):
+    """Row-wise L2 normalize (reference: ``RowL2NormLayer``)."""
+
+    def forward(self, x):
+        return x / jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True) + 1e-12)
+
+
+class SumToOneNorm(Module):
+    """Row-wise sum-to-one normalize (reference: ``SumToOneNormLayer``)."""
+
+    def forward(self, x):
+        return x / jnp.maximum(jnp.sum(x, axis=-1, keepdims=True), 1e-12)
+
+
+class DataNorm(Module):
+    """Input feature normalization from precomputed stats (reference:
+    ``DataNormLayer`` — z-score / min-max / decimal scaling)."""
+
+    def __init__(self, strategy: str = "z-score", name=None):
+        super().__init__(name=name)
+        self.strategy = strategy
+
+    def forward(self, x):
+        c = x.shape[-1]
+        if self.strategy == "z-score":
+            mean = self.state("mean", I.zeros, (c,))
+            std = self.state("std", I.ones, (c,))
+            return (x - mean) / jnp.maximum(std, 1e-12)
+        if self.strategy == "min-max":
+            mn = self.state("min", I.zeros, (c,))
+            mx = self.state("max", I.ones, (c,))
+            return (x - mn) / jnp.maximum(mx - mn, 1e-12)
+        raise ValueError(self.strategy)
+
+
+class L2Distance(Module):
+    """Row-wise L2 distance between two inputs (reference: ``L2DistanceLayer``)."""
+
+    def forward(self, a, b):
+        return jnp.sqrt(jnp.sum((a - b) ** 2, axis=-1, keepdims=True) + 1e-12)
+
+
+class CosSim(Module):
+    """Row-wise cosine similarity * scale (reference: ``CosSimLayer``,
+    function ``CosSimOp``)."""
+
+    def __init__(self, scale: float = 1.0, name=None):
+        super().__init__(name=name)
+        self.scale = scale
+
+    def forward(self, a, b):
+        na = jnp.sqrt(jnp.sum(a * a, axis=-1) + 1e-12)
+        nb = jnp.sqrt(jnp.sum(b * b, axis=-1) + 1e-12)
+        return (self.scale * jnp.sum(a * b, axis=-1) / (na * nb))[..., None]
+
+
+class OuterProd(Module):
+    """Row-wise outer product flattened (reference: ``OuterProdLayer``)."""
+
+    def forward(self, a, b):
+        return (a[:, :, None] * b[:, None, :]).reshape(a.shape[0], -1)
+
+
+class ConvShift(Module):
+    """Circular 1-D correlation of rows (reference: ``ConvShiftLayer`` — NTM
+    shift addressing)."""
+
+    def forward(self, a, b):
+        n, m = a.shape
+        k = b.shape[-1]
+        half = k // 2
+        idx = (jnp.arange(m)[:, None] + jnp.arange(-half, k - half)[None, :]) % m
+        gathered = a[:, idx]                     # [N, M, K]
+        return jnp.einsum("nmk,nk->nm", gathered, b)
+
+
+class SlopeIntercept(Module):
+    """y = slope*x + intercept, fixed scalars (reference:
+    ``SlopeInterceptLayer``)."""
+
+    def __init__(self, slope: float = 1.0, intercept: float = 0.0, name=None):
+        super().__init__(name=name)
+        self.slope = slope
+        self.intercept = intercept
+
+    def forward(self, x):
+        return self.slope * x + self.intercept
+
+
+class Pad2D(Module):
+    """Zero-pad NHWC (reference: ``PadLayer``, function ``PadOp``)."""
+
+    def __init__(self, pad: Sequence[int], name=None):
+        super().__init__(name=name)
+        self.pad = pad  # (top, bottom, left, right)
+
+    def forward(self, x):
+        t, b, l, r = self.pad
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+
+
+class Crop2D(Module):
+    """Static crop NHWC (reference: ``CropLayer``, function ``CropOp``)."""
+
+    def __init__(self, offset: Tuple[int, int], size: Tuple[int, int], name=None):
+        super().__init__(name=name)
+        self.offset = offset
+        self.size = size
+
+    def forward(self, x):
+        (oh, ow), (h, w) = self.offset, self.size
+        return x[:, oh:oh + h, ow:ow + w, :]
+
+
+class Resize(Module):
+    """Reshape rows to a new width (reference: ``ResizeLayer``)."""
+
+    def __init__(self, size: int, name=None):
+        super().__init__(name=name)
+        self.size = size
+
+    def forward(self, x):
+        return x.reshape(-1, self.size)
+
+
+class Rotate(Module):
+    """Rotate feature maps 90° (reference: ``RotateLayer``)."""
+
+    def forward(self, x):
+        return jnp.rot90(x, k=1, axes=(1, 2))
+
+
+class Addto(Module):
+    """Elementwise sum of inputs + optional bias/activation (reference:
+    ``AddtoLayer``)."""
+
+    def __init__(self, act="", use_bias: bool = False, name=None):
+        super().__init__(name=name)
+        self.act = activations.get(act)
+        self.use_bias = use_bias
+
+    def forward(self, *xs):
+        y = xs[0]
+        for x in xs[1:]:
+            y = y + x
+        if self.use_bias:
+            y = y + self.param("b", I.zeros, (y.shape[-1],))
+        return self.act(y)
+
+
+class Concat(Module):
+    """Feature concat (reference: ``ConcatenateLayer``)."""
+
+    def __init__(self, axis: int = -1, act="", name=None):
+        super().__init__(name=name)
+        self.axis = axis
+        self.act = activations.get(act)
+
+    def forward(self, *xs):
+        return self.act(jnp.concatenate(xs, axis=self.axis))
+
+
+# ---------------------------------------------------------------------------
+# MixedLayer & projections — the reference's composable projection system
+# (``gserver/layers/MixedLayer.cpp`` + projections; config surface
+# ``trainer_config_helpers/layers.py mixed_layer``). A MixedLayer sums the
+# outputs of K projections, then bias + activation.
+# ---------------------------------------------------------------------------
+
+class FullMatrixProjection(Module):
+    """Dense projection (reference: ``FullMatrixProjection.cpp``)."""
+
+    def __init__(self, features: int, w_init=I.fan_in_uniform, name=None):
+        super().__init__(name=name)
+        self.features = features
+        self.w_init = w_init
+
+    def forward(self, x):
+        w = self.param("w", self.w_init, (x.shape[-1], self.features))
+        return jnp.dot(x, w)
+
+
+class TableProjection(Module):
+    """Embedding projection (reference: ``TableProjection.cpp``)."""
+
+    def __init__(self, vocab: int, dim: int, name=None):
+        super().__init__(name=name)
+        self.emb = Embedding(vocab, dim, name="table")
+
+    def forward(self, ids):
+        return self.emb(ids)
+
+
+class IdentityProjection(Module):
+    """Identity / scaled identity (reference: ``IdentityProjection.cpp``)."""
+
+    def __init__(self, scale: float = 1.0, offset: int = 0, size=None, name=None):
+        super().__init__(name=name)
+        self.scale = scale
+        self.offset = offset
+        self.size = size
+
+    def forward(self, x):
+        if self.size is not None:
+            x = x[..., self.offset:self.offset + self.size]
+        return self.scale * x
+
+
+class DotMulProjection(Module):
+    """Elementwise learned-weight product (reference: ``DotMulProjection.cpp``)."""
+
+    def forward(self, x):
+        w = self.param("w", I.uniform(1.0), (x.shape[-1],))
+        return x * w
+
+
+class ContextProjection(Module):
+    """Sliding context window concat over time (reference:
+    ``ContextProjection.cpp``; function ``ContextProjectionOp``) — concatenates
+    [t+start, t+start+len) frames per step; out-of-range frames are zero (or
+    trainable boundary vectors when ``trainable_pads``)."""
+
+    def __init__(self, context_len: int, context_start: Optional[int] = None,
+                 trainable_pads: bool = False, name=None):
+        super().__init__(name=name)
+        self.len = context_len
+        self.start = -(context_len // 2) if context_start is None else context_start
+        self.trainable_pads = trainable_pads
+
+    def forward(self, x):  # x: [B, T, D]
+        b, t, d = x.shape
+        n_left = max(-self.start, 0)
+        n_right = max(self.start + self.len - 1, 0)
+        idx = jnp.arange(t)
+        cols = []
+        for k in range(self.len):
+            off = self.start + k
+            shifted = jnp.roll(x, -off, axis=1)
+            valid = ((idx + off >= 0) & (idx + off < t))[None, :, None]
+            if self.trainable_pads and off < 0:
+                # missing frame t+off ∈ [-n_left, -1] maps to begin-pad row
+                # n_left + (t+off), varying per timestep (reference:
+                # ContextProjection begin_pad semantics).
+                rows = jnp.clip(n_left + idx + off, 0, n_left - 1)
+                fill = self.param("pad_l", I.zeros, (n_left, d))[rows]
+                cols.append(jnp.where(valid, shifted, fill[None, :, :]))
+            elif self.trainable_pads and off > 0:
+                # missing frame t+off ∈ [T, T+n_right-1] maps to end-pad row
+                # t+off-T, varying per timestep.
+                rows = jnp.clip(idx + off - t, 0, n_right - 1)
+                fill = self.param("pad_r", I.zeros, (n_right, d))[rows]
+                cols.append(jnp.where(valid, shifted, fill[None, :, :]))
+            else:
+                cols.append(jnp.where(valid, shifted, 0.0))
+        return jnp.concatenate(cols, axis=-1)
+
+
+class MixedLayer(Module):
+    """Sum of projections + bias + activation (reference: ``MixedLayer.cpp``)."""
+
+    def __init__(self, projections: Sequence[Module], act="", use_bias=True,
+                 name=None):
+        super().__init__(name=name)
+        self.projections = list(projections)
+        self.act = activations.get(act)
+        self.use_bias = use_bias
+
+    def forward(self, *inputs):
+        assert len(inputs) == len(self.projections)
+        y = None
+        for proj, x in zip(self.projections, inputs):
+            o = proj(x)
+            y = o if y is None else y + o
+        if self.use_bias:
+            y = y + self.param("b", I.zeros, (y.shape[-1],))
+        return self.act(y)

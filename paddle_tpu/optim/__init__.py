@@ -1,0 +1,9 @@
+"""Optimizers as pure pytree transforms + LR schedules (successor of
+paddle/parameter optimizers and the pserver's remote optimizer tier)."""
+
+from . import schedules
+from .optimizers import (EMA, Optimizer, adadelta, adagrad, adam, adamax,
+                         apply_updates, chain, clip_by_global_norm,
+                         clip_by_value, decayed_adagrad, ftrl, global_norm,
+                         l1_decay, lamb, momentum, polyak_average, rmsprop,
+                         sgd, weight_decay)

@@ -1,0 +1,410 @@
+"""First-order optimizers as pure pytree transforms.
+
+The reference's optimizer family (``/root/reference/paddle/parameter/
+FirstOrderOptimizer.h:24-346``: SGD+momentum, SparseMomentum, Adagrad,
+DecayedAdagrad, AdaDelta, RMSProp, Adam, Adamax, OptimizerWithGradientClipping;
+fluid adds ftrl/proximal ops in ``paddle/operators``) runs per-parameter-block on
+CPU/GPU or *remotely inside the parameter server* (``ParameterServer2::doOperation``).
+
+TPU-native design: an optimizer is ``(init_state, update)`` over arbitrary
+pytrees — state lives on device, is sharded with the params over the mesh (this
+replaces the pserver's sharded optimizer state), and the whole update fuses into
+the pjit'd train step. Composition (clipping → decay → rule → averaging) mirrors
+the reference's updater-hook chain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import schedules as S
+
+__all__ = [
+    "Optimizer", "sgd", "momentum", "adagrad", "decayed_adagrad", "adadelta",
+    "rmsprop", "adam", "adamax", "ftrl", "lamb", "chain", "clip_by_global_norm",
+    "clip_by_value", "weight_decay", "l1_decay", "polyak_average", "apply_updates",
+    "global_norm",
+]
+
+PyTree = Any
+tmap = jax.tree_util.tree_map
+
+
+@dataclasses.dataclass(frozen=True)
+class Optimizer:
+    """A gradient transform: state = init(params); updates, state = update(
+    grads, state, params, step). ``updates`` are *deltas added to params*."""
+    init: Callable[[PyTree], PyTree]
+    update: Callable[[PyTree, PyTree, PyTree, jax.Array], Tuple[PyTree, PyTree]]
+
+    def apply(self, grads, state, params, step):
+        """Convenience: returns (new_params, new_state)."""
+        updates, new_state = self.update(grads, state, params, step)
+        return apply_updates(params, updates), new_state
+
+
+def apply_updates(params, updates):
+    return tmap(lambda p, u: (p + u).astype(p.dtype), params, updates)
+
+
+def global_norm(tree) -> jax.Array:
+    leaves = [jnp.sum(jnp.square(x.astype(jnp.float32)))
+              for x in jax.tree_util.tree_leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves))) if leaves else jnp.asarray(0.0)
+
+
+def _lr_fn(lr, schedule):
+    sched = schedule or S.constant()
+    if callable(lr):
+        return lambda step: lr(step)
+    return lambda step: lr * sched(step)
+
+
+# -- base rules ---------------------------------------------------------------
+
+def sgd(lr, schedule=None) -> Optimizer:
+    """Plain SGD (reference: ``SgdOptimizer``, FirstOrderOptimizer.h:24)."""
+    lrf = _lr_fn(lr, schedule)
+
+    def init(params):
+        return ()
+
+    def update(grads, state, params, step):
+        return tmap(lambda g: -lrf(step) * g.astype(jnp.float32), grads), state
+    return Optimizer(init, update)
+
+
+def momentum(lr, mu: float = 0.9, nesterov: bool = False,
+             schedule=None) -> Optimizer:
+    """SGD with (Nesterov) momentum (reference: momentum term in
+    ``SgdOptimizer``; ``MomentumOp`` in fluid)."""
+    lrf = _lr_fn(lr, schedule)
+
+    def init(params):
+        return tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+
+    def update(grads, vel, params, step):
+        new_v = tmap(lambda v, g: mu * v - lrf(step) * g.astype(jnp.float32),
+                     vel, grads)
+        if nesterov:
+            upd = tmap(lambda v, g: mu * v - lrf(step) * g.astype(jnp.float32),
+                       new_v, grads)
+        else:
+            upd = new_v
+        return upd, new_v
+    return Optimizer(init, update)
+
+
+def adagrad(lr, eps: float = 1e-6, schedule=None) -> Optimizer:
+    """Adagrad (reference: ``AdagradParameterOptimizer``,
+    FirstOrderOptimizer.h:124)."""
+    lrf = _lr_fn(lr, schedule)
+
+    def init(params):
+        return tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+
+    def update(grads, accum, params, step):
+        new_a = tmap(lambda a, g: a + jnp.square(g.astype(jnp.float32)),
+                     accum, grads)
+        upd = tmap(lambda a, g: -lrf(step) * g.astype(jnp.float32)
+                   / (jnp.sqrt(a) + eps), new_a, grads)
+        return upd, new_a
+    return Optimizer(init, update)
+
+
+def decayed_adagrad(lr, rho: float = 0.95, eps: float = 1e-6,
+                    schedule=None) -> Optimizer:
+    """Decayed Adagrad (reference: ``DecayedAdagradParameterOptimizer``,
+    FirstOrderOptimizer.h:153)."""
+    lrf = _lr_fn(lr, schedule)
+
+    def init(params):
+        return tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+
+    def update(grads, accum, params, step):
+        new_a = tmap(lambda a, g: rho * a + (1 - rho)
+                     * jnp.square(g.astype(jnp.float32)), accum, grads)
+        upd = tmap(lambda a, g: -lrf(step) * g.astype(jnp.float32)
+                   / (jnp.sqrt(a) + eps), new_a, grads)
+        return upd, new_a
+    return Optimizer(init, update)
+
+
+def adadelta(rho: float = 0.95, eps: float = 1e-6, lr: float = 1.0,
+             schedule=None) -> Optimizer:
+    """AdaDelta (reference: ``AdaDeltaParameterOptimizer``,
+    FirstOrderOptimizer.h:181)."""
+    lrf = _lr_fn(lr, schedule)
+
+    class St(NamedTuple):
+        accum: PyTree
+        accum_update: PyTree
+
+    def init(params):
+        z = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(z, z)
+
+    def update(grads, st, params, step):
+        new_a = tmap(lambda a, g: rho * a + (1 - rho)
+                     * jnp.square(g.astype(jnp.float32)), st.accum, grads)
+        upd = tmap(lambda a, au, g: -lrf(step)
+                   * jnp.sqrt(au + eps) / jnp.sqrt(a + eps)
+                   * g.astype(jnp.float32), new_a, st.accum_update, grads)
+        new_au = tmap(lambda au, u: rho * au + (1 - rho) * jnp.square(u),
+                      st.accum_update, upd)
+        return upd, St(new_a, new_au)
+    return Optimizer(init, update)
+
+
+def rmsprop(lr, rho: float = 0.95, eps: float = 1e-6, momentum_coef: float = 0.0,
+            centered: bool = True, schedule=None) -> Optimizer:
+    """RMSProp (reference: ``RMSPropParameterOptimizer``,
+    FirstOrderOptimizer.h:215 — the reference keeps both E[g^2] and E[g],
+    i.e. the centered variant)."""
+    lrf = _lr_fn(lr, schedule)
+
+    class St(NamedTuple):
+        ms: PyTree
+        mg: PyTree
+        mom: PyTree
+
+    def init(params):
+        z = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(z, z, z)
+
+    def update(grads, st, params, step):
+        g32 = tmap(lambda g: g.astype(jnp.float32), grads)
+        new_ms = tmap(lambda a, g: rho * a + (1 - rho) * jnp.square(g),
+                      st.ms, g32)
+        if centered:
+            new_mg = tmap(lambda a, g: rho * a + (1 - rho) * g, st.mg, g32)
+            denom = tmap(lambda ms, mg: jnp.sqrt(ms - jnp.square(mg) + eps),
+                         new_ms, new_mg)
+        else:
+            new_mg = st.mg
+            denom = tmap(lambda ms: jnp.sqrt(ms + eps), new_ms)
+        raw = tmap(lambda g, d: -lrf(step) * g / d, g32, denom)
+        if momentum_coef > 0:
+            new_mom = tmap(lambda m, r: momentum_coef * m + r, st.mom, raw)
+            return new_mom, St(new_ms, new_mg, new_mom)
+        return raw, St(new_ms, new_mg, st.mom)
+    return Optimizer(init, update)
+
+
+def adam(lr, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+         schedule=None) -> Optimizer:
+    """Adam (reference: ``AdamParameterOptimizer``, FirstOrderOptimizer.h:268;
+    also the pserver-side remote Adam via doOperation)."""
+    lrf = _lr_fn(lr, schedule)
+
+    class St(NamedTuple):
+        m: PyTree
+        v: PyTree
+
+    def init(params):
+        z = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(z, z)
+
+    def update(grads, st, params, step):
+        t = step + 1
+        new_m = tmap(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+                     st.m, grads)
+        new_v = tmap(lambda v, g: b2 * v + (1 - b2)
+                     * jnp.square(g.astype(jnp.float32)), st.v, grads)
+        bc1 = 1 - b1 ** t
+        bc2 = 1 - b2 ** t
+        upd = tmap(lambda m, v: -lrf(step) * (m / bc1)
+                   / (jnp.sqrt(v / bc2) + eps), new_m, new_v)
+        return upd, St(new_m, new_v)
+    return Optimizer(init, update)
+
+
+def adamax(lr, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+           schedule=None) -> Optimizer:
+    """Adamax (reference: ``AdamaxParameterOptimizer``,
+    FirstOrderOptimizer.h:303)."""
+    lrf = _lr_fn(lr, schedule)
+
+    class St(NamedTuple):
+        m: PyTree
+        u: PyTree
+
+    def init(params):
+        z = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(z, z)
+
+    def update(grads, st, params, step):
+        t = step + 1
+        new_m = tmap(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+                     st.m, grads)
+        new_u = tmap(lambda u, g: jnp.maximum(b2 * u,
+                                              jnp.abs(g.astype(jnp.float32))),
+                     st.u, grads)
+        upd = tmap(lambda m, u: -lrf(step) / (1 - b1 ** t) * m / (u + eps),
+                   new_m, new_u)
+        return upd, St(new_m, new_u)
+    return Optimizer(init, update)
+
+
+def ftrl(lr, lambda1: float = 0.0, lambda2: float = 0.0, beta: float = 1.0,
+         schedule=None) -> Optimizer:
+    """FTRL-proximal (fluid ``ftrl_op.cc``) — the sparse-LR/CTR optimizer."""
+    lrf = _lr_fn(lr, schedule)
+
+    class St(NamedTuple):
+        n: PyTree
+        z: PyTree
+
+    def init(params):
+        zz = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(zz, zz)
+
+    def update(grads, st, params, step):
+        lr_t = lrf(step)
+
+        def upd_leaf(n, z, g, p):
+            g = g.astype(jnp.float32)
+            p = p.astype(jnp.float32)
+            new_n = n + g * g
+            sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr_t
+            new_z = z + g - sigma * p
+            new_p = jnp.where(
+                jnp.abs(new_z) <= lambda1,
+                0.0,
+                -(new_z - jnp.sign(new_z) * lambda1)
+                / ((beta + jnp.sqrt(new_n)) / lr_t + lambda2))
+            return new_p - p, new_n, new_z
+
+        triples = tmap(upd_leaf, st.n, st.z, grads, params)
+        upd = tmap(lambda t3: t3[0], triples,
+                   is_leaf=lambda x: isinstance(x, tuple))
+        new_n = tmap(lambda t3: t3[1], triples,
+                     is_leaf=lambda x: isinstance(x, tuple))
+        new_z = tmap(lambda t3: t3[2], triples,
+                     is_leaf=lambda x: isinstance(x, tuple))
+        return upd, St(new_n, new_z)
+    return Optimizer(init, update)
+
+
+def lamb(lr, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-6,
+         wd: float = 0.0, schedule=None) -> Optimizer:
+    """LAMB — layerwise-adaptive Adam for large-batch TPU training (beyond the
+    reference's set; standard for pod-scale data parallelism)."""
+    lrf = _lr_fn(lr, schedule)
+
+    class St(NamedTuple):
+        m: PyTree
+        v: PyTree
+
+    def init(params):
+        z = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(z, z)
+
+    def update(grads, st, params, step):
+        t = step + 1
+        new_m = tmap(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+                     st.m, grads)
+        new_v = tmap(lambda v, g: b2 * v + (1 - b2)
+                     * jnp.square(g.astype(jnp.float32)), st.v, grads)
+
+        def upd_leaf(m, v, p):
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            r = mhat / (jnp.sqrt(vhat) + eps) + wd * p.astype(jnp.float32)
+            p_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            r_norm = jnp.linalg.norm(r)
+            trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+            return -lrf(step) * trust * r
+
+        return tmap(upd_leaf, new_m, new_v, params), St(new_m, new_v)
+    return Optimizer(init, update)
+
+
+# -- composable wrappers (the reference's clipping/decay/averaging hooks) -----
+
+def chain(*transforms: Optimizer) -> Optimizer:
+    """Compose gradient transforms left-to-right (clip → decay → rule)."""
+
+    def init(params):
+        return tuple(t.init(params) for t in transforms)
+
+    def update(grads, states, params, step):
+        new_states = []
+        cur = grads
+        for t, st in zip(transforms, states):
+            cur, ns = t.update(cur, st, params, step)
+            new_states.append(ns)
+        return cur, tuple(new_states)
+    return Optimizer(init, update)
+
+
+def clip_by_global_norm(max_norm: float) -> Optimizer:
+    """Global-norm clip (reference: ``OptimizerWithGradientClipping``,
+    FirstOrderOptimizer.h:346 + ``error_clipping_threshold``)."""
+
+    def init(params):
+        return ()
+
+    def update(grads, state, params, step):
+        norm = global_norm(grads)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+        return tmap(lambda g: g * scale, grads), state
+    return Optimizer(init, update)
+
+
+def clip_by_value(limit: float) -> Optimizer:
+    def init(params):
+        return ()
+
+    def update(grads, state, params, step):
+        return tmap(lambda g: jnp.clip(g, -limit, limit), grads), state
+    return Optimizer(init, update)
+
+
+def weight_decay(decay: float) -> Optimizer:
+    """L2 decay added to gradients (reference: ``Regularizer.cpp`` L2, applied
+    pre-update; decoupled variants can chain after the rule instead)."""
+
+    def init(params):
+        return ()
+
+    def update(grads, state, params, step):
+        return tmap(lambda g, p: g + decay * p.astype(g.dtype), grads,
+                    params), state
+    return Optimizer(init, update)
+
+
+def l1_decay(decay: float) -> Optimizer:
+    """L1 subgradient decay (reference: ``Regularizer.cpp`` L1 with lazy
+    catch-up for sparse rows; dense form here)."""
+
+    def init(params):
+        return ()
+
+    def update(grads, state, params, step):
+        return tmap(lambda g, p: g + decay * jnp.sign(p.astype(g.dtype)),
+                    grads, params), state
+    return Optimizer(init, update)
+
+
+def polyak_average(decay: float = 0.999) -> "EMA":
+    return EMA(decay)
+
+
+@dataclasses.dataclass(frozen=True)
+class EMA:
+    """Polyak/EMA parameter averaging (reference: ``AverageOptimizer``,
+    ``parameter/AverageOptimizer.h`` — apply/restore around eval)."""
+    decay: float = 0.999
+
+    def init(self, params):
+        return tmap(lambda p: p.astype(jnp.float32), params)
+
+    def update(self, avg, params, step=None):
+        d = self.decay
+        return tmap(lambda a, p: d * a + (1 - d) * p.astype(jnp.float32),
+                    avg, params)

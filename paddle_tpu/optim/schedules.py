@@ -1,0 +1,85 @@
+"""Learning-rate schedules.
+
+Mirrors the reference's LR policy set
+(``/root/reference/paddle/parameter/LearningRateScheduler.cpp:50-172``): constant,
+poly, caffe_poly, exp, discexp, linear (+pass_manual via manual), plus modern
+warmup/cosine for the transformer-era models. A schedule is a pure
+``step -> multiplier`` function applied to the base LR (multiplied, matching the
+reference's ``calcLearningRate`` contract).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["constant", "poly", "caffe_poly", "exponential", "discexp", "linear",
+           "manual", "warmup_linear", "cosine_decay", "chain"]
+
+Schedule = Callable
+
+
+def constant():
+    return lambda step: 1.0
+
+
+def poly(a: float, b: float):
+    """lr * (1 + a*t)^(-b) (reference ``BaseLearningRateScheduler`` poly)."""
+    return lambda step: (1.0 + a * step) ** (-b)
+
+
+def caffe_poly(a: float, b: float, max_steps: int):
+    """lr * (1 - t/max)^b (reference caffe_poly)."""
+    return lambda step: (1.0 - jnp.minimum(step, max_steps) / max_steps) ** b
+
+
+def exponential(a: float, b: float):
+    """lr * a^(t/b) (reference exp)."""
+    return lambda step: a ** (step / b)
+
+
+def discexp(a: float, b: float):
+    """lr * a^floor(t/b) — discrete exponential (reference discexp)."""
+    return lambda step: a ** jnp.floor(step / b)
+
+
+def linear(a: float, b: float):
+    """max(lr - a*t, b) as a multiplier of lr=1 (reference linear)."""
+    return lambda step: jnp.maximum(1.0 - a * step, b)
+
+
+def manual(boundaries: Sequence[int], values: Sequence[float]):
+    """Piecewise-constant by step/sample count (reference manual &
+    pass_manual: ``LearningRateScheduler.cpp:107-150``)."""
+    bs = list(boundaries)
+    vs = list(values)
+    assert len(vs) == len(bs) + 1
+
+    def sched(step):
+        mult = jnp.asarray(vs[0])
+        for b, v in zip(bs, vs[1:]):
+            mult = jnp.where(step >= b, v, mult)
+        return mult
+    return sched
+
+
+def warmup_linear(warmup_steps: int):
+    return lambda step: jnp.minimum(1.0, (step + 1) / max(1, warmup_steps))
+
+
+def cosine_decay(decay_steps: int, alpha: float = 0.0):
+    def sched(step):
+        t = jnp.minimum(step, decay_steps) / decay_steps
+        return alpha + (1 - alpha) * 0.5 * (1 + jnp.cos(jnp.pi * t))
+    return sched
+
+
+def chain(*schedules: Schedule) -> Schedule:
+    """Multiply schedules (e.g. warmup * cosine)."""
+    def sched(step):
+        m = 1.0
+        for s in schedules:
+            m = m * s(step)
+        return m
+    return sched

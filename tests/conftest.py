@@ -4,8 +4,10 @@ tests (``/root/reference/paddle/gserver/tests/test_CompareSparse.cpp:64``)."""
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere. Force-override: the shell env
+# carries JAX_PLATFORMS=axon (the real TPU); tests must run on the virtual
+# 8-device CPU platform for determinism and sharding coverage.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -14,6 +16,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# sitecustomize.py (axon TPU plugin) imports jax at interpreter start, capturing
+# JAX_PLATFORMS=axon before this file runs — override via config as well.
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture

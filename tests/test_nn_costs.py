@@ -1,0 +1,116 @@
+"""Cost function tests vs numpy oracles (analog of the reference's
+CostLayer gradient tests in test_LayerGrad.cpp)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import costs
+
+
+def test_softmax_ce_matches_numpy(rng):
+    logits = jax.random.normal(rng, (6, 5))
+    labels = jnp.array([0, 1, 2, 3, 4, -1])
+    l = np.asarray(costs.softmax_cross_entropy(logits, labels))
+    ln = np.asarray(logits)
+    p = np.exp(ln - ln.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    for i in range(5):
+        np.testing.assert_allclose(l[i], -np.log(p[i, i]), rtol=1e-5)
+    assert l[5] == 0.0  # masked
+
+
+def test_ce_grad_is_softmax_minus_onehot(rng):
+    logits = jax.random.normal(rng, (4, 3))
+    labels = jnp.array([0, 1, 2, 0])
+    g = jax.grad(lambda z: costs.softmax_cross_entropy(z, labels).sum())(logits)
+    p = np.asarray(jax.nn.softmax(logits))
+    onehot = np.eye(3)[np.asarray(labels)]
+    np.testing.assert_allclose(np.asarray(g), p - onehot, atol=1e-5)
+
+
+def test_mse_and_smooth_l1():
+    o = jnp.array([[1.0, 2.0]])
+    t = jnp.array([[0.0, 0.0]])
+    np.testing.assert_allclose(np.asarray(costs.mse(o, t)), [2.5])
+    np.testing.assert_allclose(np.asarray(costs.smooth_l1(o, t)), [0.5 + 1.5])
+
+
+def test_rank_cost_symmetry():
+    l = jnp.array([[2.0]])
+    r = jnp.array([[1.0]])
+    c1 = float(costs.rank_cost(l, r, jnp.array([1.0]))[0])
+    c2 = float(costs.rank_cost(l, r, jnp.array([0.0]))[0])
+    assert c1 < c2  # correct order is cheaper
+
+
+def test_multi_binary_ce_matches_sigmoid_oracle(rng):
+    x = jax.random.normal(rng, (3, 4))
+    t = (jax.random.uniform(rng, (3, 4)) > 0.5).astype(jnp.float32)
+    got = np.asarray(costs.multi_binary_ce(x, t))
+    p = 1 / (1 + np.exp(-np.asarray(x)))
+    want = -(np.asarray(t) * np.log(p) + (1 - np.asarray(t)) * np.log(1 - p))
+    np.testing.assert_allclose(got, want.sum(-1), rtol=1e-4)
+
+
+def test_huber_classification_regions():
+    s = jnp.array([[2.0], [0.5], [-2.0]])
+    y = jnp.array([1.0, 1.0, 1.0])
+    l = np.asarray(costs.huber_classification(s, y))
+    assert l[0] == 0.0
+    np.testing.assert_allclose(l[1], 0.25)
+    np.testing.assert_allclose(l[2], 8.0)
+
+
+def test_hinge():
+    s = jnp.array([[0.5], [-0.5]])
+    l = np.asarray(costs.hinge(s, jnp.array([1.0, 1.0])))
+    np.testing.assert_allclose(l, [0.5, 1.5])
+
+
+def test_nce_decreases_for_true_class(rng):
+    # loss should be lower when hidden aligns with the true class embedding
+    V, D = 8, 4
+    w = jax.random.normal(rng, (V, D))
+    b = jnp.zeros((V,))
+    labels = jnp.array([2])
+    noise = jnp.array([[5, 6, 7]])
+    h_good = w[2][None, :] * 3
+    h_bad = -w[2][None, :] * 3
+    assert float(costs.nce_loss(h_good, labels, w, b, noise)[0]) < \
+        float(costs.nce_loss(h_bad, labels, w, b, noise)[0])
+
+
+def test_hsigmoid_codes_and_loss(rng):
+    C = 8
+    labels = jnp.array([0, 3, 7])
+    codes, signs = costs.build_hsigmoid_codes(labels, C)
+    assert codes.shape == (3, 3)
+    # all internal nodes in range
+    assert int(codes.max()) < C - 1 or int(codes.max()) < C
+    w = jax.random.normal(rng, (C, 4))
+    b = jnp.zeros((C,))
+    h = jax.random.normal(rng, (3, 4))
+    l = costs.hsigmoid_loss(h, labels, codes, signs, w, b)
+    assert l.shape == (3,)
+    assert (np.asarray(l) > 0).all()
+    # gradient flows
+    g = jax.grad(lambda hh: costs.hsigmoid_loss(hh, labels, codes, signs,
+                                                w, b).sum())(h)
+    assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_lambda_rank_prefers_correct_order():
+    r = jnp.array([[3.0, 2.0, 1.0, 0.0]])
+    good = jnp.array([[4.0, 3.0, 2.0, 1.0]])
+    bad = jnp.array([[1.0, 2.0, 3.0, 4.0]])
+    lg = float(costs.lambda_rank_ndcg(good, r)[0])
+    lb = float(costs.lambda_rank_ndcg(bad, r)[0])
+    assert lg < lb
+
+
+def test_reduce_masked():
+    x = jnp.array([1.0, 2.0, 3.0])
+    m = jnp.array([1.0, 1.0, 0.0])
+    assert float(costs.reduce(x, m)) == 1.5
+    assert float(costs.reduce(x, how="sum")) == 6.0

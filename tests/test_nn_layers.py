@@ -1,0 +1,198 @@
+"""Layer library tests: shape/oracle checks vs numpy + numeric gradient checks
+vs jax.grad (the analog of the reference's test_LayerGrad.cpp harness)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import activations
+
+
+def numeric_grad_check(mod, vs, *args, eps=1e-3, tol=2e-2):
+    """Perturb params, compare numeric vs autodiff grads of sum(out)."""
+    def loss(params):
+        return jnp.sum(mod.apply({"params": params, "state": vs.get("state", {})},
+                                 *args) ** 2)
+
+    g = jax.grad(loss)(vs["params"])
+    flat_p, tree = jax.tree_util.tree_flatten(vs["params"])
+    flat_g = jax.tree_util.tree_leaves(g)
+    for pi, (p, ag) in enumerate(zip(flat_p, flat_g)):
+        it = np.ndindex(*p.shape) if p.ndim else [()]
+        for idx in list(it)[:3]:  # spot-check first few entries
+            dp = np.zeros_like(np.asarray(p))
+            dp[idx] = eps
+            plus = jax.tree_util.tree_unflatten(
+                tree, [q + dp if i == pi else q for i, q in enumerate(flat_p)])
+            minus = jax.tree_util.tree_unflatten(
+                tree, [q - dp if i == pi else q for i, q in enumerate(flat_p)])
+            num = (loss(plus) - loss(minus)) / (2 * eps)
+            np.testing.assert_allclose(np.asarray(ag)[idx], num, rtol=tol,
+                                       atol=tol)
+
+
+def test_linear_matches_numpy(rng):
+    m = nn.Linear(7, act="tanh")
+    x = jax.random.normal(rng, (4, 5))
+    vs = m.init(rng, x)
+    w = np.asarray(vs["params"]["Linear_0"]["w"])
+    b = np.asarray(vs["params"]["Linear_0"]["b"])
+    want = np.tanh(np.asarray(x) @ w + b)
+    np.testing.assert_allclose(np.asarray(m.apply(vs, x)), want, atol=1e-5)
+    numeric_grad_check(m, vs, x)
+
+
+def test_embedding_oov_and_grad(rng):
+    m = nn.Embedding(10, 4)
+    ids = jnp.array([[0, 9, -1], [3, 3, 10]])
+    vs = m.init(rng, ids)
+    out = m.apply(vs, ids)
+    assert out.shape == (2, 3, 4)
+    np.testing.assert_array_equal(np.asarray(out[0, 2]), np.zeros(4))
+    np.testing.assert_array_equal(np.asarray(out[1, 2]), np.zeros(4))
+    np.testing.assert_allclose(out[1, 0], out[1, 1])
+
+
+def test_conv2d_matches_scipy(rng):
+    m = nn.Conv2D(3, kernel=3, padding="VALID")
+    x = jax.random.normal(rng, (2, 8, 8, 2))
+    vs = m.init(rng, x)
+    out = m.apply(vs, x)
+    assert out.shape == (2, 6, 6, 3)
+    # oracle: direct correlation
+    w = np.asarray(vs["params"]["Conv2D_0"]["w"])
+    b = np.asarray(vs["params"]["Conv2D_0"]["b"])
+    xn = np.asarray(x)
+    want = np.zeros((2, 6, 6, 3), np.float32)
+    for n in range(2):
+        for i in range(6):
+            for j in range(6):
+                patch = xn[n, i:i + 3, j:j + 3, :]
+                want[n, i, j] = np.tensordot(patch, w, axes=3) + b
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_grad(rng):
+    m = nn.Conv2D(2, kernel=2, padding="SAME")
+    x = jax.random.normal(rng, (1, 4, 4, 2))
+    vs = m.init(rng, x)
+    numeric_grad_check(m, vs, x)
+
+
+def test_pool(rng):
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    m = nn.Pool2D("max", 2)
+    vs = m.init(rng, x)
+    out = np.asarray(m.apply(vs, x))[0, :, :, 0]
+    np.testing.assert_array_equal(out, [[5, 7], [13, 15]])
+    a = nn.Pool2D("avg", 2)
+    out2 = np.asarray(a.apply(a.init(rng, x), x))[0, :, :, 0]
+    np.testing.assert_allclose(out2, [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_batchnorm_train_and_eval(rng):
+    m = nn.BatchNorm(momentum=0.5)
+    x = jax.random.normal(rng, (64, 3)) * 4.0 + 2.0
+    vs = m.init(rng, x, train=True)
+    out, new = m.apply(vs, x, train=True, mutable=("state",))
+    np.testing.assert_allclose(np.asarray(out).mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out).std(0), 1.0, atol=1e-2)
+    # eval mode uses running stats, no mutation needed
+    out_eval = m.apply({"params": vs["params"], "state": new["state"]}, x)
+    assert out_eval.shape == x.shape
+
+
+def test_layernorm(rng):
+    m = nn.LayerNorm()
+    x = jax.random.normal(rng, (5, 16)) * 3 + 1
+    vs = m.init(rng, x)
+    out = np.asarray(m.apply(vs, x))
+    np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+
+def test_dropout_modes(rng):
+    m = nn.Dropout(0.5)
+    x = jnp.ones((100, 100))
+    vs = m.init(rng, x)
+    out_eval = m.apply(vs, x)
+    np.testing.assert_array_equal(np.asarray(out_eval), np.asarray(x))
+    out_train = np.asarray(
+        m.apply(vs, x, train=True, rngs={"dropout": rng}))
+    frac = (out_train == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = out_train[out_train != 0]
+    np.testing.assert_allclose(kept, 2.0)
+
+
+def test_maxout():
+    m = nn.Maxout(2)
+    x = jnp.array([[1.0, 5.0, 2.0, 0.0]])
+    vs = m.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_array_equal(np.asarray(m.apply(vs, x)), [[5.0, 2.0]])
+
+
+def test_cos_sim(rng):
+    m = nn.CosSim(scale=5.0)
+    a = jax.random.normal(rng, (3, 8))
+    vs = m.init(rng, a, a)
+    np.testing.assert_allclose(np.asarray(m.apply(vs, a, a)), 5.0, rtol=1e-5)
+
+
+def test_context_projection():
+    m = nn.ContextProjection(context_len=3, context_start=-1)
+    x = jnp.arange(6.0).reshape(1, 3, 2)
+    vs = m.init(jax.random.PRNGKey(0), x)
+    out = np.asarray(m.apply(vs, x))
+    assert out.shape == (1, 3, 6)
+    # t=0: [zeros, x0, x1]
+    np.testing.assert_array_equal(out[0, 0], [0, 0, 0, 1, 2, 3])
+    # t=2: [x1, x2, zeros]
+    np.testing.assert_array_equal(out[0, 2], [2, 3, 4, 5, 0, 0])
+
+
+def test_mixed_layer(rng):
+    m = nn.MixedLayer([nn.FullMatrixProjection(6), nn.IdentityProjection()],
+                      act="relu")
+    a = jax.random.normal(rng, (2, 4))
+    b = jax.random.normal(rng, (2, 6))
+    vs = m.init(rng, a, b)
+    out = m.apply(vs, a, b)
+    assert out.shape == (2, 6)
+    numeric_grad_check(m, vs, a, b)
+
+
+def test_block_expand(rng):
+    m = nn.BlockExpand(block=2, stride=2)
+    x = jax.random.normal(rng, (1, 4, 4, 3))
+    vs = m.init(rng, x)
+    assert m.apply(vs, x).shape == (1, 4, 12)
+
+
+def test_multiplex():
+    m = nn.Multiplex()
+    a = jnp.zeros((3, 2))
+    b = jnp.ones((3, 2))
+    idx = jnp.array([0, 1, 0])
+    vs = m.init(jax.random.PRNGKey(0), idx, a, b)
+    out = np.asarray(m.apply(vs, idx, a, b))
+    np.testing.assert_array_equal(out[:, 0], [0, 1, 0])
+
+
+def test_activation_registry():
+    x = jnp.array([-2.0, 0.5, 30.0])
+    assert np.asarray(activations.get("brelu")(x)).tolist() == [0.0, 0.5, 24.0]
+    np.testing.assert_allclose(activations.get("stanh")(jnp.zeros(1)), 0.0)
+    np.testing.assert_allclose(
+        np.asarray(activations.get("softsign")(jnp.array([1.0]))), [0.5])
+    with pytest.raises(KeyError):
+        activations.get("nope")
+
+
+def test_sequence_softmax():
+    x = jnp.array([[1.0, 1.0, 1.0, 9.0]])
+    out = np.asarray(activations.sequence_softmax(x, lengths=jnp.array([3])))
+    np.testing.assert_allclose(out[0, :3], 1 / 3, rtol=1e-5)
+    assert out[0, 3] == 0

@@ -1,0 +1,144 @@
+"""Optimizer tests: convergence on a quadratic, oracle updates, schedules,
+composition — the analog of the reference's optimizer unit tests
+(paddle/parameter/tests, paddle/optimizer/parameter_optimizer_test.cc)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu import optim
+from paddle_tpu.optim import schedules as S
+
+
+def quad_loss(p):
+    return 0.5 * jnp.sum(p["w"] ** 2) + 0.5 * jnp.sum((p["b"] - 1.0) ** 2)
+
+
+PARAMS = {"w": jnp.array([2.0, -3.0]), "b": jnp.array([0.0])}
+
+
+@pytest.mark.parametrize("make", [
+    lambda: optim.sgd(0.1),
+    lambda: optim.momentum(0.05, 0.9),
+    lambda: optim.momentum(0.05, 0.9, nesterov=True),
+    lambda: optim.adagrad(0.5),
+    lambda: optim.decayed_adagrad(0.3),
+    lambda: optim.adadelta(rho=0.9, eps=1e-2, lr=1.0),
+    lambda: optim.rmsprop(0.05),
+    lambda: optim.rmsprop(0.05, momentum_coef=0.9, centered=False),
+    lambda: optim.adam(0.2),
+    lambda: optim.adamax(0.2),
+    lambda: optim.ftrl(0.5),
+    lambda: optim.lamb(0.05),
+])
+def test_converges_on_quadratic(make):
+    opt = make()
+    params = PARAMS
+    state = opt.init(params)
+    l0 = float(quad_loss(params))
+
+    @jax.jit
+    def step_fn(params, state, i):
+        g = jax.grad(quad_loss)(params)
+        upd, state = opt.update(g, state, params, i)
+        return optim.apply_updates(params, upd), state
+
+    for i in range(300):
+        params, state = step_fn(params, state, jnp.asarray(i))
+    assert float(quad_loss(params)) < 0.05 * l0, type(opt)
+
+
+def test_sgd_oracle():
+    opt = optim.sgd(0.1)
+    g = {"w": jnp.array([1.0, 2.0])}
+    p = {"w": jnp.array([1.0, 1.0])}
+    upd, _ = opt.update(g, opt.init(p), p, 0)
+    np.testing.assert_allclose(np.asarray(upd["w"]), [-0.1, -0.2], rtol=1e-6)
+
+
+def test_adam_oracle_first_step():
+    opt = optim.adam(0.1, b1=0.9, b2=0.999, eps=1e-8)
+    g = {"w": jnp.array([0.5])}
+    p = {"w": jnp.array([0.0])}
+    upd, st = opt.update(g, opt.init(p), p, 0)
+    # first step: mhat = g, vhat = g^2 -> update = -lr * g/(|g|+eps) ~ -lr*sign
+    np.testing.assert_allclose(np.asarray(upd["w"]), [-0.1], rtol=1e-4)
+
+
+def test_clipping_global_norm():
+    clip = optim.clip_by_global_norm(1.0)
+    g = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}  # norm 5
+    upd, _ = clip.update(g, clip.init(g), g, 0)
+    np.testing.assert_allclose(optim.global_norm(upd), 1.0, rtol=1e-5)
+    # under the limit: unchanged
+    g2 = {"a": jnp.array([0.3]), "b": jnp.array([0.4])}
+    upd2, _ = clip.update(g2, clip.init(g2), g2, 0)
+    np.testing.assert_allclose(np.asarray(upd2["a"]), [0.3], rtol=1e-6)
+
+
+def test_chain_clip_decay_rule():
+    opt = optim.chain(optim.clip_by_value(0.5), optim.weight_decay(0.1),
+                      optim.sgd(1.0))
+    p = {"w": jnp.array([2.0])}
+    g = {"w": jnp.array([10.0])}
+    st = opt.init(p)
+    upd, _ = opt.update(g, st, p, 0)
+    # clip to 0.5, add 0.1*2 decay, sgd lr 1 -> -(0.5+0.2)
+    np.testing.assert_allclose(np.asarray(upd["w"]), [-0.7], rtol=1e-6)
+
+
+def test_l1_decay_sign():
+    opt = optim.chain(optim.l1_decay(0.5), optim.sgd(1.0))
+    p = {"w": jnp.array([2.0, -2.0])}
+    g = {"w": jnp.zeros(2)}
+    upd, _ = opt.update(g, opt.init(p), p, 0)
+    np.testing.assert_allclose(np.asarray(upd["w"]), [-0.5, 0.5], rtol=1e-6)
+
+
+def test_schedules():
+    assert float(S.constant()(100)) == 1.0
+    np.testing.assert_allclose(float(S.poly(1.0, 1.0)(1)), 0.5)
+    np.testing.assert_allclose(float(S.exponential(0.5, 10)(10)), 0.5)
+    np.testing.assert_allclose(float(S.discexp(0.5, 10)(19)), 0.5)
+    np.testing.assert_allclose(float(S.discexp(0.5, 10)(20)), 0.25)
+    np.testing.assert_allclose(float(S.linear(0.1, 0.2)(5)), 0.5)
+    np.testing.assert_allclose(float(S.linear(0.1, 0.2)(100)), 0.2)
+    m = S.manual([10, 20], [1.0, 0.5, 0.1])
+    np.testing.assert_allclose([float(m(5)), float(m(15)), float(m(25))],
+                               [1.0, 0.5, 0.1], rtol=1e-6)
+    np.testing.assert_allclose(float(S.warmup_linear(10)(4)), 0.5)
+    np.testing.assert_allclose(float(S.cosine_decay(100)(100)), 0.0, atol=1e-6)
+    c = S.chain(S.warmup_linear(10), S.constant())
+    np.testing.assert_allclose(float(c(4)), 0.5)
+
+
+def test_lr_schedule_in_optimizer():
+    opt = optim.sgd(1.0, schedule=S.manual([5], [1.0, 0.1]))
+    g = {"w": jnp.array([1.0])}
+    p = {"w": jnp.array([0.0])}
+    upd0, _ = opt.update(g, (), p, 0)
+    upd9, _ = opt.update(g, (), p, 9)
+    np.testing.assert_allclose(np.asarray(upd0["w"]), [-1.0])
+    np.testing.assert_allclose(np.asarray(upd9["w"]), [-0.1], rtol=1e-5)
+
+
+def test_ema_average():
+    ema = optim.polyak_average(0.5)
+    p = {"w": jnp.array([0.0])}
+    avg = ema.init(p)
+    avg = ema.update(avg, {"w": jnp.array([2.0])})
+    np.testing.assert_allclose(np.asarray(avg["w"]), [1.0])
+
+
+def test_optimizer_state_is_pytree():
+    opt = optim.adam(0.1)
+    p = {"w": jnp.zeros((3, 3))}
+    st = opt.init(p)
+    leaves = jax.tree_util.tree_leaves(st)
+    assert all(l.shape == (3, 3) for l in leaves)
+    # jit-compatible
+    @jax.jit
+    def f(st):
+        return jax.tree_util.tree_map(lambda x: x + 1, st)
+    f(st)
