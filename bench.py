@@ -1,0 +1,68 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Mirrors the reference's benchmark protocol (``/root/reference/benchmark/
+paddle/image/run.sh``: fixed batch size, warmup, timed batches, img/s). Current
+flagship metric: MNIST-LeNet training images/sec on one chip (placeholder until
+the ResNet-50 milestone lands; baseline anchor is the reference's ResNet-50
+CPU number in BASELINE.md until then).
+"""
+
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def bench_lenet(batch_size=128, warmup=5, iters=30):
+    import paddle_tpu as pt
+    from paddle_tpu import optim
+    from paddle_tpu.models import LeNet
+    from paddle_tpu.nn import costs
+    from paddle_tpu.train import Trainer
+
+    trainer = Trainer(
+        model=LeNet(),
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
+        optimizer=optim.momentum(0.01, 0.9))
+    rng = np.random.RandomState(0)
+    batch = {
+        "x": rng.normal(size=(batch_size, 28, 28, 1)).astype(np.float32),
+        "label": rng.randint(0, 10, size=batch_size).astype(np.int32),
+    }
+    trainer.init(jax.random.PRNGKey(0), batch)
+    trainer._build_train_step()
+    ts = trainer.train_state
+    sharded = trainer._shard(batch)
+    key = jax.random.PRNGKey(1)
+    params, state, opt_state, step = ts.params, ts.state, ts.opt_state, ts.step
+    for _ in range(warmup):
+        params, state, opt_state, step, loss, stats = trainer._train_step(
+            params, state, opt_state, step, sharded, key)
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, state, opt_state, step, loss, stats = trainer._train_step(
+            params, state, opt_state, step, sharded, key)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
+def main():
+    img_s = bench_lenet()
+    # Anchor: no in-tree MNIST-LeNet throughput number exists in the reference;
+    # vs_baseline compares against the reference's strongest CPU ResNet-50
+    # figure (82.35 img/s, BASELINE.md) only as a sanity scale until the
+    # ResNet-50 benchmark replaces this metric.
+    print(json.dumps({
+        "metric": "mnist_lenet_train_images_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / 82.35, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

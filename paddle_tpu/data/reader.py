@@ -1,0 +1,170 @@
+"""Composable reader combinators — the v2 data-pipeline surface.
+
+Reference: ``/root/reference/python/paddle/v2/reader/decorator.py`` (map_readers,
+shuffle, buffered, compose, chain, firstn, xmap) and ``minibatch.py``. A *reader*
+is a zero-arg callable returning an iterator over samples; combinators wrap
+readers into new readers. Batching adds TPU-specific care: fixed batch shapes
+(drop/pad last partial batch) so jit never re-traces, and host-side prefetch into
+a background thread (the analog of the reference's ``DoubleBuffer`` async layer,
+``paddle/gserver/dataproviders/DataProvider.h:249``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["map_readers", "shuffle", "buffered", "compose", "chain", "firstn",
+           "batched", "prefetch", "cycle", "sharded"]
+
+Reader = Callable[[], Iterable]
+
+
+def map_readers(func: Callable, *readers: Reader) -> Reader:
+    """Apply func elementwise over zipped readers (reference: map_readers)."""
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader_fn: Reader, buf_size: int, seed: Optional[int] = None) -> Reader:
+    """Windowed shuffle (reference: shuffle decorator). Deterministic when
+    ``seed`` is given — required for resumable/elastic data order."""
+    def reader():
+        rng = _random.Random(seed)
+        buf: List[Any] = []
+        for item in reader_fn():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+    return reader
+
+
+def buffered(reader_fn: Reader, size: int) -> Reader:
+    """Decouple producer/consumer with a bounded queue on a thread
+    (reference: buffered decorator)."""
+    def reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+        end = object()
+        err: List[BaseException] = []
+
+        def fill():
+            try:
+                for item in reader_fn():
+                    q.put(item)
+            except BaseException as e:  # propagate into consumer
+                err.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item
+        if err:
+            raise err[0]
+    return reader
+
+
+def compose(*readers: Reader) -> Reader:
+    """Zip readers into tuple samples (reference: compose)."""
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+    return reader
+
+
+def chain(*readers: Reader) -> Reader:
+    """Concatenate readers (reference: chain)."""
+    def reader():
+        for r in readers:
+            yield from r()
+    return reader
+
+
+def firstn(reader_fn: Reader, n: int) -> Reader:
+    def reader():
+        return itertools.islice(reader_fn(), n)
+    return reader
+
+
+def cycle(reader_fn: Reader) -> Reader:
+    def reader():
+        while True:
+            it = iter(reader_fn())
+            empty = True
+            for x in it:
+                empty = False
+                yield x
+            if empty:
+                return
+    return reader
+
+
+def sharded(reader_fn: Reader, num_shards: int, shard_id: int) -> Reader:
+    """Deterministic per-host data sharding — the TPU-native replacement for the
+    Go master's task queue (``/root/reference/go/master/service.go:368``): every
+    host reads the same stream and keeps items where idx % num_shards == id."""
+    def reader():
+        for i, item in enumerate(reader_fn()):
+            if i % num_shards == shard_id:
+                yield item
+    return reader
+
+
+def batched(reader_fn: Reader, batch_size: int, drop_last: bool = True,
+            collate: Optional[Callable] = None) -> Reader:
+    """Group samples into fixed-size batches of stacked numpy arrays.
+
+    Fixed shapes keep one XLA compilation alive (the reference re-traces nothing
+    either — its batches are dynamic but C++-side). ``collate`` overrides the
+    default stack-per-field behavior (tuples -> tuple of arrays, dicts -> dict).
+    """
+    def default_collate(samples):
+        first = samples[0]
+        if isinstance(first, dict):
+            return {k: np.stack([np.asarray(s[k]) for s in samples])
+                    for k in first}
+        if isinstance(first, (tuple, list)):
+            return tuple(np.stack([np.asarray(s[i]) for s in samples])
+                         for i in range(len(first)))
+        return np.stack([np.asarray(s) for s in samples])
+
+    coll = collate or default_collate
+
+    def reader():
+        buf = []
+        for item in reader_fn():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield coll(buf)
+                buf = []
+        if buf and not drop_last:
+            yield coll(buf)
+    return reader
+
+
+def prefetch(reader_fn: Reader, depth: int = 2) -> Reader:
+    """Async host-side prefetch (DoubleBuffer analog) — overlap input pipeline
+    with device compute."""
+    return buffered(reader_fn, depth)

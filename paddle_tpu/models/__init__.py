@@ -1,0 +1,4 @@
+"""Model zoo — the acceptance workloads from BASELINE.json (MNIST LeNet,
+ResNet, seq2seq attention NMT, sequence tagging, CTR) built on paddle_tpu.nn."""
+
+from .mnist import LeNet, MnistMLP

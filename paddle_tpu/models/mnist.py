@@ -1,0 +1,53 @@
+"""MNIST models — the reference's first demo family.
+
+Reference: ``/root/reference/v1_api_demo/mnist/light_mnist.py`` (LeNet-style
+conv-pool×2 + fc) and ``mnist/vgg_16_mnist.py``; the fluid analogs are
+``fluid/tests/book/test_recognize_digits_{mlp,conv}.py``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.module import Module
+from .. import nn
+
+__all__ = ["LeNet", "MnistMLP"]
+
+
+class LeNet(Module):
+    """conv(20,5)-pool2-conv(50,5)-pool2-fc(500)-fc(10), the light_mnist
+    topology (``v1_api_demo/mnist/light_mnist.py`` conv_pool groups)."""
+
+    def __init__(self, num_classes: int = 10, use_batchnorm: bool = False):
+        super().__init__()
+        self.c1 = nn.Conv2D(20, 5, act="relu", padding="VALID")
+        self.p1 = nn.Pool2D("max", 2)
+        self.c2 = nn.Conv2D(50, 5, act="relu", padding="VALID")
+        self.p2 = nn.Pool2D("max", 2)
+        self.bn = nn.BatchNorm() if use_batchnorm else None
+        self.fc1 = nn.Linear(500, act="relu")
+        self.fc2 = nn.Linear(num_classes)
+
+    def forward(self, x, train: bool = False):
+        h = self.p1(self.c1(x))
+        h = self.p2(self.c2(h))
+        if self.bn is not None:
+            h = self.bn(h, train=train)
+        h = h.reshape(h.shape[0], -1)
+        return self.fc2(self.fc1(h))
+
+
+class MnistMLP(Module):
+    """128-64-10 MLP (``fluid/tests/book/test_recognize_digits_mlp.py``)."""
+
+    def __init__(self, num_classes: int = 10, hidden=(128, 64)):
+        super().__init__()
+        self.fcs = [nn.Linear(h, act="relu") for h in hidden]
+        self.out = nn.Linear(num_classes)
+
+    def forward(self, x, train: bool = False):
+        h = x.reshape(x.shape[0], -1)
+        for fc in self.fcs:
+            h = fc(h)
+        return self.out(h)

@@ -27,6 +27,11 @@ def _weight(loss, weight):
     return loss if weight is None else loss * weight
 
 
+def _softplus(x):
+    """Stable log(1+exp(x)) (jax.nn.softplus) in float32."""
+    return jax.nn.softplus(x.astype(jnp.float32))
+
+
 def reduce(per_example, mask=None, how: str = "mean"):
     """Masked reduction to a scalar; use inside train steps."""
     x = per_example.astype(jnp.float32)
@@ -72,7 +77,7 @@ def multi_binary_ce(logits, targets, weight=None):
     """Multi-label binary CE from logits (reference:
     ``MultiBinaryLabelCrossEntropy``, CostLayer.cpp)."""
     x = logits.astype(jnp.float32)
-    l = jnp.maximum(x, 0) - x * targets + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    l = _softplus(x) - x * targets
     return _weight(l.sum(-1), weight)
 
 
@@ -115,7 +120,7 @@ def rank_cost(left, right, label, weight=None):
     """Pairwise rank cost (RankNet; reference: ``RankingCost``,
     CostLayer.cpp): -o*t + log(1+exp(o)), o = left-right, t in [0,1]."""
     o = (left - right).astype(jnp.float32)[..., 0]
-    l = jnp.log1p(jnp.exp(-jnp.abs(o))) + jnp.maximum(o, 0) - o * label
+    l = _softplus(o) - o * label
     return _weight(l, weight)
 
 
@@ -158,14 +163,15 @@ def sum_cost(output, weight=None):
     return _weight(output.astype(jnp.float32).sum(-1), weight)
 
 
-def nce_loss(hidden, labels, table_w, table_b, noise_ids, noise_logprob=None,
-             num_classes: Optional[int] = None):
+def nce_loss(hidden, labels, table_w, table_b, noise_ids, noise_logprob=None):
     """Noise-contrastive estimation (reference: ``NCELayer.cpp``) — binary
     logistic on the true class vs K sampled noise classes.
 
     hidden: [B, D]; labels: [B]; table_w: [V, D]; table_b: [V];
-    noise_ids: [B, K] pre-sampled noise class ids.
-    """
+    noise_ids: [B, K] pre-sampled noise class ids. ``noise_logprob`` is
+    log(k·q(class)) per vocabulary entry, [V]; when given, logits are corrected
+    by subtracting it (the consistency correction matching the reference's
+    sampling-weighted multinomial in NCELayer)."""
     h = hidden.astype(jnp.float32)
     pos_w = jnp.take(table_w, labels, axis=0)          # [B, D]
     pos_b = jnp.take(table_b, labels)
@@ -173,12 +179,11 @@ def nce_loss(hidden, labels, table_w, table_b, noise_ids, noise_logprob=None,
     neg_w = jnp.take(table_w, noise_ids, axis=0)       # [B, K, D]
     neg_b = jnp.take(table_b, noise_ids)
     neg_logit = jnp.einsum("bd,bkd->bk", h, neg_w) + neg_b
-
-    def softplus(x):  # stable log(1+exp(x))
-        return jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(x, 0.0)
-
-    pos_l = softplus(-pos_logit)
-    neg_l = softplus(neg_logit).sum(-1)
+    if noise_logprob is not None:
+        pos_logit = pos_logit - jnp.take(noise_logprob, labels)
+        neg_logit = neg_logit - jnp.take(noise_logprob, noise_ids)
+    pos_l = _softplus(-pos_logit)
+    neg_l = _softplus(neg_logit).sum(-1)
     return pos_l + neg_l
 
 
@@ -196,8 +201,7 @@ def hsigmoid_loss(hidden, labels, codes, signs, node_w, node_b):
     w = jnp.take(node_w, safe, axis=0)                 # [B, L, D]
     b = jnp.take(node_b, safe)
     logit = jnp.einsum("bd,bld->bl", h, w) + b
-    z = signs * logit
-    l = jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(-z, 0.0)
+    l = _softplus(-signs * logit)
     return (l * (codes >= 0)).sum(-1)
 
 

@@ -45,6 +45,20 @@ def _pair(v: Pair) -> Tuple[int, int]:
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
+def _conv_padding(padding):
+    """Normalize padding: "SAME"/"VALID", int p, (pad_h, pad_w), or explicit
+    [(lo,hi),(lo,hi)] — matching the kernel/stride (h, w) convention."""
+    if isinstance(padding, str):
+        return padding
+    if isinstance(padding, int):
+        return [(padding, padding), (padding, padding)]
+    padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        return [tuple(p) for p in padding]
+    ph, pw = padding
+    return [(ph, ph), (pw, pw)]
+
+
 class Linear(Module):
     """Fully-connected layer (reference: ``FullyConnectedLayer``,
     ``gserver/layers/FullyConnectedLayer.cpp``; fluid ``mul_op`` + bias)."""
@@ -118,10 +132,7 @@ class Conv2D(Module):
         self.features = features
         self.kernel = _pair(kernel)
         self.stride = _pair(stride)
-        self.padding = padding if isinstance(padding, str) else \
-            [_pair(p) for p in (padding if isinstance(padding, (list, tuple))
-                                and isinstance(padding[0], (list, tuple))
-                                else [padding, padding])]
+        self.padding = _conv_padding(padding)
         self.dilation = _pair(dilation)
         self.groups = groups
         self.act = activations.get(act)
@@ -182,7 +193,7 @@ class Conv2DTranspose(Module):
         self.features = features
         self.kernel = _pair(kernel)
         self.stride = _pair(stride)
-        self.padding = padding
+        self.padding = _conv_padding(padding)
         self.act = activations.get(act)
         self.use_bias = use_bias
         self.w_init = w_init
@@ -234,6 +245,7 @@ class GlobalPool(Module):
 
     def __init__(self, kind: str = "avg", name=None):
         super().__init__(name=name)
+        assert kind in ("max", "avg"), kind
         self.kind = kind
 
     def forward(self, x):

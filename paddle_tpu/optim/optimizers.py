@@ -58,9 +58,12 @@ def global_norm(tree) -> jax.Array:
 
 
 def _lr_fn(lr, schedule):
-    sched = schedule or S.constant()
     if callable(lr):
+        if schedule is not None:
+            raise ValueError(
+                "pass either a callable lr or (base lr + schedule), not both")
         return lambda step: lr(step)
+    sched = schedule or S.constant()
     return lambda step: lr * sched(step)
 
 
@@ -145,8 +148,8 @@ def adadelta(rho: float = 0.95, eps: float = 1e-6, lr: float = 1.0,
         accum_update: PyTree
 
     def init(params):
-        z = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
-        return St(z, z)
+        zeros = lambda: tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(zeros(), zeros())  # distinct buffers: donation-safe
 
     def update(grads, st, params, step):
         new_a = tmap(lambda a, g: rho * a + (1 - rho)
@@ -173,8 +176,8 @@ def rmsprop(lr, rho: float = 0.95, eps: float = 1e-6, momentum_coef: float = 0.0
         mom: PyTree
 
     def init(params):
-        z = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
-        return St(z, z, z)
+        zeros = lambda: tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(zeros(), zeros(), zeros())
 
     def update(grads, st, params, step):
         g32 = tmap(lambda g: g.astype(jnp.float32), grads)
@@ -206,8 +209,8 @@ def adam(lr, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
         v: PyTree
 
     def init(params):
-        z = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
-        return St(z, z)
+        zeros = lambda: tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(zeros(), zeros())
 
     def update(grads, st, params, step):
         t = step + 1
@@ -234,8 +237,8 @@ def adamax(lr, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
         u: PyTree
 
     def init(params):
-        z = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
-        return St(z, z)
+        zeros = lambda: tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(zeros(), zeros())
 
     def update(grads, st, params, step):
         t = step + 1
@@ -260,8 +263,8 @@ def ftrl(lr, lambda1: float = 0.0, lambda2: float = 0.0, beta: float = 1.0,
         z: PyTree
 
     def init(params):
-        zz = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
-        return St(zz, zz)
+        zeros = lambda: tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(zeros(), zeros())
 
     def update(grads, st, params, step):
         lr_t = lrf(step)
@@ -280,12 +283,9 @@ def ftrl(lr, lambda1: float = 0.0, lambda2: float = 0.0, beta: float = 1.0,
             return new_p - p, new_n, new_z
 
         triples = tmap(upd_leaf, st.n, st.z, grads, params)
-        upd = tmap(lambda t3: t3[0], triples,
-                   is_leaf=lambda x: isinstance(x, tuple))
-        new_n = tmap(lambda t3: t3[1], triples,
-                     is_leaf=lambda x: isinstance(x, tuple))
-        new_z = tmap(lambda t3: t3[2], triples,
-                     is_leaf=lambda x: isinstance(x, tuple))
+        outer = jax.tree_util.tree_structure(grads)
+        inner = jax.tree_util.tree_structure((0, 0, 0))
+        upd, new_n, new_z = jax.tree_util.tree_transpose(outer, inner, triples)
         return upd, St(new_n, new_z)
     return Optimizer(init, update)
 
@@ -301,8 +301,8 @@ def lamb(lr, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-6,
         v: PyTree
 
     def init(params):
-        z = tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
-        return St(z, z)
+        zeros = lambda: tmap(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return St(zeros(), zeros())
 
     def update(grads, st, params, step):
         t = step + 1

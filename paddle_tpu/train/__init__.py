@@ -1,0 +1,7 @@
+"""Training driver, events, evaluators, checkpointing (successor of
+paddle/trainer, v2 SGD event loop, gserver evaluators, ParamUtil checkpoints)."""
+
+from . import checkpoint, events, evaluators
+from .evaluators import (Auc, ChunkEvaluator, ClassificationError, Evaluator,
+                         EvaluatorSet, PrecisionRecall)
+from .trainer import Trainer, TrainState

@@ -1,0 +1,225 @@
+"""Evaluators — streaming metrics over batches.
+
+Reference: ``/root/reference/paddle/gserver/evaluators/Evaluator.cpp`` registry
+(classification_error, precision_recall, pnpair, rankauc, chunk, ctc_edit_
+distance, detection_map, sum/column_sum + printers). Design: each evaluator has
+a jit-safe ``batch(outputs, batch) -> dict[str, array]`` piece producing small
+sufficient statistics on device, and host-side ``update``/``result`` that
+accumulate across batches — so metrics ride inside the compiled train step and
+only scalars cross to host (no HBM round-trips of activations).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["Evaluator", "ClassificationError", "PrecisionRecall", "Auc",
+           "ChunkEvaluator", "EvaluatorSet"]
+
+
+class Evaluator:
+    name = "evaluator"
+
+    def batch_stats(self, outputs, batch) -> Dict[str, Any]:
+        """Device-side sufficient statistics (runs under jit)."""
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, stats: Dict[str, np.ndarray]):
+        raise NotImplementedError
+
+    def result(self) -> Dict[str, float]:
+        raise NotImplementedError
+
+
+class ClassificationError(Evaluator):
+    """top-1 error (reference: ``ClassificationErrorEvaluator``)."""
+
+    def __init__(self, name="classification_error"):
+        self.name = name
+        self.reset()
+
+    def batch_stats(self, outputs, batch):
+        labels = batch["label"]
+        pred = jnp.argmax(outputs, axis=-1)
+        valid = (labels >= 0)
+        wrong = jnp.sum((pred != labels) & valid)
+        return {"wrong": wrong, "total": jnp.sum(valid)}
+
+    def reset(self):
+        self._wrong = 0
+        self._total = 0
+
+    def update(self, stats):
+        self._wrong += int(stats["wrong"])
+        self._total += int(stats["total"])
+
+    def result(self):
+        err = self._wrong / max(1, self._total)
+        return {self.name: err, "accuracy": 1.0 - err}
+
+
+class PrecisionRecall(Evaluator):
+    """Binary/micro-averaged precision-recall-F1 (reference:
+    ``PrecisionRecallEvaluator``)."""
+
+    def __init__(self, threshold: float = 0.5, name="precision_recall"):
+        self.name = name
+        self.threshold = threshold
+        self.reset()
+
+    def batch_stats(self, outputs, batch):
+        labels = batch["label"]
+        if outputs.ndim > 1 and outputs.shape[-1] == 2:
+            pred = jnp.argmax(outputs, -1)
+        else:
+            score = outputs[..., 0] if outputs.ndim > 1 else outputs
+            pred = (score > self.threshold).astype(jnp.int32)
+        labels = labels.astype(jnp.int32)
+        tp = jnp.sum((pred == 1) & (labels == 1))
+        fp = jnp.sum((pred == 1) & (labels == 0))
+        fn = jnp.sum((pred == 0) & (labels == 1))
+        return {"tp": tp, "fp": fp, "fn": fn}
+
+    def reset(self):
+        self._tp = self._fp = self._fn = 0
+
+    def update(self, stats):
+        self._tp += int(stats["tp"])
+        self._fp += int(stats["fp"])
+        self._fn += int(stats["fn"])
+
+    def result(self):
+        p = self._tp / max(1, self._tp + self._fp)
+        r = self._tp / max(1, self._tp + self._fn)
+        f1 = 2 * p * r / max(1e-9, p + r)
+        return {"precision": p, "recall": r, "f1": f1}
+
+
+class Auc(Evaluator):
+    """ROC AUC via fixed-bin histogram (reference: ``AucEvaluator`` — same
+    binned approach, Evaluator.cpp)."""
+
+    def __init__(self, num_bins: int = 1024, name="auc"):
+        self.name = name
+        self.num_bins = num_bins
+        self.reset()
+
+    def batch_stats(self, outputs, batch):
+        labels = batch["label"].astype(jnp.int32)
+        if outputs.ndim > 1 and outputs.shape[-1] == 2:
+            import jax
+            score = jax.nn.softmax(outputs, -1)[..., 1]
+        else:
+            score = outputs[..., 0] if outputs.ndim > 1 else outputs
+        idx = jnp.clip((score * self.num_bins).astype(jnp.int32), 0,
+                       self.num_bins - 1)
+        pos = jnp.zeros(self.num_bins).at[idx].add(labels == 1)
+        neg = jnp.zeros(self.num_bins).at[idx].add(labels == 0)
+        return {"pos": pos, "neg": neg}
+
+    def reset(self):
+        self._pos = np.zeros(self.num_bins)
+        self._neg = np.zeros(self.num_bins)
+
+    def update(self, stats):
+        self._pos += np.asarray(stats["pos"])
+        self._neg += np.asarray(stats["neg"])
+
+    def result(self):
+        # integrate from the highest bin down
+        tp = np.cumsum(self._pos[::-1])
+        fp = np.cumsum(self._neg[::-1])
+        tot_p, tot_n = tp[-1], fp[-1]
+        if tot_p == 0 or tot_n == 0:
+            return {self.name: 0.5}
+        tpr = np.concatenate([[0.0], tp / tot_p])
+        fpr = np.concatenate([[0.0], fp / tot_n])
+        auc = float(np.trapezoid(tpr, fpr))
+        return {self.name: auc}
+
+
+class ChunkEvaluator(Evaluator):
+    """Chunk (NER span) F1 with IOB labeling (reference:
+    ``ChunkEvaluator.cpp:294`` — plain-IOB scheme). Host-side extraction from
+    predicted/gold tag sequences; stats stay device-friendly (tag arrays)."""
+
+    def __init__(self, num_tag_types: int, scheme: str = "IOB",
+                 name="chunk"):
+        assert scheme == "IOB"
+        self.name = name
+        self.num_tag_types = num_tag_types
+        self.reset()
+
+    def batch_stats(self, outputs, batch):
+        # outputs: predicted tags [B, T] (already decoded); pass through
+        return {"pred": outputs, "gold": batch["label"],
+                "length": batch["length"]}
+
+    def _chunks(self, tags, length):
+        """Extract (start, end, type) spans. Encoding (the reference's
+        ``plain`` IOB scheme): B-k = 2k, I-k = 2k+1, O = 2*num_tag_types."""
+        o_tag = 2 * self.num_tag_types
+        out = set()
+        start, typ = None, None
+        for t in range(length):
+            tag = int(tags[t])
+            if start is not None and tag != 2 * typ + 1:
+                out.add((start, t - 1, typ))   # current span ends
+                start, typ = None, None
+            if tag < o_tag and tag % 2 == 0:   # B- tag opens a span
+                start, typ = t, tag // 2
+        if start is not None:
+            out.add((start, length - 1, typ))
+        return out
+
+    def reset(self):
+        self._correct = self._pred = self._gold = 0
+
+    def update(self, stats):
+        pred = np.asarray(stats["pred"])
+        gold = np.asarray(stats["gold"])
+        lengths = np.asarray(stats["length"])
+        for b in range(pred.shape[0]):
+            L = int(lengths[b])
+            pc = set(self._chunks(pred[b], L))
+            gc = set(self._chunks(gold[b], L))
+            self._correct += len(pc & gc)
+            self._pred += len(pc)
+            self._gold += len(gc)
+
+    def result(self):
+        p = self._correct / max(1, self._pred)
+        r = self._correct / max(1, self._gold)
+        f1 = 2 * p * r / max(1e-9, p + r)
+        return {"chunk_precision": p, "chunk_recall": r, "chunk_f1": f1}
+
+
+class EvaluatorSet:
+    """Bundle of evaluators sharing one device round-trip per batch."""
+
+    def __init__(self, *evaluators: Evaluator):
+        self.evaluators = list(evaluators)
+
+    def batch_stats(self, outputs, batch):
+        return {ev.name: ev.batch_stats(outputs, batch)
+                for ev in self.evaluators}
+
+    def reset(self):
+        for ev in self.evaluators:
+            ev.reset()
+
+    def update(self, stats):
+        for ev in self.evaluators:
+            ev.update(stats[ev.name])
+
+    def result(self):
+        out = {}
+        for ev in self.evaluators:
+            out.update(ev.result())
+        return out

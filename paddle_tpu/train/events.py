@@ -1,0 +1,47 @@
+"""Training events — the v2 event-callback surface.
+
+Reference: ``/root/reference/python/paddle/v2/event.py`` (BeginPass/EndPass/
+BeginIteration/EndIteration with cost + evaluator results) consumed by the
+``SGD.train`` loop (``v2/trainer.py:169-194``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+__all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration",
+           "TestResult"]
+
+
+@dataclasses.dataclass
+class BeginPass:
+    pass_id: int
+
+
+@dataclasses.dataclass
+class EndPass:
+    pass_id: int
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class BeginIteration:
+    pass_id: int
+    batch_id: int
+
+
+@dataclasses.dataclass
+class EndIteration:
+    pass_id: int
+    batch_id: int
+    step: int
+    cost: float
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TestResult:
+    pass_id: int
+    cost: float
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)

@@ -1,0 +1,277 @@
+"""The training driver — successor of paddle/trainer + the v2 SGD event loop.
+
+Reference call stack (SURVEY.md §3.1/§3.2): ``Trainer::train`` → ``trainOnePass``
+→ ``TrainerInternal::trainOneBatch`` (forwardBackward; updater; evaluators;
+events), with data-parallelism delegated to ``MultiGradientMachine`` threads and
+remote updaters talking to parameter servers.
+
+TPU-native design: ONE jit-compiled ``train_step`` closed over model+optimizer,
+executed over a device mesh. Data parallelism is a sharding annotation, not a
+thread pool: the batch arrives sharded over the ``data`` axis, parameters are
+replicated, and XLA inserts the gradient all-reduce (the entire pserver tier of
+the reference collapses into this). Evaluator statistics ride in the same
+compiled step. The host loop only feeds data, fires events, logs, and
+checkpoints — mirroring the v2 ``SGD.train`` surface
+(``python/paddle/v2/trainer.py:124``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import mesh as mesh_lib
+from ..core.module import Module
+from ..optim.optimizers import Optimizer, apply_updates
+from ..utils.stats import StatSet
+from . import checkpoint as ckpt_lib
+from . import events as ev
+
+__all__ = ["Trainer", "TrainState"]
+
+
+class TrainState:
+    """The complete training pytree: params, module state, optimizer state, step."""
+
+    def __init__(self, params, state, opt_state, step):
+        self.params = params
+        self.state = state
+        self.opt_state = opt_state
+        self.step = step
+
+    def as_dict(self):
+        return {"params": self.params, "state": self.state,
+                "opt_state": self.opt_state,
+                "step": self.step}
+
+
+class Trainer:
+    """Single-controller training driver.
+
+    Args:
+      model: the Module.
+      loss_fn: ``(outputs, batch) -> per-example losses`` (reduced by mean,
+        masked by ``batch['weight']`` if present).
+      optimizer: an ``optim.Optimizer``.
+      mesh: device mesh; defaults to all devices on the ``data`` axis.
+      forward: optional ``(model, variables, batch, train, rngs) -> (out, new_state)``
+        override for models with non-standard inputs (default feeds
+        ``batch['x']``).
+      evaluator: optional EvaluatorSet/Evaluator whose stats are computed
+        inside the compiled step.
+      param_sharding: optional pytree of PartitionSpecs for model parallelism;
+        default fully replicated.
+    """
+
+    def __init__(self, model: Module, loss_fn: Callable, optimizer: Optimizer,
+                 mesh=None, forward: Optional[Callable] = None,
+                 evaluator=None, param_sharding=None, donate: bool = True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh or mesh_lib.default_mesh()
+        self.evaluator = evaluator
+        self.stats = StatSet("trainer")
+        self._forward = forward or self._default_forward
+        self._param_sharding = param_sharding
+        self._train_step = None
+        self._eval_step = None
+        self._donate = donate
+        self.train_state: Optional[TrainState] = None
+
+    # -- setup ---------------------------------------------------------------
+
+    @staticmethod
+    def _default_forward(model, variables, batch, train, rngs):
+        if train:
+            out, new = model.apply(variables, batch["x"], train=True,
+                                   mutable=("state",), rngs=rngs)
+            return out, new["state"]
+        return model.apply(variables, batch["x"]), variables["state"]
+
+    def init(self, rng, sample_batch: Dict[str, Any]) -> TrainState:
+        """Initialize params/state/optimizer from one (host) batch. Models with
+        non-standard inputs (custom ``forward=`` arg) implement
+        ``init_variables(rng, batch)``."""
+        batch = jax.tree_util.tree_map(jnp.asarray, sample_batch)
+        if hasattr(self.model, "init_variables"):
+            variables = self.model.init_variables(rng, batch)
+        else:
+            variables = self.model.init(rng, batch["x"], train=True)
+        opt_state = self.optimizer.init(variables["params"])
+        self.train_state = TrainState(variables["params"],
+                                      variables.get("state", {}),
+                                      opt_state, jnp.zeros((), jnp.int32))
+        return self.train_state
+
+    # -- compiled steps ------------------------------------------------------
+
+    def _build_train_step(self):
+        mesh = self.mesh
+        opt = self.optimizer
+        model = self.model
+        loss_fn = self.loss_fn
+        forward = self._forward
+        evaluator = self.evaluator
+
+        def step_fn(params, state, opt_state, step, batch, rng):
+            rngs = {"dropout": jax.random.fold_in(rng, step)}
+
+            def compute_loss(p):
+                out, new_state = forward(model, {"params": p, "state": state},
+                                         batch, True, rngs)
+                per_ex = loss_fn(out, batch)
+                w = batch.get("weight")
+                if w is not None:
+                    loss = jnp.sum(per_ex * w) / jnp.maximum(jnp.sum(w), 1e-9)
+                else:
+                    loss = jnp.mean(per_ex)
+                return loss, (new_state, out)
+
+            (loss, (new_state, out)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params)
+            updates, new_opt = opt.update(grads, opt_state, params, step)
+            new_params = apply_updates(params, updates)
+            stats = (evaluator.batch_stats(out, batch)
+                     if evaluator is not None else {})
+            return new_params, new_state, new_opt, step + 1, loss, stats
+
+        # Shardings: params/opt replicated (or user-specified for model
+        # parallelism), batch sharded over the data axis. XLA inserts the
+        # gradient all-reduce over ICI — the entire pserver tier collapses here.
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+        pspec = self._param_sharding or repl
+        donate = (0, 1, 2) if self._donate else ()
+        self._train_step = jax.jit(
+            step_fn,
+            in_shardings=(pspec, repl, pspec, repl, data, repl),
+            donate_argnums=donate)
+
+    def _build_eval_step(self):
+        model = self.model
+        loss_fn = self.loss_fn
+        forward = self._forward
+        evaluator = self.evaluator
+
+        def eval_fn(params, state, batch):
+            out, _ = forward(model, {"params": params, "state": state},
+                             batch, False, None)
+            per_ex = loss_fn(out, batch)
+            stats = (evaluator.batch_stats(out, batch)
+                     if evaluator is not None else {})
+            return jnp.mean(per_ex), stats
+
+        self._eval_step = jax.jit(eval_fn)
+
+    # -- loops ---------------------------------------------------------------
+
+    def _shard(self, host_batch):
+        return mesh_lib.shard_batch(self.mesh, host_batch)
+
+    def train(self, reader: Callable, num_passes: int = 1,
+              event_handler: Optional[Callable] = None,
+              test_reader: Optional[Callable] = None,
+              checkpoint_dir: Optional[str] = None,
+              checkpoint_keep: int = 3,
+              log_period: int = 100, rng: Optional[jax.Array] = None,
+              resume: bool = False) -> TrainState:
+        """The pass/batch loop (v2 ``SGD.train`` surface + v1 pass checkpoints)."""
+        assert self.train_state is not None, "call init() first"
+        if self._train_step is None:
+            self._build_train_step()
+        handler = event_handler or (lambda e: None)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        start_pass = 0
+        if resume and checkpoint_dir:
+            last = ckpt_lib.latest_pass(checkpoint_dir)
+            if last is not None:
+                self.restore(checkpoint_dir, last)
+                start_pass = last + 1
+
+        ts = self.train_state
+        params, state, opt_state, step = (ts.params, ts.state, ts.opt_state,
+                                          ts.step)
+        for pass_id in range(start_pass, num_passes):
+            handler(ev.BeginPass(pass_id))
+            if self.evaluator is not None:
+                self.evaluator.reset()
+            costs = []
+            for batch_id, host_batch in enumerate(reader()):
+                handler(ev.BeginIteration(pass_id, batch_id))
+                with self.stats.time("shard_batch"):
+                    batch = self._shard(host_batch)
+                with self.stats.time("train_step"):
+                    params, state, opt_state, step, loss, stats = \
+                        self._train_step(params, state, opt_state, step,
+                                         batch, rng)
+                cost = float(loss)
+                costs.append(cost)
+                metrics = {}
+                if self.evaluator is not None:
+                    self.evaluator.update(jax.device_get(stats))
+                    metrics = self.evaluator.result()
+                if (batch_id + 1) % log_period == 0:
+                    pass  # logging is the event handler's job
+                handler(ev.EndIteration(pass_id, batch_id, int(step), cost,
+                                        metrics))
+            self.train_state = TrainState(params, state, opt_state, step)
+            pass_metrics = (self.evaluator.result()
+                            if self.evaluator is not None else {})
+            pass_metrics["mean_cost"] = float(np.mean(costs)) if costs else 0.0
+            if test_reader is not None:
+                tc, tm = self.evaluate(test_reader)
+                pass_metrics.update({f"test_{k}": v for k, v in tm.items()})
+                pass_metrics["test_cost"] = tc
+            if checkpoint_dir:
+                ckpt_lib.save_checkpoint(
+                    checkpoint_dir, pass_id, self.train_state.as_dict(),
+                    keep_last=checkpoint_keep)
+            handler(ev.EndPass(pass_id, pass_metrics))
+        return self.train_state
+
+    def evaluate(self, reader: Callable) -> Tuple[float, Dict[str, float]]:
+        assert self.train_state is not None
+        if self._eval_step is None:
+            self._build_eval_step()
+        if self.evaluator is not None:
+            self.evaluator.reset()
+        ts = self.train_state
+        costs = []
+        for host_batch in reader():
+            batch = self._shard(host_batch)
+            loss, stats = self._eval_step(ts.params, ts.state, batch)
+            costs.append(float(loss))
+            if self.evaluator is not None:
+                self.evaluator.update(jax.device_get(stats))
+        metrics = self.evaluator.result() if self.evaluator is not None else {}
+        return float(np.mean(costs)) if costs else 0.0, metrics
+
+    # -- checkpoint ----------------------------------------------------------
+
+    def save(self, checkpoint_dir: str, pass_id: int):
+        assert self.train_state is not None
+        return ckpt_lib.save_checkpoint(checkpoint_dir, pass_id,
+                                        self.train_state.as_dict())
+
+    def restore(self, checkpoint_dir: str, pass_id: Optional[int] = None):
+        loaded = ckpt_lib.load_checkpoint(checkpoint_dir, pass_id)
+        put = lambda tree: jax.tree_util.tree_map(jnp.asarray, tree)
+        # Rebuild optimizer-state pytree type (tuples/namedtuples flattened to
+        # plain containers by the npz round-trip) by grafting leaves onto a
+        # freshly-built state skeleton.
+        params = put(loaded["params"])
+        skeleton = self.optimizer.init(params)
+        flat_loaded = jax.tree_util.tree_leaves(put(loaded["opt_state"]))
+        treedef = jax.tree_util.tree_structure(skeleton)
+        opt_state = jax.tree_util.tree_unflatten(treedef, flat_loaded)
+        self.train_state = TrainState(params, put(loaded.get("state", {})),
+                                      opt_state,
+                                      jnp.asarray(loaded["step"], jnp.int32))
+        return self.train_state

@@ -1,0 +1,3 @@
+"""Utilities: timers/stats, logging (successor of paddle/utils)."""
+
+from .stats import StatSet, global_stats, profile_trace, timer

@@ -1,0 +1,99 @@
+"""Hierarchical timers / stats — the Stat.h analog.
+
+Reference: ``/root/reference/paddle/utils/Stat.h:63,230`` (``StatSet`` with
+``REGISTER_TIMER*`` macros, periodic ``printAllStatus``) used through the hot
+loop. TPU-native notes: device work is async, so timers that should include
+device time must fence via ``jax.block_until_ready`` (the ``sync`` flag); the
+jax profiler (``start_trace``/``stop_trace``) is surfaced for kernel-level
+traces (the analog of ``hl_profiler_start``/nvprof).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["StatSet", "global_stats", "timer", "profile_trace"]
+
+
+class _Stat:
+    __slots__ = ("total", "count", "max")
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self.max = 0.0
+
+    def add(self, dt: float):
+        self.total += dt
+        self.count += 1
+        self.max = max(self.max, dt)
+
+
+class StatSet:
+    def __init__(self, name: str = "stats"):
+        self.name = name
+        self._stats: Dict[str, _Stat] = {}
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def time(self, key: str, sync=None):
+        """Time a block; pass ``sync=array_or_pytree`` to block on device work."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._stats.setdefault(key, _Stat()).add(dt)
+
+    def add(self, key: str, dt: float):
+        with self._lock:
+            self._stats.setdefault(key, _Stat()).add(dt)
+
+    def reset(self):
+        with self._lock:
+            self._stats.clear()
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: {"total_s": s.total, "count": s.count,
+                        "avg_ms": 1e3 * s.total / max(1, s.count),
+                        "max_ms": 1e3 * s.max}
+                    for k, s in self._stats.items()}
+
+    def report(self) -> str:
+        lines = [f"=== {self.name} ==="]
+        for k, v in sorted(self.summary().items()):
+            lines.append(f"  {k:<30s} n={v['count']:<6d} "
+                         f"avg={v['avg_ms']:8.2f}ms max={v['max_ms']:8.2f}ms "
+                         f"total={v['total_s']:.2f}s")
+        return "\n".join(lines)
+
+
+_global = StatSet("global")
+
+
+def global_stats() -> StatSet:
+    return _global
+
+
+def timer(key: str, sync=None):
+    return _global.time(key, sync=sync)
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str):
+    """jax profiler trace (view in TensorBoard/Perfetto) — the GPU-profiler
+    analog (``hl_profiler_start/end``)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

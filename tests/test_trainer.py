@@ -1,0 +1,142 @@
+"""Trainer end-to-end tests: MNIST slice, events, evaluators, checkpoints,
+and the 1-device vs 8-device equivalence check (the analog of the reference's
+local-vs-remote comparison, gserver/tests/test_CompareSparse.cpp)."""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import data, optim
+from paddle_tpu.data import datasets
+from paddle_tpu.models import MnistMLP, LeNet
+from paddle_tpu.nn import costs
+from paddle_tpu.train import (Trainer, ClassificationError, EvaluatorSet,
+                              checkpoint as ckpt, events as ev)
+
+
+def mnist_batches(batch_size=64, n=512, split="train"):
+    r = datasets.mnist(split, synthetic_n=n)
+    return data.batched(
+        data.map_readers(lambda s: {"x": s[0], "label": s[1]}, r), batch_size)
+
+
+def make_trainer(model=None, mesh=None):
+    return Trainer(
+        model=model or MnistMLP(),
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
+        optimizer=optim.adam(1e-3),
+        mesh=mesh,
+        evaluator=ClassificationError())
+
+
+def test_mnist_end_to_end_slice(tmp_path):
+    """The minimum end-to-end slice (SURVEY.md §7 stage 3): synthetic-MNIST
+    LeNet-lite to high accuracy."""
+    tr = make_trainer()
+    reader = mnist_batches()
+    tr.init(jax.random.PRNGKey(0), next(iter(reader())))
+    seen = {"it": 0, "passes": []}
+
+    def handler(e):
+        if isinstance(e, ev.EndIteration):
+            seen["it"] += 1
+        elif isinstance(e, ev.EndPass):
+            seen["passes"].append(e.metrics)
+
+    tr.train(reader, num_passes=6, event_handler=handler,
+             checkpoint_dir=str(tmp_path / "ckpt"))
+    assert seen["it"] == 6 * 8  # 512/64 batches * passes
+    final = seen["passes"][-1]
+    assert final["accuracy"] > 0.95, final
+    # checkpoints written per pass, gc'd to keep_last=3
+    dirs = sorted(os.listdir(tmp_path / "ckpt"))
+    assert dirs == ["pass-00003", "pass-00004", "pass-00005"]
+
+
+def test_evaluate_and_test_reader():
+    tr = make_trainer()
+    reader = mnist_batches(n=256)
+    tr.init(jax.random.PRNGKey(0), next(iter(reader())))
+    tr.train(reader, num_passes=4)
+    cost, metrics = tr.evaluate(mnist_batches(n=256, split="train"))
+    assert metrics["accuracy"] > 0.9
+    assert cost < 1.0
+
+
+def test_checkpoint_roundtrip_and_resume(tmp_path):
+    tr = make_trainer()
+    reader = mnist_batches(n=128)
+    tr.init(jax.random.PRNGKey(0), next(iter(reader())))
+    tr.train(reader, num_passes=2, checkpoint_dir=str(tmp_path))
+    step_before = int(tr.train_state.step)
+    p_before = jax.device_get(tr.train_state.params)
+
+    tr2 = make_trainer()
+    tr2.init(jax.random.PRNGKey(1), next(iter(reader())))  # different init
+    tr2.restore(str(tmp_path))
+    assert int(tr2.train_state.step) == step_before
+    p_after = jax.device_get(tr2.train_state.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), p_before, p_after)
+    # resume skips completed passes
+    tr3 = make_trainer()
+    tr3.init(jax.random.PRNGKey(2), next(iter(reader())))
+    tr3.train(reader, num_passes=2, checkpoint_dir=str(tmp_path), resume=True)
+    assert int(tr3.train_state.step) == step_before  # nothing re-run
+
+
+def test_checkpoint_crc_detects_corruption(tmp_path):
+    tree = {"params": {"w": np.ones((2, 2))}, "step": np.asarray(5)}
+    d = ckpt.save_checkpoint(str(tmp_path), 0, tree)
+    # corrupt the file
+    path = os.path.join(d, "params.npz")
+    with open(path, "r+b") as f:
+        f.seek(30)
+        f.write(b"\xff\xff")
+    with pytest.raises(IOError, match="crc"):
+        ckpt.load_checkpoint(str(tmp_path))
+
+
+def test_single_vs_multichip_equivalence():
+    """1-device vs 8-device data parallel must produce the same training
+    trajectory (the reference's local-vs-remote oracle,
+    test_CompareSparse.cpp:144)."""
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest must force 8 CPU devices"
+    reader = mnist_batches(batch_size=64, n=256)
+    results = []
+    for mesh in (pt.make_mesh({"data": 1}, devices=devices[:1]),
+                 pt.make_mesh({"data": 8}, devices=devices[:8])):
+        tr = make_trainer(mesh=mesh)
+        tr.init(jax.random.PRNGKey(0), next(iter(reader())))
+        tr.train(reader, num_passes=1)
+        results.append((float(jax.device_get(
+            optim.global_norm(tr.train_state.params))),
+            int(tr.train_state.step)))
+    norm1, steps1 = results[0]
+    norm8, steps8 = results[1]
+    assert steps1 == steps8
+    np.testing.assert_allclose(norm1, norm8, rtol=1e-4)
+
+
+def test_weighted_loss_path():
+    tr = Trainer(
+        model=MnistMLP(),
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
+        optimizer=optim.sgd(0.1))
+    r = datasets.mnist("train", synthetic_n=64)
+
+    def wreader():
+        for b in data.batched(
+                data.map_readers(lambda s: {"x": s[0], "label": s[1]}, r),
+                32)():
+            b["weight"] = np.ones_like(b["label"], np.float32)
+            yield b
+
+    tr.init(jax.random.PRNGKey(0), next(iter(wreader())))
+    tr.train(wreader, num_passes=1)
+    assert int(tr.train_state.step) == 2
