@@ -40,7 +40,7 @@ class _Frame:
     """Per-init/apply execution context (thread-local)."""
 
     __slots__ = ("variables", "rngs", "mode", "mutable", "path", "counters",
-                 "rng_counters", "touched")
+                 "rng_counters", "touched", "active")
 
     def __init__(self, variables, rngs, mode, mutable):
         self.variables = variables          # {'params': nested, 'state': nested, ...}
@@ -51,6 +51,7 @@ class _Frame:
         self.counters: Dict[Tuple[str, ...], Dict[str, int]] = {}
         self.rng_counters: Dict[Tuple[str, ...], int] = {}
         self.touched = False                # any state write happened
+        self.active: list[int] = []         # module-instance id stack
 
 
 _tls = threading.local()
@@ -183,15 +184,43 @@ class Module:
 
     # -- execution ------------------------------------------------------------
 
+    def scope(self):
+        """Context manager entering this module's parameter scope — for methods
+        other than forward() that declare/fetch params (e.g. RNN cell ``step``,
+        ``Embedding.table``, ``CRF.weights``). Idempotent: if this instance's
+        scope is already active (we're inside its forward or another scoped
+        method), no extra path segment is pushed — so helper methods can wrap
+        themselves in scope() and be callable both internally and externally."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            fr = _frame()
+            if fr.active and fr.active[-1] == id(self):
+                yield self
+                return
+            name = self._ensure_name(fr)
+            fr.path.append(name)
+            fr.active.append(id(self))
+            fr.counters[tuple(fr.path)] = {}
+            try:
+                yield self
+            finally:
+                fr.path.pop()
+                fr.active.pop()
+        return _scope()
+
     def __call__(self, *args, **kwargs):
         fr = _frame()
         name = self._ensure_name(fr)
         fr.path.append(name)
+        fr.active.append(id(self))
         fr.counters[tuple(fr.path)] = {}
         try:
             return self.forward(*args, **kwargs)
         finally:
             fr.path.pop()
+            fr.active.pop()
 
     def forward(self, *args, **kwargs):  # pragma: no cover - abstract
         raise NotImplementedError
@@ -213,8 +242,14 @@ class Module:
         return fr.variables
 
     def apply(self, variables, *args, rngs: Optional[dict] = None,
-              mutable: Sequence[str] = (), **kwargs):
-        """Pure application. With ``mutable`` non-empty returns (out, new_vars)."""
+              mutable: Sequence[str] = (), method=None, **kwargs):
+        """Pure application. With ``mutable`` non-empty returns (out, new_vars).
+
+        ``method`` names (or is) an alternative entry point — e.g.
+        ``model.apply(vs, x, method="generate")`` for beam search or
+        ``crf.apply(vs, em, lengths, method="decode")`` — executed inside this
+        module's parameter scope.
+        """
         if isinstance(mutable, str):
             mutable = (mutable,)
         # Shallow-copy the mutable collections so writes don't alias caller state.
@@ -225,7 +260,12 @@ class Module:
         prev = getattr(_tls, "frame", None)
         _tls.frame = fr
         try:
-            out = self(*args, **kwargs)
+            if method is None:
+                out = self(*args, **kwargs)
+            else:
+                fn = getattr(self, method) if isinstance(method, str) else method
+                with self.scope():
+                    out = fn(*args, **kwargs)
         finally:
             _tls.frame = prev
         if mutable:

@@ -159,9 +159,9 @@ def imdb(split: str = "train", vocab_size: int = 5000, max_len: int = 100,
     label-correlated token distributions (positive reviews draw from the upper
     vocab half more often) so models actually learn."""
     n = synthetic_n or (4096 if split == "train" else 1024)
-    rng = np.random.RandomState(6 if split == "train" else 7)
 
     def reader():
+        rng = np.random.RandomState(6 if split == "train" else 7)
         for i in range(n):
             label = int(rng.randint(0, 2))
             length = int(rng.randint(max_len // 4, max_len))
@@ -185,10 +185,10 @@ def synthetic_nmt(split: str = "train", src_vocab: int = 1000,
     ``v2/dataset/wmt14.py`` in the zero-egress environment. ids 0/1/2 reserved
     for pad/bos/eos."""
     n = n or (4096 if split == "train" else 512)
-    rng = np.random.RandomState(8 if split == "train" else 9)
     perm = np.random.RandomState(42).permutation(src_vocab)
 
     def reader():
+        rng = np.random.RandomState(8 if split == "train" else 9)
         for i in range(n):
             length = int(rng.randint(3, max_len - 2))
             src = rng.randint(3, src_vocab, size=length).astype(np.int32)
@@ -205,9 +205,9 @@ def synthetic_tagging(split: str = "train", vocab: int = 2000, n_tags: int = 9,
     sequence_tagging demo data, ``v1_api_demo/sequence_tagging``): tag depends
     on token range + previous tag, so CRF transitions matter."""
     n = n or (4096 if split == "train" else 512)
-    rng = np.random.RandomState(10 if split == "train" else 11)
 
     def reader():
+        rng = np.random.RandomState(10 if split == "train" else 11)
         for i in range(n):
             length = int(rng.randint(5, max_len))
             toks = rng.randint(0, vocab, size=length).astype(np.int32)
@@ -230,11 +230,11 @@ def synthetic_ctr(split: str = "train", num_fields: int = 8,
     ``v1_api_demo/quick_start/trainer_config.lr.py``): sparse categorical ids
     per field; click prob from a hidden per-field weight table."""
     n = n or (16384 if split == "train" else 2048)
-    rng = np.random.RandomState(12 if split == "train" else 13)
     hidden = np.random.RandomState(43).normal(
         0, 1.0, size=(num_fields, vocab_per_field)).astype(np.float32)
 
     def reader():
+        rng = np.random.RandomState(12 if split == "train" else 13)
         for i in range(n):
             ids = np.array([rng.randint(0, vocab_per_field)
                             for _ in range(num_fields)], np.int32)

@@ -2,3 +2,5 @@
 ResNet, seq2seq attention NMT, sequence tagging, CTR) built on paddle_tpu.nn."""
 
 from .mnist import LeNet, MnistMLP
+from .seq2seq import Seq2SeqAttention
+from .tagging import LinearCrfTagger, RnnCrfTagger

@@ -1,0 +1,88 @@
+"""Sequence tagging models — linear-CRF and RNN-CRF.
+
+Reference: ``/root/reference/v1_api_demo/sequence_tagging/linear_crf.py`` (sparse
+feature projections + CRF) and ``rnn_crf.py`` (embedding + RNN + CRF), evaluated
+with the chunk evaluator (``paddle/gserver/evaluators/ChunkEvaluator.cpp``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.module import Module
+from ..core.sequence import length_mask
+from .. import nn
+
+__all__ = ["RnnCrfTagger", "LinearCrfTagger"]
+
+
+class RnnCrfTagger(Module):
+    """Embedding -> BiLSTM -> Linear emissions -> CRF (rnn_crf.py analog).
+
+    forward(batch) -> per-sequence CRF negative log-likelihood.
+    ``decode`` -> viterbi tags (use via apply(..., method="decode")).
+    """
+
+    def __init__(self, vocab: int, num_tags: int, emb_dim: int = 64,
+                 hidden: int = 128, name=None):
+        super().__init__(name=name)
+        self.emb = nn.Embedding(vocab, emb_dim, name="emb")
+        self.rnn = nn.BiRNN(nn.LSTMCell(hidden), nn.LSTMCell(hidden),
+                            name="birnn")
+        self.proj = nn.Linear(num_tags, name="emissions")
+        self.crf = nn.CRF(num_tags, name="crf")
+
+    def emissions(self, batch):
+        toks, lengths = batch["tokens"], batch["length"]
+        mask = length_mask(lengths, toks.shape[1])
+        h = self.rnn(self.emb(toks), mask=mask)
+        return self.proj(h), lengths
+
+    def forward(self, batch, train: bool = False):
+        em, lengths = self.emissions(batch)
+        return self.crf(em, batch["label"], lengths)
+
+    def decode(self, batch):
+        em, lengths = self.emissions(batch)
+        return self.crf.decode(em, lengths)
+
+    def init_variables(self, rng, batch):
+        return self.init(rng, batch)
+
+
+class LinearCrfTagger(Module):
+    """Sparse-feature linear emissions -> CRF (linear_crf.py analog): token
+    (and optional context) ids project straight to tag scores via embedding
+    tables — the TPU-native form of the reference's sparse full-matrix
+    projections over one-hot features."""
+
+    def __init__(self, vocab: int, num_tags: int, context: int = 2, name=None):
+        super().__init__(name=name)
+        self.context = context
+        self.tables = [nn.Embedding(vocab, num_tags, name=f"feat_{i}")
+                       for i in range(2 * context + 1)]
+        self.crf = nn.CRF(num_tags, name="crf")
+
+    def emissions(self, batch):
+        toks, lengths = batch["tokens"], batch["length"]
+        em = None
+        for off in range(-self.context, self.context + 1):
+            shifted = jnp.roll(toks, -off, axis=1)
+            t = toks.shape[1]
+            idx = jnp.arange(t)
+            valid = (idx + off >= 0) & (idx + off < t)
+            shifted = jnp.where(valid[None, :], shifted, -1)  # -1 -> zero emb
+            e = self.tables[off + self.context](shifted)
+            em = e if em is None else em + e
+        return em, lengths
+
+    def forward(self, batch, train: bool = False):
+        em, lengths = self.emissions(batch)
+        return self.crf(em, batch["label"], lengths)
+
+    def decode(self, batch):
+        em, lengths = self.emissions(batch)
+        return self.crf.decode(em, lengths)
+
+    def init_variables(self, rng, batch):
+        return self.init(rng, batch)

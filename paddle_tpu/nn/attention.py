@@ -1,0 +1,118 @@
+"""Attention layers.
+
+Reference: ``simple_attention`` (``/root/reference/python/paddle/
+trainer_config_helpers/networks.py:1320`` — additive/concat attention over
+encoder states inside the recurrent group) and ``dot_product_attention``
+(``networks.py:1400``+). Multi-head scaled-dot-product attention is the
+transformer-era generalization (beyond the 2017 reference, required for the
+long-context axis; the sequence-parallel ring variant lives in
+``paddle_tpu.parallel.ring_attention``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import initializers as I
+from ..core.dtypes import current_policy
+from ..core.module import Module
+from .layers import Linear
+
+__all__ = ["AdditiveAttention", "DotProductAttention", "MultiHeadAttention",
+           "dot_product_attention_weights"]
+
+
+def dot_product_attention_weights(q, k, mask=None, scale: Optional[float] = None):
+    """softmax(q·kᵀ/√d) with additive masking; q [B, Tq, D], k [B, Tk, D]."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    if mask is not None:
+        logits = jnp.where(mask > 0, logits, -1e9)
+    w = jax.nn.softmax(logits, axis=-1)
+    if mask is not None:
+        w = w * (mask > 0)
+    return w
+
+
+class AdditiveAttention(Module):
+    """Bahdanau / the reference's ``simple_attention``: score = vᵀ tanh(W_d d +
+    W_e e). ``__call__(decoder_state [B, D], enc [B, T, E], enc_mask [B, T])``
+    returns the context vector [B, E]."""
+
+    def __init__(self, hidden: int, name=None):
+        super().__init__(name=name)
+        self.hidden = hidden
+        self.proj_d = Linear(hidden, use_bias=False, name="proj_decoder")
+        self.proj_e = Linear(hidden, use_bias=False, name="proj_encoder")
+        self.v = Linear(1, use_bias=False, name="score")
+
+    def forward(self, decoder_state, enc, enc_mask=None, enc_proj=None):
+        # enc_proj may be precomputed once per sequence (the reference caches
+        # the encoder projection outside the recurrent group).
+        if enc_proj is None:
+            enc_proj = self.proj_e(enc)
+        s = jnp.tanh(enc_proj + self.proj_d(decoder_state)[:, None, :])
+        scores = self.v(s)[..., 0]                       # [B, T]
+        from .activations import sequence_softmax
+        w = sequence_softmax(scores, mask=enc_mask)
+        return jnp.einsum("bt,bte->be", w, enc), w
+
+
+class DotProductAttention(Module):
+    """The reference's ``dot_product_attention`` (networks.py): context =
+    softmax(d·Eᵀ)·E for a single query state."""
+
+    def __init__(self, scale: Optional[float] = None, name=None):
+        super().__init__(name=name)
+        self.scale = scale
+
+    def forward(self, decoder_state, enc, enc_mask=None):
+        w = dot_product_attention_weights(
+            decoder_state[:, None, :], enc,
+            mask=None if enc_mask is None else enc_mask[:, None, :],
+            scale=self.scale)[:, 0]                      # [B, T]
+        return jnp.einsum("bt,bte->be", w, enc), w
+
+
+class MultiHeadAttention(Module):
+    """Scaled-dot-product multi-head attention, bf16-friendly, with optional
+    causal + segment masking (packed sequences). Self- or cross-attention."""
+
+    def __init__(self, num_heads: int, head_dim: Optional[int] = None,
+                 out_dim: Optional[int] = None, name=None):
+        super().__init__(name=name)
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.out_dim = out_dim
+
+    def forward(self, q_in, kv_in=None, mask=None):
+        """q_in [B, Tq, D]; kv_in defaults to q_in (self-attention);
+        mask [B, Tq, Tk] (1 = attend)."""
+        kv_in = q_in if kv_in is None else kv_in
+        pol = current_policy()
+        d_model = q_in.shape[-1]
+        h = self.num_heads
+        hd = self.head_dim or d_model // h
+        out_d = self.out_dim or d_model
+
+        def proj(name, x, feats):
+            w = self.param(name, I.xavier_uniform, (x.shape[-1], feats))
+            return jnp.dot(pol.cast_compute(x), pol.cast_compute(w),
+                           preferred_element_type=pol.accum_dtype)
+
+        q = proj("wq", q_in, h * hd).reshape(*q_in.shape[:2], h, hd)
+        k = proj("wk", kv_in, h * hd).reshape(*kv_in.shape[:2], h, hd)
+        v = proj("wv", kv_in, h * hd).reshape(*kv_in.shape[:2], h, hd)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+        logits = logits.astype(jnp.float32)
+        if mask is not None:
+            logits = jnp.where(mask[:, None, :, :] > 0, logits, -1e9)
+        w = jax.nn.softmax(logits, axis=-1).astype(pol.compute_dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", w, v)
+        ctx = ctx.reshape(*q_in.shape[:2], h * hd)
+        return proj("wo", ctx, out_d)

@@ -1,0 +1,134 @@
+"""Linear-chain CRF — loss (forward algorithm) and Viterbi decode as scans.
+
+Reference: ``/root/reference/paddle/gserver/layers/LinearChainCRF.cpp`` (forward
+recursion with start/stop transition rows, ``CRFLayer.cpp`` the cost layer,
+``CRFDecodingLayer.cpp`` the Viterbi decoder; fluid ``linear_chain_crf_op``).
+Parameterization matches the reference: a ``[L+2, L]`` weight matrix whose row 0
+is start transitions ``a``, row 1 stop transitions ``b``, rows 2.. the ``w``
+transition matrix (``LinearChainCRF.cpp:23-29`` comment block).
+
+Log-space throughout; recursions are ``lax.scan`` over time (XLA-friendly, no
+dynamic shapes); masking freezes alpha past each sequence's end.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import initializers as I
+from ..core.module import Module
+from ..core.sequence import length_mask
+
+__all__ = ["CRF", "crf_log_likelihood", "crf_decode"]
+
+
+def _logsumexp(x, axis=-1):
+    return jax.scipy.special.logsumexp(x, axis=axis)
+
+
+def crf_forward(emissions, lengths, start, stop, trans):
+    """log Z via the forward recursion (LinearChainCRF::forward analog).
+
+    emissions: [B, T, L] unary scores; lengths: [B]; start/stop: [L];
+    trans: [L, L] (trans[i, j] = score of i -> j). Returns [B] log partition.
+    """
+    b, t, L = emissions.shape
+    alpha0 = start[None, :] + emissions[:, 0]          # [B, L]
+    mask = length_mask(lengths, t)                      # [B, T]
+
+    def body(alpha, inp):
+        emit_t, m_t = inp                               # [B, L], [B]
+        # alpha'[j] = logsumexp_i(alpha[i] + trans[i,j]) + emit[j]
+        scores = alpha[:, :, None] + trans[None, :, :]  # [B, L, L]
+        new = _logsumexp(scores, axis=1) + emit_t
+        keep = m_t[:, None]
+        return keep * new + (1 - keep) * alpha, None
+
+    xs = (jnp.swapaxes(emissions, 0, 1)[1:], jnp.swapaxes(mask, 0, 1)[1:])
+    alpha, _ = lax.scan(body, alpha0, xs)
+    return _logsumexp(alpha + stop[None, :], axis=1)
+
+
+def crf_score(emissions, tags, lengths, start, stop, trans):
+    """Score of a given tag path (gold score)."""
+    b, t, L = emissions.shape
+    mask = length_mask(lengths, t)
+    # unary terms
+    unary = jnp.take_along_axis(emissions, tags[..., None], axis=-1)[..., 0]
+    unary = (unary * mask).sum(1)
+    # start / stop terms
+    first = jnp.take(start, tags[:, 0])
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_tag = jnp.take_along_axis(tags, last_idx[:, None], 1)[:, 0]
+    final = jnp.take(stop, last_tag)
+    # transitions
+    pair = trans[tags[:, :-1], tags[:, 1:]]            # [B, T-1]
+    pair = (pair * mask[:, 1:]).sum(1)
+    valid = (lengths > 0).astype(emissions.dtype)
+    return (unary + pair + first + final) * valid
+
+
+def crf_log_likelihood(emissions, tags, lengths, weights):
+    """Per-sequence negative log likelihood (the reference ``CRFLayer`` cost).
+    ``weights``: the [L+2, L] parameter block (start/stop/trans packed)."""
+    start, stop, trans = weights[0], weights[1], weights[2:]
+    logz = crf_forward(emissions, lengths, start, stop, trans)
+    gold = crf_score(emissions, tags, lengths, start, stop, trans)
+    return logz - gold
+
+
+def crf_decode(emissions, lengths, weights):
+    """Viterbi decode (reference: ``CRFDecodingLayer`` /
+    ``LinearChainCRF::decode``): returns best tags [B, T] (0 past lengths)."""
+    start, stop, trans = weights[0], weights[1], weights[2:]
+    b, t, L = emissions.shape
+    mask = length_mask(lengths, t)
+    alpha0 = start[None, :] + emissions[:, 0]
+
+    def body(alpha, inp):
+        emit_t, m_t = inp
+        scores = alpha[:, :, None] + trans[None, :, :]  # [B, i, j]
+        best_prev = jnp.argmax(scores, axis=1)          # [B, L]
+        new = jnp.max(scores, axis=1) + emit_t
+        keep = m_t[:, None]
+        new_alpha = keep * new + (1 - keep) * alpha
+        # frozen steps keep identity backpointer so backtrace passes through
+        bp = jnp.where(m_t[:, None] > 0, best_prev,
+                       jnp.arange(L)[None, :])
+        return new_alpha, bp
+
+    xs = (jnp.swapaxes(emissions, 0, 1)[1:], jnp.swapaxes(mask, 0, 1)[1:])
+    alpha, bps = lax.scan(body, alpha0, xs)             # bps: [T-1, B, L]
+    last = jnp.argmax(alpha + stop[None, :], axis=-1)   # [B]
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first_tag, tags_rev = lax.scan(back, last, bps, reverse=True)
+    tags = jnp.concatenate([first_tag[None], tags_rev], 0)  # [T, B]
+    tags = jnp.swapaxes(tags, 0, 1)
+    return (tags * mask.astype(tags.dtype)).astype(jnp.int32)
+
+
+class CRF(Module):
+    """CRF layer holding the packed [L+2, L] weights (reference param layout)."""
+
+    def __init__(self, num_tags: int, name=None):
+        super().__init__(name=name)
+        self.num_tags = num_tags
+
+    def weights(self):
+        with self.scope():
+            return self.param("w", I.normal(0.01),
+                              (self.num_tags + 2, self.num_tags))
+
+    def forward(self, emissions, tags, lengths):
+        return crf_log_likelihood(emissions, tags, lengths, self.weights())
+
+    def decode(self, emissions, lengths):
+        return crf_decode(emissions, lengths, self.weights())

@@ -1,0 +1,102 @@
+"""CTC loss — the forward (alpha) recursion as a lax.scan.
+
+Reference: ``/root/reference/paddle/gserver/layers/LinearChainCTC.cpp`` (the
+classic alpha-beta recursion over the blank-extended label sequence; ``CTCLayer
+.cpp`` cost layer, ``WarpCTCLayer.cpp`` the warp-ctc binding). Blank id = 0 by
+default, matching the reference's ``blank_`` convention (norm_by_times flag too).
+
+Log-space alpha recursion over the extended sequence z of length 2U+1 (blanks
+interleaved); all shapes static, masking handles variable input/label lengths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.sequence import length_mask
+
+__all__ = ["ctc_loss", "ctc_greedy_decode"]
+
+# Large-negative sentinel instead of -inf: keeps gradients finite for
+# infeasible alignments (e.g. label longer than input).
+_NEG = -1e30
+
+_log_add = jnp.logaddexp
+
+
+def ctc_loss(log_probs, input_lengths, labels, label_lengths, blank: int = 0,
+             norm_by_times: bool = False):
+    """Per-example CTC negative log likelihood.
+
+    log_probs: [B, T, V] log-softmax outputs; labels: [B, U] (no blanks);
+    input_lengths: [B]; label_lengths: [B]. Returns [B] losses.
+    """
+    b, t, v = log_probs.shape
+    u = labels.shape[1]
+    s = 2 * u + 1
+
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((b, s), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_valid = jnp.arange(s)[None, :] < (2 * label_lengths + 1)[:, None]
+
+    # can skip from s-2 to s when ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate(
+        [jnp.full((b, 2), -1, labels.dtype), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    # alpha_0: positions 0 (blank) and 1 (first label)
+    emit0 = jnp.take_along_axis(log_probs[:, 0], ext, axis=-1)  # [B, S]
+    alpha0 = jnp.where(jnp.arange(s)[None, :] <= 1, emit0, _NEG)
+    alpha0 = jnp.where(ext_valid, alpha0, _NEG)
+
+    time_mask = length_mask(input_lengths, t)
+
+    def body(alpha, inp):
+        lp_t, m_t = inp                                  # [B, V], [B]
+        emit = jnp.take_along_axis(lp_t, ext, axis=-1)   # [B, S]
+        shift1 = jnp.concatenate(
+            [jnp.full((b, 1), _NEG), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate(
+            [jnp.full((b, 2), _NEG), alpha[:, :-2]], axis=1)
+        acc = _log_add(alpha, shift1)
+        acc = jnp.where(can_skip, _log_add(acc, shift2), acc)
+        new = jnp.where(ext_valid, acc + emit, _NEG)
+        keep = m_t[:, None]
+        return jnp.where(keep > 0, new, alpha), None
+
+    xs = (jnp.swapaxes(log_probs, 0, 1)[1:],
+          jnp.swapaxes(time_mask.astype(log_probs.dtype), 0, 1)[1:])
+    alpha, _ = lax.scan(body, alpha0, xs)
+
+    # final: last blank or last label position (the latter only exists for
+    # non-empty targets — clamping would double-count alpha[0]).
+    end1 = 2 * label_lengths                             # final blank
+    end2 = jnp.maximum(2 * label_lengths - 1, 0)         # final label
+    a1 = jnp.take_along_axis(alpha, end1[:, None], 1)[:, 0]
+    a2 = jnp.take_along_axis(alpha, end2[:, None], 1)[:, 0]
+    a2 = jnp.where(label_lengths > 0, a2, _NEG)
+    ll = _log_add(a1, a2)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths.astype(loss.dtype), 1.0)
+    return loss
+
+
+def ctc_greedy_decode(log_probs, input_lengths, blank: int = 0):
+    """Best-path decode: argmax per frame, collapse repeats, strip blanks.
+    Returns (decoded [B, T] padded with -1, lengths [B])."""
+    b, t, v = log_probs.shape
+    ids = jnp.argmax(log_probs, axis=-1)                # [B, T]
+    valid = length_mask(input_lengths, t) > 0
+    prev = jnp.concatenate([jnp.full((b, 1), -1, ids.dtype), ids[:, :-1]], 1)
+    keep = valid & (ids != blank) & (ids != prev)
+
+    # stable compaction: sort by (not keep, position)
+    order = jnp.argsort(jnp.where(keep, jnp.arange(t)[None, :], t + 1), axis=1)
+    gathered = jnp.take_along_axis(jnp.where(keep, ids, -1), order, axis=1)
+    lengths = keep.sum(1)
+    pos = jnp.arange(t)[None, :]
+    return jnp.where(pos < lengths[:, None], gathered, -1), lengths

@@ -99,14 +99,8 @@ class Embedding(Module):
         """Fetch the table from within this module's own scope (callable from a
         parent's forward — pushes this module's path so the param is shared
         with lookups, enabling tied softmax weights)."""
-        from ..core.module import _frame
-        fr = _frame()
-        name = self._ensure_name(fr)
-        fr.path.append(name)
-        try:
+        with self.scope():
             return self.param("w", self.w_init, (self.vocab, self.dim))
-        finally:
-            fr.path.pop()
 
     def forward(self, ids):
         w = self.param("w", self.w_init, (self.vocab, self.dim))

@@ -1,0 +1,236 @@
+"""Recurrent layers — lax.scan successors of the reference's RNN machinery.
+
+Reference: ``/root/reference/paddle/gserver/layers/LstmLayer.cpp`` (LSTM with
+peephole connections, reversed mode), ``GatedRecurrentLayer.cpp`` (GRU),
+``RecurrentLayer.cpp`` (vanilla), and the ``SequenceToBatch`` batch-scheduling
+trick (``SequenceToBatch.h``) that packs variable-length sequences for step-wise
+kernels. On TPU the scheduling disappears: one ``lax.scan`` over the padded time
+axis with per-step validity masks (state freezes past each sequence's end), and
+optional segment-reset for packed rows. The gate matmuls are fused into one
+``[D, 4H]`` projection so the MXU sees large GEMMs.
+
+Step cells are exposed separately (``LSTMCell.step``) for the decoder-side
+"recurrent group" pattern (the reference's ``LstmStepLayer``/``GruStepLayer``
+used inside ``RecurrentGradientMachine`` unrolls).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import initializers as I
+from ..core.module import Module
+from . import activations
+
+__all__ = ["LSTMCell", "GRUCell", "SimpleRNNCell", "RNN", "BiRNN"]
+
+
+class LSTMCell(Module):
+    """LSTM cell with optional peepholes (reference: ``LstmLayer.cpp`` — gates
+    i,f,o with W_ic/W_fc/W_oc diagonal peephole weights; ``hl_lstm.h``)."""
+
+    def __init__(self, hidden: int, use_peepholes: bool = True,
+                 act="tanh", gate_act="sigmoid", name=None):
+        super().__init__(name=name)
+        self.hidden = hidden
+        self.use_peepholes = use_peepholes
+        self.act = activations.get(act)
+        self.gate_act = activations.get(gate_act)
+
+    def initial_state(self, batch: int):
+        return (jnp.zeros((batch, self.hidden)),
+                jnp.zeros((batch, self.hidden)))
+
+    def step(self, state, x):
+        with self.scope():
+            return self._step(state, x)
+
+    def _step(self, state, x):
+        h_prev, c_prev = state
+        hd = self.hidden
+        wx = self.param("wx", I.xavier_uniform, (x.shape[-1], 4 * hd))
+        wh = self.param("wh", I.orthogonal(), (hd, 4 * hd))
+        b = self.param("b", I.zeros, (4 * hd,))
+        z = x @ wx + h_prev @ wh + b
+        zi, zf, zg, zo = jnp.split(z, 4, axis=-1)
+        if self.use_peepholes:
+            w_ic = self.param("w_ic", I.zeros, (hd,))
+            w_fc = self.param("w_fc", I.zeros, (hd,))
+            zi = zi + c_prev * w_ic
+            zf = zf + c_prev * w_fc
+        i = self.gate_act(zi)
+        f = self.gate_act(zf)
+        c = f * c_prev + i * self.act(zg)
+        zo_ = zo
+        if self.use_peepholes:
+            w_oc = self.param("w_oc", I.zeros, (hd,))
+            zo_ = zo + c * w_oc
+        o = self.gate_act(zo_)
+        h = o * self.act(c)
+        return (h, c), h
+
+    def forward(self, state, x):
+        return self._step(state, x)
+
+
+class GRUCell(Module):
+    """GRU cell (reference: ``GatedRecurrentLayer.cpp``, ``hl_gpu_gru.cuh``)."""
+
+    def __init__(self, hidden: int, act="tanh", gate_act="sigmoid", name=None):
+        super().__init__(name=name)
+        self.hidden = hidden
+        self.act = activations.get(act)
+        self.gate_act = activations.get(gate_act)
+
+    def initial_state(self, batch: int):
+        return jnp.zeros((batch, self.hidden))
+
+    def step(self, state, x):
+        with self.scope():
+            return self._step(state, x)
+
+    def _step(self, state, x):
+        h_prev = state
+        hd = self.hidden
+        wx = self.param("wx", I.xavier_uniform, (x.shape[-1], 3 * hd))
+        wh = self.param("wh", I.orthogonal(), (hd, 2 * hd))
+        wc = self.param("wc", I.orthogonal(), (hd, hd))
+        b = self.param("b", I.zeros, (3 * hd,))
+        zx = x @ wx + b
+        zu, zr, zc = jnp.split(zx, 3, axis=-1)
+        hu, hr = jnp.split(h_prev @ wh, 2, axis=-1)
+        u = self.gate_act(zu + hu)
+        r = self.gate_act(zr + hr)
+        cand = self.act(zc + (r * h_prev) @ wc)
+        h = u * h_prev + (1 - u) * cand
+        return h, h
+
+    def forward(self, state, x):
+        return self._step(state, x)
+
+
+class SimpleRNNCell(Module):
+    """Vanilla RNN (reference: ``RecurrentLayer.cpp``)."""
+
+    def __init__(self, hidden: int, act="tanh", name=None):
+        super().__init__(name=name)
+        self.hidden = hidden
+        self.act = activations.get(act)
+
+    def initial_state(self, batch: int):
+        return jnp.zeros((batch, self.hidden))
+
+    def step(self, state, x):
+        with self.scope():
+            return self._step(state, x)
+
+    def _step(self, state, x):
+        wx = self.param("wx", I.xavier_uniform, (x.shape[-1], self.hidden))
+        wh = self.param("wh", I.orthogonal(), (self.hidden, self.hidden))
+        b = self.param("b", I.zeros, (self.hidden,))
+        h = self.act(x @ wx + state @ wh + b)
+        return h, h
+
+    def forward(self, state, x):
+        return self._step(state, x)
+
+
+class RNN(Module):
+    """Run a cell over the time axis of ``x [B, T, D]`` with lax.scan.
+
+    - ``mask [B, T]``: state freezes where mask==0 (padded steps) — replaces
+      the reference's SequenceToBatch scheduling.
+    - ``segment_starts [B, T]``: 1 where a new packed segment begins — state
+      resets, enabling packed-row training (SURVEY.md §5).
+    - ``reverse``: the reference's reversed-LSTM mode.
+    - ``initial_state``: boot state (the RecurrentGradientMachine boot layer).
+    Returns ``(outputs [B, T, H], final_state)``.
+    """
+
+    def __init__(self, cell, reverse: bool = False, name=None):
+        super().__init__(name=name)
+        self.cell = cell
+        self.reverse = reverse
+
+    def forward(self, x, mask=None, segment_starts=None, initial_state=None):
+        b, t = x.shape[0], x.shape[1]
+        state0 = (initial_state if initial_state is not None
+                  else self.cell.initial_state(b))
+
+        # Materialize cell params once (outside scan) by tracing one step at
+        # fixed path; scan then reuses them via closure.
+        cell = self.cell
+
+        def one_step(state, inputs):
+            xt, mt, st = inputs
+            if st is not None:
+                # reset state where a new segment starts
+                state = jax.tree_util.tree_map(
+                    lambda s0, s: jnp.where(st[:, None] > 0, s0, s),
+                    state0, state)
+            new_state, out = cell.step(state, xt)
+            if mt is not None:
+                keep = mt[:, None]
+                new_state = jax.tree_util.tree_map(
+                    lambda n, o: keep * n + (1 - keep) * o, new_state, state)
+                out = out * keep
+            return new_state, out
+
+        xs = jnp.swapaxes(x, 0, 1)                      # [T, B, D]
+        ms = None if mask is None else jnp.swapaxes(mask, 0, 1)
+        ss = None if segment_starts is None else jnp.swapaxes(segment_starts,
+                                                              0, 1)
+        if self.reverse:
+            xs = xs[::-1]
+            ms = None if ms is None else ms[::-1]
+            ss = None if ss is None else ss[::-1]
+
+        # Pre-create params: run one step eagerly so scan's trace finds them.
+        _ = one_step(state0, (xs[0], None if ms is None else ms[0],
+                              None if ss is None else ss[0]))
+
+        def scan_body(state, inp):
+            if ms is None and ss is None:
+                xt = inp
+                return one_step(state, (xt, None, None))
+            if ss is None:
+                xt, mt = inp
+                return one_step(state, (xt, mt, None))
+            if ms is None:
+                xt, st = inp
+                return one_step(state, (xt, None, st))
+            xt, mt, st = inp
+            return one_step(state, (xt, mt, st))
+
+        if ms is None and ss is None:
+            inputs = xs
+        elif ss is None:
+            inputs = (xs, ms)
+        elif ms is None:
+            inputs = (xs, ss)
+        else:
+            inputs = (xs, ms, ss)
+        final, outs = lax.scan(scan_body, state0, inputs)
+        outs = jnp.swapaxes(outs, 0, 1)                 # [B, T, H]
+        if self.reverse:
+            outs = outs[:, ::-1]
+        return outs, final
+
+
+class BiRNN(Module):
+    """Bidirectional wrapper (reference: ``networks.py bidirectional_lstm``):
+    concat of forward and reverse passes with independent cells."""
+
+    def __init__(self, fwd_cell, bwd_cell, name=None):
+        super().__init__(name=name)
+        self.fwd = RNN(fwd_cell, reverse=False, name="fwd")
+        self.bwd = RNN(bwd_cell, reverse=True, name="bwd")
+
+    def forward(self, x, mask=None, segment_starts=None):
+        of, _ = self.fwd(x, mask=mask, segment_starts=segment_starts)
+        ob, _ = self.bwd(x, mask=mask, segment_starts=segment_starts)
+        return jnp.concatenate([of, ob], axis=-1)

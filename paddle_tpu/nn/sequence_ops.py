@@ -1,0 +1,124 @@
+"""Sequence manipulation layers over padded [B, T, ...] batches with masks.
+
+Reference: the gserver sequence family — ``SequencePoolLayer.cpp`` (avg/max/sum
+pooling), ``SequenceLastInstanceLayer.cpp`` (last/first), ``ExpandLayer.cpp``,
+``SequenceConcatLayer.cpp``, ``SequenceReshapeLayer.cpp``, ``SequenceSliceLayer
+.cpp``, ``KmaxSeqScoreLayer.cpp``, ``MaxIdLayer.cpp``. The reference works on
+ragged Arguments; here every op takes ``lengths [B]`` (or a mask) against padded
+data — all static shapes for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.sequence import length_mask
+
+__all__ = ["seq_pool", "seq_last", "seq_first", "seq_expand", "seq_concat",
+           "seq_reshape", "seq_slice", "kmax_scores", "max_id", "seq_softmax_pool"]
+
+
+def seq_pool(x, lengths, kind: str = "average"):
+    """Pool over time honoring lengths (reference: ``SequencePoolLayer`` —
+    average/sum/max/sqrt)."""
+    t = x.shape[1]
+    m = length_mask(lengths, t)[..., None]
+    if kind == "sum":
+        return (x * m).sum(1)
+    if kind == "average":
+        return (x * m).sum(1) / jnp.maximum(
+            lengths[:, None].astype(x.dtype), 1.0)
+    if kind == "sqrt":
+        return (x * m).sum(1) / jnp.sqrt(
+            jnp.maximum(lengths[:, None].astype(x.dtype), 1.0))
+    if kind == "max":
+        neg = jnp.where(m > 0, x, -jnp.inf)
+        out = neg.max(1)
+        return jnp.where(lengths[:, None] > 0, out, 0.0)
+    raise ValueError(kind)
+
+
+def seq_last(x, lengths):
+    """Last valid frame (reference: ``SequenceLastInstanceLayer``)."""
+    idx = jnp.maximum(lengths - 1, 0)
+    out = jnp.take_along_axis(
+        x, idx[:, None, None].astype(jnp.int32).repeat(x.shape[-1], -1),
+        axis=1)[:, 0]
+    return jnp.where(lengths[:, None] > 0, out, 0.0)
+
+
+def seq_first(x, lengths):
+    return jnp.where(lengths[:, None] > 0, x[:, 0], 0.0)
+
+
+def seq_expand(vec, like_lengths, max_len: int):
+    """Broadcast a per-sequence vector across time (reference: ``ExpandLayer``)."""
+    out = jnp.broadcast_to(vec[:, None, :],
+                           (vec.shape[0], max_len, vec.shape[-1]))
+    return out * length_mask(like_lengths, max_len)[..., None]
+
+
+def seq_concat(a, a_len, b, b_len):
+    """Concatenate two padded sequence batches along time, compacting padding
+    (reference: ``SequenceConcatLayer``). Output T = Ta + Tb."""
+    ta, tb = a.shape[1], b.shape[1]
+    t_out = ta + tb
+    bsz = a.shape[0]
+    pos = jnp.arange(t_out)[None, :]
+    from_a = pos < a_len[:, None]
+    idx_a = jnp.clip(pos, 0, ta - 1)
+    idx_b = jnp.clip(pos - a_len[:, None], 0, tb - 1)
+    ga = jnp.take_along_axis(a, idx_a[..., None].repeat(a.shape[-1], -1), 1)
+    gb = jnp.take_along_axis(b, idx_b[..., None].repeat(b.shape[-1], -1), 1)
+    out = jnp.where(from_a[..., None], ga, gb)
+    new_len = a_len + b_len
+    return out * length_mask(new_len, t_out)[..., None], new_len
+
+
+def seq_reshape(x, lengths, new_width: int):
+    """Reshape each sequence's flat values to a new frame width (reference:
+    ``SequenceReshapeLayer``): [B, T, D] -> [B, T*D//W, W] with adjusted
+    lengths."""
+    b, t, d = x.shape
+    assert (t * d) % new_width == 0
+    new_t = t * d // new_width
+    out = x.reshape(b, new_t, new_width)
+    new_len = (lengths * d) // new_width
+    return out * length_mask(new_len, new_t)[..., None], new_len
+
+
+def seq_slice(x, lengths, offsets, sizes):
+    """Per-sequence subsequence extraction (reference: ``SequenceSliceLayer``):
+    gather ``sizes`` frames starting at ``offsets`` (clamped to valid range)."""
+    b, t, d = x.shape
+    pos = jnp.arange(t)[None, :]
+    idx = jnp.clip(offsets[:, None] + pos, 0, t - 1)
+    gathered = jnp.take_along_axis(x, idx[..., None].repeat(d, -1), 1)
+    new_len = jnp.minimum(sizes, jnp.maximum(lengths - offsets, 0))
+    return gathered * length_mask(new_len, t)[..., None], new_len
+
+
+def kmax_scores(scores, lengths, k: int):
+    """Indices of the top-k scores per sequence (reference:
+    ``KmaxSeqScoreLayer``)."""
+    t = scores.shape[1]
+    masked = jnp.where(length_mask(lengths, t) > 0, scores, -jnp.inf)
+    _, idx = jax.lax.top_k(masked, k)
+    return idx
+
+
+def max_id(x):
+    """Argmax over features (reference: ``MaxIdLayer`` — the prediction op)."""
+    return jnp.argmax(x, axis=-1)
+
+
+def seq_softmax_pool(x, scores, lengths):
+    """Attention-style weighted pool: softmax(scores over valid steps) · x."""
+    from .activations import sequence_softmax
+    w = sequence_softmax(scores, lengths=lengths)
+    if w.ndim == 2:
+        w = w[..., None]
+    return (x * w).sum(1)

@@ -62,7 +62,10 @@ def _unflatten(flat: Dict[str, np.ndarray]):
             for i in range(n):
                 sub = {k[len(f"{i}/"):]: v for k, v in flat.items()
                        if k.startswith(f"{i}/")}
-                items.append(_unflatten(sub))
+                if not sub and str(i) in flat:
+                    items.append(flat[str(i)])  # bare array element
+                else:
+                    items.append(_unflatten(sub))
             return ctor(items)
     out: Dict[str, Any] = {}
     leaves = {}

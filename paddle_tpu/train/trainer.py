@@ -17,8 +17,11 @@ checkpoints — mirroring the v2 ``SGD.train`` surface
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+_log = logging.getLogger("paddle_tpu.trainer")
 
 import numpy as np
 import jax
@@ -211,17 +214,22 @@ class Trainer:
                     params, state, opt_state, step, loss, stats = \
                         self._train_step(params, state, opt_state, step,
                                          batch, rng)
+                # Refresh train_state every step: with buffer donation the
+                # previous arrays are invalidated, and event handlers may read
+                # trainer.train_state (e.g. to save) mid-pass.
+                self.train_state = TrainState(params, state, opt_state, step)
                 cost = float(loss)
                 costs.append(cost)
                 metrics = {}
                 if self.evaluator is not None:
                     self.evaluator.update(jax.device_get(stats))
                     metrics = self.evaluator.result()
-                if (batch_id + 1) % log_period == 0:
-                    pass  # logging is the event handler's job
+                if log_period and (batch_id + 1) % log_period == 0:
+                    msg = " ".join(f"{k}={v:.4f}" for k, v in metrics.items())
+                    _log.info("pass %d batch %d cost=%.4f %s",
+                              pass_id, batch_id + 1, cost, msg)
                 handler(ev.EndIteration(pass_id, batch_id, int(step), cost,
                                         metrics))
-            self.train_state = TrainState(params, state, opt_state, step)
             pass_metrics = (self.evaluator.result()
                             if self.evaluator is not None else {})
             pass_metrics["mean_cost"] = float(np.mean(costs)) if costs else 0.0

@@ -1,0 +1,135 @@
+"""Seq2seq + tagging model tests: training reduces loss, beam search decodes
+the learned mapping (the analog of test_recurrent_machine_generation golden
+tests), CRF taggers learn synthetic transitions."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import data, optim
+from paddle_tpu.data import datasets
+from paddle_tpu.models import Seq2SeqAttention, RnnCrfTagger, LinearCrfTagger
+from paddle_tpu.models.seq2seq import BOS, EOS, PAD
+from paddle_tpu.train import Trainer
+
+
+def nmt_batches(batch_size=32, n=256, max_len=8, vocab=50):
+    """Tiny copy-task NMT data: target = source (easy to learn fast)."""
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(n // batch_size):
+            lens = rng.randint(2, max_len - 1, size=batch_size)
+            src = np.zeros((batch_size, max_len), np.int32)
+            tgt = np.zeros((batch_size, max_len + 1), np.int32)
+            for i, L in enumerate(lens):
+                toks = rng.randint(3, vocab, size=L)
+                src[i, :L] = toks
+                tgt[i, 0] = BOS
+                tgt[i, 1:L + 1] = toks
+                # append EOS if room
+                if L + 1 <= max_len:
+                    tgt[i, L + 1 if L + 1 <= max_len else L] = EOS
+            yield {"src": src, "src_len": lens.astype(np.int32),
+                   "tgt": tgt, "tgt_len": (lens + 2).astype(np.int32)}
+    return reader
+
+
+@pytest.fixture(scope="module")
+def trained_nmt():
+    model = Seq2SeqAttention(50, 50, emb_dim=32, hidden=64)
+    tr = Trainer(model=model,
+                 loss_fn=lambda out, b: out,   # model returns per-example loss
+                 optimizer=optim.adam(5e-3),
+                 forward=lambda m, v, b, train, rngs: (m.apply(v, b), v["state"]))
+    reader = nmt_batches()
+    tr.init(jax.random.PRNGKey(0), next(iter(reader())))
+    costs = []
+    from paddle_tpu.train import events as ev
+
+    def handler(e):
+        if isinstance(e, ev.EndPass):
+            costs.append(e.metrics["mean_cost"])
+
+    tr.train(reader, num_passes=30, event_handler=handler)
+    return model, tr, costs
+
+
+def test_nmt_loss_decreases(trained_nmt):
+    _, _, costs = trained_nmt
+    assert costs[-1] < 0.25 * costs[0], costs
+
+
+def test_beam_search_decodes_copy_task(trained_nmt):
+    model, tr, _ = trained_nmt
+    variables = {"params": tr.train_state.params, "state": tr.train_state.state}
+    rng = np.random.RandomState(7)
+    L = 4
+    src = np.zeros((2, 8), np.int32)
+    toks = [rng.randint(3, 50, size=L) for _ in range(2)]
+    for i in range(2):
+        src[i, :L] = toks[i]
+    tokens, scores = model.generate(variables, jnp.asarray(src),
+                                    jnp.asarray([L, L]), beam_size=3,
+                                    max_len=8)
+    assert tokens.shape == (2, 3, 8)
+    # best beam reproduces the source prefix
+    for i in range(2):
+        got = np.asarray(tokens[i, 0])
+        np.testing.assert_array_equal(got[:L], toks[i])
+    # scores sorted best-first
+    assert (np.diff(np.asarray(scores), axis=1) <= 1e-5).all()
+
+
+def test_beam_is_jittable(trained_nmt):
+    model, tr, _ = trained_nmt
+    variables = {"params": tr.train_state.params, "state": tr.train_state.state}
+
+    @jax.jit
+    def gen(src, src_len):
+        return model.generate(variables, src, src_len, beam_size=2, max_len=6)
+
+    t, s = gen(jnp.ones((1, 8), jnp.int32) * 5, jnp.asarray([3]))
+    assert t.shape == (1, 2, 6)
+
+
+def tagging_batches(batch_size=32, n=512, max_len=12, vocab=100, n_tags=4):
+    """Tags depend on token value range — learnable by emissions alone; a
+    sticky-previous rule adds transition structure for the CRF."""
+    rng = np.random.RandomState(1)
+
+    def reader():
+        for _ in range(n // batch_size):
+            lens = rng.randint(3, max_len, size=batch_size)
+            toks = np.zeros((batch_size, max_len), np.int32)
+            tags = np.zeros((batch_size, max_len), np.int32)
+            for i, L in enumerate(lens):
+                tk = rng.randint(0, vocab, size=L)
+                toks[i, :L] = tk
+                tags[i, :L] = (tk * n_tags) // vocab
+            yield {"tokens": toks, "length": lens.astype(np.int32),
+                   "label": tags}
+    return reader
+
+
+@pytest.mark.parametrize("cls", [RnnCrfTagger, LinearCrfTagger])
+def test_crf_taggers_learn(cls):
+    model = (cls(100, 4, emb_dim=16, hidden=32) if cls is RnnCrfTagger
+             else cls(100, 4))
+    tr = Trainer(model=model,
+                 loss_fn=lambda out, b: out,
+                 optimizer=optim.adam(1e-2 if cls is RnnCrfTagger else 3e-2),
+                 forward=lambda m, v, b, train, rngs: (m.apply(v, b),
+                                                       v["state"]))
+    reader = tagging_batches()
+    tr.init(jax.random.PRNGKey(0), next(iter(reader())))
+    tr.train(reader, num_passes=4)
+    variables = {"params": tr.train_state.params, "state": tr.train_state.state}
+    batch = next(iter(reader()))
+    batch = {k: jnp.asarray(v) for k, v in batch.items()}
+    pred = model.apply(variables, batch, method="decode")
+    mask = np.arange(12)[None, :] < np.asarray(batch["length"])[:, None]
+    acc = (np.asarray(pred) == np.asarray(batch["label"]))[mask].mean()
+    assert acc > 0.9, acc

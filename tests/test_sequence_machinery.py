@@ -1,0 +1,293 @@
+"""Sequence machinery tests: RNN masking/packing semantics, CRF and CTC vs
+brute-force oracles (the analog of test_CRFLayerGrad / LinearChainCTC tests),
+sequence ops vs numpy, attention shapes/masking."""
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import sequence_ops as sq
+from paddle_tpu.nn.crf import crf_log_likelihood, crf_decode
+from paddle_tpu.nn.ctc import ctc_loss, ctc_greedy_decode
+
+
+# ---------------------------------------------------------------- RNN / cells
+
+def test_lstm_shapes_and_mask_freeze(rng):
+    cell = nn.LSTMCell(8)
+    rnn = nn.RNN(cell)
+    x = jax.random.normal(rng, (3, 5, 4))
+    lengths = jnp.array([5, 3, 0])
+    mask = (jnp.arange(5)[None, :] < lengths[:, None]).astype(jnp.float32)
+    vs = rnn.init(rng, x, mask=mask)
+    out, (h, c) = rnn.apply(vs, x, mask=mask)
+    assert out.shape == (3, 5, 8)
+    # padded outputs are zero
+    np.testing.assert_array_equal(np.asarray(out[1, 3:]), 0.0)
+    # frozen state equals state at last valid step
+    out2, (h2, c2) = rnn.apply(vs, x[:, :3], mask=mask[:, :3])
+    np.testing.assert_allclose(np.asarray(h[1]), np.asarray(h2[1]), rtol=1e-5)
+
+
+def test_rnn_reverse_matches_flipped(rng):
+    cell = nn.GRUCell(6)
+    fwd = nn.RNN(cell)
+    x = jax.random.normal(rng, (2, 4, 3))
+    vs = fwd.init(rng, x)
+    rev = nn.RNN(cell, reverse=True)
+    out_r, _ = rev.apply(vs, x)
+    out_f, _ = fwd.apply(vs, x[:, ::-1])
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_f[:, ::-1]),
+                               rtol=1e-5)
+
+
+def test_rnn_segment_reset(rng):
+    """State resets at packed-segment starts: two packed sequences in one row
+    must equal the same sequences run in separate rows."""
+    cell = nn.LSTMCell(5, use_peepholes=False)
+    rnn = nn.RNN(cell)
+    a = jax.random.normal(rng, (1, 2, 3))
+    bx = jax.random.normal(jax.random.fold_in(rng, 1), (1, 3, 3))
+    packed = jnp.concatenate([a, bx], axis=1)           # [1, 5, 3]
+    seg_starts = jnp.array([[1, 0, 1, 0, 0]], jnp.float32)
+    vs = rnn.init(rng, packed, segment_starts=seg_starts)
+    out_packed, _ = rnn.apply(vs, packed, segment_starts=seg_starts)
+    out_a, _ = rnn.apply(vs, a)
+    out_b, _ = rnn.apply(vs, bx)
+    np.testing.assert_allclose(np.asarray(out_packed[0, :2]),
+                               np.asarray(out_a[0]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_packed[0, 2:]),
+                               np.asarray(out_b[0]), rtol=1e-5)
+
+
+def test_bidirectional(rng):
+    bi = nn.BiRNN(nn.GRUCell(4), nn.GRUCell(4))
+    x = jax.random.normal(rng, (2, 6, 3))
+    vs = bi.init(rng, x)
+    assert bi.apply(vs, x).shape == (2, 6, 8)
+
+
+def test_rnn_grad_flows(rng):
+    rnn = nn.RNN(nn.LSTMCell(4))
+    x = jax.random.normal(rng, (2, 3, 3))
+    vs = rnn.init(rng, x)
+
+    def loss(p):
+        out, _ = rnn.apply({"params": p, "state": {}}, x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(vs["params"])
+    total = sum(float(jnp.abs(l).sum()) for l in jax.tree_util.tree_leaves(g))
+    assert total > 0
+
+
+# ---------------------------------------------------------------- sequence ops
+
+def test_seq_pool_kinds():
+    x = jnp.array([[[1.0], [2.0], [3.0]], [[4.0], [5.0], [6.0]]])
+    lengths = jnp.array([2, 3])
+    np.testing.assert_allclose(np.asarray(sq.seq_pool(x, lengths, "sum")),
+                               [[3.0], [15.0]])
+    np.testing.assert_allclose(np.asarray(sq.seq_pool(x, lengths, "average")),
+                               [[1.5], [5.0]])
+    np.testing.assert_allclose(np.asarray(sq.seq_pool(x, lengths, "max")),
+                               [[2.0], [6.0]])
+    np.testing.assert_allclose(np.asarray(sq.seq_last(x, lengths)),
+                               [[2.0], [6.0]])
+    np.testing.assert_allclose(np.asarray(sq.seq_first(x, lengths)),
+                               [[1.0], [4.0]])
+
+
+def test_seq_concat_and_expand():
+    a = jnp.arange(4.0).reshape(2, 2, 1)
+    b = jnp.arange(10.0, 16.0).reshape(2, 3, 1)
+    out, lens = sq.seq_concat(a, jnp.array([1, 2]), b, jnp.array([3, 1]))
+    np.testing.assert_array_equal(np.asarray(lens), [4, 3])
+    np.testing.assert_allclose(np.asarray(out[0, :4, 0]), [0, 10, 11, 12])
+    np.testing.assert_allclose(np.asarray(out[1, :3, 0]), [2, 3, 13])
+    v = jnp.array([[7.0], [9.0]])
+    e = sq.seq_expand(v, jnp.array([2, 1]), 3)
+    np.testing.assert_allclose(np.asarray(e[:, :, 0]),
+                               [[7, 7, 0], [9, 0, 0]])
+
+
+def test_kmax_and_maxid():
+    s = jnp.array([[0.1, 0.9, 0.5, 0.7]])
+    idx = sq.kmax_scores(s, jnp.array([3]), 2)
+    assert set(np.asarray(idx[0]).tolist()) == {1, 2}
+    assert int(sq.max_id(s)[0]) == 1
+
+
+# ---------------------------------------------------------------- CRF oracle
+
+def brute_force_crf(emissions, tags_all, start, stop, trans, length):
+    """Enumerate all paths for log Z."""
+    L = emissions.shape[-1]
+    scores = []
+    gold = None
+    for path in itertools.product(range(L), repeat=length):
+        s = start[path[0]] + emissions[0, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emissions[t, path[t]]
+        s += stop[path[-1]]
+        scores.append(s)
+    return np.logaddexp.reduce(scores)
+
+
+def test_crf_loss_matches_bruteforce(rng, nprng):
+    B, T, L = 2, 4, 3
+    em = nprng.randn(B, T, L).astype(np.float32)
+    w = nprng.randn(L + 2, L).astype(np.float32) * 0.5
+    tags = nprng.randint(0, L, (B, T)).astype(np.int32)
+    lengths = np.array([4, 2], np.int32)
+    nll = np.asarray(crf_log_likelihood(jnp.asarray(em), jnp.asarray(tags),
+                                        jnp.asarray(lengths), jnp.asarray(w)))
+    for b in range(B):
+        Lb = lengths[b]
+        logz = brute_force_crf(em[b], None, w[0], w[1], w[2:], Lb)
+        gold = w[0][tags[b, 0]] + em[b, 0, tags[b, 0]]
+        for t in range(1, Lb):
+            gold += w[2:][tags[b, t - 1], tags[b, t]] + em[b, t, tags[b, t]]
+        gold += w[1][tags[b, Lb - 1]]
+        np.testing.assert_allclose(nll[b], logz - gold, rtol=1e-4)
+
+
+def test_crf_decode_matches_bruteforce(nprng):
+    T, L = 5, 3
+    em = nprng.randn(1, T, L).astype(np.float32)
+    w = nprng.randn(L + 2, L).astype(np.float32)
+    lengths = np.array([T], np.int32)
+    got = np.asarray(crf_decode(jnp.asarray(em), jnp.asarray(lengths),
+                                jnp.asarray(w)))[0]
+    best, best_s = None, -np.inf
+    for path in itertools.product(range(L), repeat=T):
+        s = w[0][path[0]] + em[0, 0, path[0]]
+        for t in range(1, T):
+            s += w[2:][path[t - 1], path[t]] + em[0, t, path[t]]
+        s += w[1][path[-1]]
+        if s > best_s:
+            best, best_s = path, s
+    np.testing.assert_array_equal(got, best)
+
+
+def test_crf_grad_is_finite(rng, nprng):
+    em = jnp.asarray(nprng.randn(2, 4, 3), jnp.float32)
+    tags = jnp.zeros((2, 4), jnp.int32)
+    lengths = jnp.array([4, 3])
+    crf = nn.CRF(3)
+    vs = crf.init(rng, em, tags, lengths)
+
+    def loss(p):
+        return crf.apply({"params": p, "state": {}}, em, tags, lengths).sum()
+
+    g = jax.tree_util.tree_leaves(jax.grad(loss)(vs["params"]))[0]
+    assert np.isfinite(np.asarray(g)).all()
+
+
+# ---------------------------------------------------------------- CTC oracle
+
+def brute_force_ctc(log_probs, label, T, blank=0):
+    """Sum over all alignments: enumerate all T-length paths, collapse, match."""
+    V = log_probs.shape[-1]
+    total = -np.inf
+    for path in itertools.product(range(V), repeat=T):
+        # collapse
+        col = []
+        prev = None
+        for p in path:
+            if p != prev and p != blank:
+                col.append(p)
+            prev = p
+        if col == list(label):
+            s = sum(log_probs[t, path[t]] for t in range(T))
+            total = np.logaddexp(total, s)
+    return -total
+
+
+def test_ctc_matches_bruteforce(nprng):
+    T, V = 4, 3
+    logits = nprng.randn(1, T, V).astype(np.float32)
+    lp = jax.nn.log_softmax(jnp.asarray(logits), -1)
+    label = [1, 2]
+    loss = float(ctc_loss(lp, jnp.array([T]), jnp.array([label]),
+                          jnp.array([2]))[0])
+    want = brute_force_ctc(np.asarray(lp[0]), label, T)
+    np.testing.assert_allclose(loss, want, rtol=1e-4)
+
+
+def test_ctc_repeated_label(nprng):
+    T, V = 5, 3
+    lp = jax.nn.log_softmax(jnp.asarray(nprng.randn(1, T, V), jnp.float32), -1)
+    label = [1, 1]  # repeated label requires a blank between
+    loss = float(ctc_loss(lp, jnp.array([T]), jnp.array([label]),
+                          jnp.array([2]))[0])
+    want = brute_force_ctc(np.asarray(lp[0]), label, T)
+    np.testing.assert_allclose(loss, want, rtol=1e-4)
+
+
+def test_ctc_batch_and_varlen(nprng):
+    T, V, U = 6, 4, 3
+    lp = jax.nn.log_softmax(jnp.asarray(nprng.randn(3, T, V), jnp.float32), -1)
+    labels = jnp.array([[1, 2, 3], [2, 2, 0], [1, 0, 0]])
+    in_len = jnp.array([6, 5, 3])
+    lab_len = jnp.array([3, 2, 1])
+    losses = np.asarray(ctc_loss(lp, in_len, labels, lab_len))
+    assert np.isfinite(losses).all()
+    for b, (il, ll) in enumerate([(6, 3), (5, 2), (3, 1)]):
+        want = brute_force_ctc(np.asarray(lp[b, :il]),
+                               list(np.asarray(labels[b, :ll])), il)
+        np.testing.assert_allclose(losses[b], want, rtol=1e-3)
+
+
+def test_ctc_grad_finite(nprng):
+    lp_logits = jnp.asarray(nprng.randn(2, 5, 4), jnp.float32)
+
+    def loss(z):
+        lp = jax.nn.log_softmax(z, -1)
+        return ctc_loss(lp, jnp.array([5, 4]), jnp.array([[1, 2], [3, 0]]),
+                        jnp.array([2, 1])).sum()
+
+    g = jax.grad(loss)(lp_logits)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_ctc_greedy_decode():
+    # frames argmax: [1, 1, 0, 2, 2] -> collapse -> [1, 2]
+    lp = jnp.log(jnp.asarray([[
+        [0.1, 0.8, 0.1], [0.1, 0.8, 0.1], [0.8, 0.1, 0.1],
+        [0.1, 0.1, 0.8], [0.1, 0.1, 0.8]]]))
+    dec, lens = ctc_greedy_decode(lp, jnp.array([5]))
+    assert int(lens[0]) == 2
+    np.testing.assert_array_equal(np.asarray(dec[0, :2]), [1, 2])
+
+
+# ---------------------------------------------------------------- attention
+
+def test_additive_attention_masks(rng):
+    att = nn.AdditiveAttention(8)
+    dec = jax.random.normal(rng, (2, 6))
+    enc = jax.random.normal(rng, (2, 5, 7))
+    mask = jnp.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+    vs = att.init(rng, dec, enc, mask)
+    ctx, w = att.apply(vs, dec, enc, mask)
+    assert ctx.shape == (2, 7)
+    np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(w[0, 3:]), 0.0)
+
+
+def test_multihead_attention_causal(rng):
+    mha = nn.MultiHeadAttention(num_heads=2)
+    x = jax.random.normal(rng, (1, 4, 8))
+    causal = jnp.tril(jnp.ones((4, 4)))[None]
+    vs = mha.init(rng, x, mask=causal)
+    out = mha.apply(vs, x, mask=causal)
+    assert out.shape == (1, 4, 8)
+    # causality: output at t=0 must not depend on x at t>0
+    x2 = x.at[:, 2:].set(0.0)
+    out2 = mha.apply(vs, x2, mask=causal)
+    np.testing.assert_allclose(np.asarray(out[:, :2]), np.asarray(out2[:, :2]),
+                               rtol=1e-4)
