@@ -1,6 +1,9 @@
 """Model zoo — the acceptance workloads from BASELINE.json (MNIST LeNet,
 ResNet, seq2seq attention NMT, sequence tagging, CTR) built on paddle_tpu.nn."""
 
+from .image_zoo import AlexNet, GoogLeNet, VGG, vgg16, vgg19
 from .mnist import LeNet, MnistMLP
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, resnet_cifar)
 from .seq2seq import Seq2SeqAttention
 from .tagging import LinearCrfTagger, RnnCrfTagger

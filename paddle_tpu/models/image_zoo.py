@@ -1,0 +1,157 @@
+"""VGG, AlexNet, GoogLeNet — the reference's image benchmark set.
+
+Reference configs: ``/root/reference/benchmark/paddle/image/vgg.py``,
+``alexnet.py``, ``googlenet.py``; the v1 DSL composite
+``trainer_config_helpers/networks.py:468`` (``vgg_16_network``,
+``img_conv_group``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..core.module import Module
+from .. import nn
+
+__all__ = ["VGG", "vgg16", "vgg19", "AlexNet", "GoogLeNet"]
+
+
+class ConvGroup(Module):
+    """n × (conv3x3 + relu) [+ BN] + maxpool (reference: img_conv_group,
+    networks.py:376)."""
+
+    def __init__(self, features: int, n: int, use_bn: bool = True,
+                 dropout: float = 0.0, name=None):
+        super().__init__(name=name)
+        self.convs = [nn.Conv2D(features, 3, act="" if use_bn else "relu",
+                                use_bias=not use_bn, name=f"conv{i}")
+                      for i in range(n)]
+        self.bns = ([nn.BatchNorm(name=f"bn{i}") for i in range(n)]
+                    if use_bn else None)
+        self.dropout = nn.Dropout(dropout) if dropout > 0 else None
+        self.pool = nn.Pool2D("max", 2)
+
+    def forward(self, x, train=False):
+        for i, conv in enumerate(self.convs):
+            x = conv(x)
+            if self.bns is not None:
+                x = jnp.maximum(self.bns[i](x, train=train), 0.0)
+            if self.dropout is not None:
+                x = self.dropout(x, train=train)
+        return self.pool(x)
+
+
+class VGG(Module):
+    """VGG-16/19 (reference: benchmark/paddle/image/vgg.py; vgg_16_network)."""
+
+    def __init__(self, cfg: Sequence[int], num_classes: int = 1000,
+                 use_bn: bool = True, name=None):
+        super().__init__(name=name)
+        feats = [64, 128, 256, 512, 512]
+        self.groups = [ConvGroup(f, n, use_bn=use_bn, name=f"group{i}")
+                       for i, (f, n) in enumerate(zip(feats, cfg))]
+        self.do1 = nn.Dropout(0.5)
+        self.fc1 = nn.Linear(4096, act="relu", name="fc1")
+        self.do2 = nn.Dropout(0.5)
+        self.fc2 = nn.Linear(4096, act="relu", name="fc2")
+        self.out = nn.Linear(num_classes, name="out")
+
+    def forward(self, x, train=False):
+        for g in self.groups:
+            x = g(x, train=train)
+        x = x.reshape(x.shape[0], -1)
+        x = self.do1(self.fc1(x), train=train)
+        x = self.do2(self.fc2(x), train=train)
+        return self.out(x)
+
+
+def vgg16(num_classes=1000, use_bn=True):
+    return VGG([2, 2, 3, 3, 3], num_classes, use_bn)
+
+
+def vgg19(num_classes=1000, use_bn=True):
+    return VGG([2, 2, 4, 4, 4], num_classes, use_bn)
+
+
+class AlexNet(Module):
+    """AlexNet (reference: benchmark/paddle/image/alexnet.py)."""
+
+    def __init__(self, num_classes: int = 1000, name=None):
+        super().__init__(name=name)
+        self.c1 = nn.Conv2D(96, 11, stride=4, padding="VALID", act="relu",
+                            name="c1")
+        self.c2 = nn.Conv2D(256, 5, act="relu", groups=1, name="c2")
+        self.c3 = nn.Conv2D(384, 3, act="relu", name="c3")
+        self.c4 = nn.Conv2D(384, 3, act="relu", name="c4")
+        self.c5 = nn.Conv2D(256, 3, act="relu", name="c5")
+        self.pool = nn.Pool2D("max", 3, stride=2, padding="VALID")
+        self.do1 = nn.Dropout(0.5)
+        self.fc1 = nn.Linear(4096, act="relu", name="fc1")
+        self.do2 = nn.Dropout(0.5)
+        self.fc2 = nn.Linear(4096, act="relu", name="fc2")
+        self.out = nn.Linear(num_classes, name="out")
+
+    def forward(self, x, train=False):
+        h = self.pool(self.c1(x))
+        h = self.pool(self.c2(h))
+        h = self.c4(self.c3(h))
+        h = self.pool(self.c5(h))
+        h = h.reshape(h.shape[0], -1)
+        h = self.do1(self.fc1(h), train=train)
+        h = self.do2(self.fc2(h), train=train)
+        return self.out(h)
+
+
+class Inception(Module):
+    """GoogLeNet inception block (reference: benchmark/paddle/image/
+    googlenet.py ``inception``): 1x1 / 3x3 / 5x5 / pool-proj branches."""
+
+    def __init__(self, c1, c3r, c3, c5r, c5, proj, name=None):
+        super().__init__(name=name)
+        self.b1 = nn.Conv2D(c1, 1, act="relu", name="b1")
+        self.b3r = nn.Conv2D(c3r, 1, act="relu", name="b3r")
+        self.b3 = nn.Conv2D(c3, 3, act="relu", name="b3")
+        self.b5r = nn.Conv2D(c5r, 1, act="relu", name="b5r")
+        self.b5 = nn.Conv2D(c5, 5, act="relu", name="b5")
+        self.pool = nn.Pool2D("max", 3, stride=1, padding="SAME")
+        self.bp = nn.Conv2D(proj, 1, act="relu", name="bp")
+
+    def forward(self, x):
+        return jnp.concatenate([
+            self.b1(x), self.b3(self.b3r(x)), self.b5(self.b5r(x)),
+            self.bp(self.pool(x))], axis=-1)
+
+
+class GoogLeNet(Module):
+    """GoogLeNet v1 (reference: benchmark/paddle/image/googlenet.py), without
+    the auxiliary towers (benchmark config also drops them)."""
+
+    def __init__(self, num_classes: int = 1000, name=None):
+        super().__init__(name=name)
+        self.stem1 = nn.Conv2D(64, 7, stride=2, act="relu", name="stem1")
+        self.stem2 = nn.Conv2D(64, 1, act="relu", name="stem2")
+        self.stem3 = nn.Conv2D(192, 3, act="relu", name="stem3")
+        self.pool = nn.Pool2D("max", 3, stride=2, padding="SAME")
+        cfg = [
+            (64, 96, 128, 16, 32, 32), (128, 128, 192, 32, 96, 64),  # 3a 3b
+            (192, 96, 208, 16, 48, 64), (160, 112, 224, 24, 64, 64),  # 4a 4b
+            (128, 128, 256, 24, 64, 64), (112, 144, 288, 32, 64, 64),  # 4c 4d
+            (256, 160, 320, 32, 128, 128),                             # 4e
+            (256, 160, 320, 32, 128, 128), (384, 192, 384, 48, 128, 128),  # 5
+        ]
+        self.inc = [Inception(*c, name=f"inc{i}") for i, c in enumerate(cfg)]
+        self.dropout = nn.Dropout(0.4)
+        self.out = nn.Linear(num_classes, name="out")
+
+    def forward(self, x, train=False):
+        h = self.pool(self.stem1(x))
+        h = self.pool(self.stem3(self.stem2(h)))
+        for i, blk in enumerate(self.inc):
+            h = blk(h)
+            if i in (1, 6):
+                h = self.pool(h)
+        h = jnp.mean(h, axis=(1, 2))
+        h = self.dropout(h, train=train)
+        return self.out(h)

@@ -1,0 +1,152 @@
+"""ResNet family — the reference's model-zoo flagship and benchmark workload.
+
+Reference: ``/root/reference/v1_api_demo/model_zoo/resnet/resnet.py:171-253``
+(conv_bn_layer / shortcut / basicblock / bottleneck; depth 18/34/50/101/152)
+and ``benchmark/paddle/image/resnet.py``. TPU-native: NHWC layout, bf16 compute
+policy, BN running stats as module state; the residual topology maps 1:1.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..core.module import Module
+from .. import nn
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "resnet152", "resnet_cifar"]
+
+
+class ConvBN(Module):
+    """conv + batchnorm + activation (reference: conv_bn_layer,
+    resnet.py:171)."""
+
+    def __init__(self, features, kernel, stride=1, act="relu", name=None):
+        super().__init__(name=name)
+        self.conv = nn.Conv2D(features, kernel, stride=stride, padding="SAME",
+                              act="", use_bias=False, name="conv")
+        self.bn = nn.BatchNorm(name="bn")
+        self.act = nn.activations.get(act)
+
+    def forward(self, x, train=False):
+        return self.act(self.bn(self.conv(x), train=train))
+
+
+class BasicBlock(Module):
+    """3x3+3x3 residual block (reference: basicblock, resnet.py:205)."""
+
+    expansion = 1
+
+    def __init__(self, features, stride=1, name=None):
+        super().__init__(name=name)
+        self.c1 = ConvBN(features, 3, stride=stride, name="c1")
+        self.c2 = ConvBN(features, 3, act="", name="c2")
+        self.stride = stride
+        self.features = features
+
+    def forward(self, x, train=False):
+        h = self.c2(self.c1(x, train=train), train=train)
+        if self.stride != 1 or x.shape[-1] != self.features:
+            x = ConvBN(self.features, 1, stride=self.stride, act="",
+                       name="shortcut")(x, train=train)
+        return jnp.maximum(h + x, 0.0)
+
+
+class Bottleneck(Module):
+    """1x1-3x3-1x1 bottleneck (reference: bottleneck, resnet.py:219)."""
+
+    expansion = 4
+
+    def __init__(self, features, stride=1, name=None):
+        super().__init__(name=name)
+        self.c1 = ConvBN(features, 1, name="c1")
+        self.c2 = ConvBN(features, 3, stride=stride, name="c2")
+        self.c3 = ConvBN(features * 4, 1, act="", name="c3")
+        self.stride = stride
+        self.features = features
+
+    def forward(self, x, train=False):
+        h = self.c3(self.c2(self.c1(x, train=train), train=train), train=train)
+        out_ch = self.features * 4
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            x = ConvBN(out_ch, 1, stride=self.stride, act="",
+                       name="shortcut")(x, train=train)
+        return jnp.maximum(h + x, 0.0)
+
+
+class ResNet(Module):
+    """ImageNet-shape ResNet (reference: resnet.py:232 ``deep_res_net``)."""
+
+    def __init__(self, block, layers: Sequence[int], num_classes: int = 1000,
+                 name=None):
+        super().__init__(name=name)
+        self.stem = ConvBN(64, 7, stride=2, name="stem")
+        self.pool = nn.Pool2D("max", 3, stride=2, padding="SAME")
+        self.stages = []
+        feats = [64, 128, 256, 512]
+        for si, (f, n) in enumerate(zip(feats, layers)):
+            blocks = []
+            for bi in range(n):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                blocks.append(block(f, stride=stride,
+                                    name=f"stage{si}_block{bi}"))
+            self.stages.append(blocks)
+        # register for naming
+        self.all_blocks = [b for s in self.stages for b in s]
+        self.head = nn.Linear(num_classes, name="fc")
+
+    def forward(self, x, train=False):
+        h = self.pool(self.stem(x, train=train))
+        for stage in self.stages:
+            for blk in stage:
+                h = blk(h, train=train)
+        h = jnp.mean(h, axis=(1, 2))
+        return self.head(h)
+
+
+def resnet18(num_classes=1000):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes)
+
+
+def resnet34(num_classes=1000):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes)
+
+
+def resnet50(num_classes=1000):
+    return ResNet(Bottleneck, [3, 4, 6, 3], num_classes)
+
+
+def resnet101(num_classes=1000):
+    return ResNet(Bottleneck, [3, 4, 23, 3], num_classes)
+
+
+def resnet152(num_classes=1000):
+    return ResNet(Bottleneck, [3, 8, 36, 3], num_classes)
+
+
+class ResNetCifar(Module):
+    """CIFAR-shape ResNet (3 stages, 32x32 stem) — the benchmark SmallNet
+    analog (``benchmark/paddle/image/smallnet_mnist_cifar.py`` scale)."""
+
+    def __init__(self, depth_n: int = 3, num_classes: int = 10, name=None):
+        super().__init__(name=name)
+        self.stem = ConvBN(16, 3, name="stem")
+        self.blocks = []
+        for si, f in enumerate([16, 32, 64]):
+            for bi in range(depth_n):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                self.blocks.append(BasicBlock(f, stride=stride,
+                                              name=f"s{si}_b{bi}"))
+        self.head = nn.Linear(num_classes, name="fc")
+
+    def forward(self, x, train=False):
+        h = self.stem(x, train=train)
+        for blk in self.blocks:
+            h = blk(h, train=train)
+        return self.head(jnp.mean(h, axis=(1, 2)))
+
+
+def resnet_cifar(depth_n=3, num_classes=10):
+    return ResNetCifar(depth_n, num_classes)

@@ -1,0 +1,81 @@
+"""Image model zoo tests: shapes, BN state threading, cifar-ResNet training."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu import optim
+from paddle_tpu.core.dtypes import bfloat16_compute, use_policy
+from paddle_tpu.models import (AlexNet, GoogLeNet, resnet18, resnet50,
+                               resnet_cifar, vgg16)
+from paddle_tpu.nn import costs
+from paddle_tpu.train import Trainer, ClassificationError
+
+
+def n_params(tree):
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(tree))
+
+
+def test_resnet50_param_count(rng):
+    m = resnet50(num_classes=1000)
+    x = jnp.zeros((1, 64, 64, 3))  # small spatial for test speed
+    vs = m.init(rng, x, train=True)
+    # canonical ResNet-50: ~25.5M params
+    n = n_params(vs["params"])
+    assert 25_000_000 < n < 26_100_000, n
+    out = m.apply(vs, x)
+    assert out.shape == (1, 1000)
+
+
+def test_resnet18_forward_and_bn_state(rng):
+    m = resnet18(num_classes=10)
+    x = jax.random.normal(rng, (2, 32, 32, 3))
+    vs = m.init(rng, x, train=True)
+    out, new = m.apply(vs, x, train=True, mutable=("state",))
+    assert out.shape == (2, 10)
+    # BN means moved
+    before = jax.tree_util.tree_leaves(vs["state"])
+    after = jax.tree_util.tree_leaves(new["state"])
+    moved = sum(float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+                for a, b in zip(before, after))
+    assert moved > 0
+
+
+@pytest.mark.parametrize("ctor,shape", [
+    (lambda: AlexNet(10), (1, 227, 227, 3)),
+    (lambda: vgg16(10), (1, 32, 32, 3)),
+    (lambda: GoogLeNet(10), (1, 64, 64, 3)),
+])
+def test_zoo_forward_shapes(ctor, shape, rng):
+    m = ctor()
+    x = jnp.zeros(shape)
+    vs = m.init(rng, x, train=True)
+    assert m.apply(vs, x).shape == (1, 10)
+
+
+def test_bf16_policy_resnet(rng):
+    with use_policy(bfloat16_compute):
+        m = resnet_cifar(depth_n=1)
+        x = jax.random.normal(rng, (2, 32, 32, 3))
+        vs = m.init(rng, x, train=True)
+        out = m.apply(vs, x)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+def test_cifar_resnet_trains(rng):
+    from paddle_tpu.data import datasets, batched, map_readers
+    m = resnet_cifar(depth_n=1)
+    tr = Trainer(model=m,
+                 loss_fn=lambda o, b: costs.softmax_cross_entropy(o, b["label"]),
+                 optimizer=optim.adam(2e-3),
+                 evaluator=ClassificationError())
+    r = datasets.cifar10("train", synthetic_n=256)
+    reader = batched(map_readers(lambda s: {"x": s[0], "label": s[1]}, r), 64)
+    tr.init(jax.random.PRNGKey(0), next(iter(reader())))
+    from paddle_tpu.train import events as ev
+    accs = []
+    tr.train(reader, num_passes=8,
+             event_handler=lambda e: accs.append(e.metrics["accuracy"])
+             if isinstance(e, ev.EndPass) else None)
+    assert accs[-1] > 0.8, accs
