@@ -153,7 +153,7 @@ def lambda_rank_ndcg(scores, relevance, lengths=None, sigma: float = 1.0,
         jnp.maximum(idcg, 1e-9)[:, None, None]
     pair_valid = valid[:, :, None] & valid[:, None, :] & \
         (r[:, :, None] > r[:, None, :])
-    logistic = jnp.log1p(jnp.exp(-sigma * diff_s))
+    logistic = _softplus(-sigma * diff_s)
     return (delta * logistic * pair_valid).sum((1, 2))
 
 

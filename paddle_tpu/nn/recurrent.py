@@ -180,6 +180,15 @@ class RNN(Module):
                 out = out * keep
             return new_state, out
 
+        if self.reverse and segment_starts is not None:
+            # The reversed scan enters each packed segment at its END, so the
+            # reset flags must fire there: end[t] = start[t+1] (and the last
+            # position always ends a segment), computed in original order and
+            # reversed with the rest of the inputs below.
+            segment_starts = jnp.concatenate(
+                [segment_starts[:, 1:],
+                 jnp.ones_like(segment_starts[:, :1])], axis=1)
+
         xs = jnp.swapaxes(x, 0, 1)                      # [T, B, D]
         ms = None if mask is None else jnp.swapaxes(mask, 0, 1)
         ss = None if segment_starts is None else jnp.swapaxes(segment_starts,

@@ -161,22 +161,42 @@ class ChunkEvaluator(Evaluator):
         return {"pred": outputs, "gold": batch["label"],
                 "length": batch["length"]}
 
-    def _chunks(self, tags, length):
-        """Extract (start, end, type) spans. Encoding (the reference's
-        ``plain`` IOB scheme): B-k = 2k, I-k = 2k+1, O = 2*num_tag_types."""
+    def _chunk_codes(self, tags, lengths):
+        """Vectorized span extraction over the whole [B, T] batch (the
+        reference computes chunk stats per batch in C++,
+        ChunkEvaluator.cpp:294; a per-token Python loop would dominate the
+        host loop on real corpora). Tag encoding (plain IOB): B-k = 2k,
+        I-k = 2k+1, O = 2*num_tag_types. A chunk begins on a B- tag OR on an
+        I-k tag with no active k-span (after O or a different type — the
+        reference's ``isChunkBegin``, ChunkEvaluator.cpp:236). Returns one
+        int64 code per chunk encoding (flat_start, flat_end, type); codes are
+        unique since starts are."""
+        tags = np.asarray(tags)
+        B, T = tags.shape
         o_tag = 2 * self.num_tag_types
-        out = set()
-        start, typ = None, None
-        for t in range(length):
-            tag = int(tags[t])
-            if start is not None and tag != 2 * typ + 1:
-                out.add((start, t - 1, typ))   # current span ends
-                start, typ = None, None
-            if tag < o_tag and tag % 2 == 0:   # B- tag opens a span
-                start, typ = t, tag // 2
-        if start is not None:
-            out.add((start, length - 1, typ))
-        return out
+        valid = np.arange(T)[None, :] < np.asarray(lengths)[:, None]
+        tags = np.where(valid, tags, o_tag)
+        is_o = tags >= o_tag
+        typ = np.where(is_o, -1, tags // 2)
+        is_b = (~is_o) & (tags % 2 == 0)
+        prev_typ = np.concatenate([np.full((B, 1), -1), typ[:, :-1]], axis=1)
+        prev_in = np.concatenate([np.zeros((B, 1), bool), ~is_o[:, :-1]],
+                                 axis=1)
+        # begin: B- tag, or any in-chunk tag not continuing the previous span
+        begins = ~is_o & (is_b | ~(prev_in & (prev_typ == typ)))
+        # a chunk ends at t unless t+1 continues it (in-chunk and not a begin)
+        cont = ~is_o & ~begins
+        next_cont = np.concatenate([cont[:, 1:], np.zeros((B, 1), bool)],
+                                   axis=1)
+        ends = ~is_o & ~next_cont
+        flat_b = np.flatnonzero(begins)
+        flat_e = np.flatnonzero(ends)
+        # begins/ends interleave s1<=e1<s2<=e2… within each row and rows have
+        # equal counts, so row-major flattening keeps the pairing aligned.
+        types = typ.ravel()[flat_b]
+        n = np.int64(B) * T + 1
+        return (flat_b.astype(np.int64) * n + flat_e) * (
+            self.num_tag_types + 1) + types
 
     def reset(self):
         self._correct = self._pred = self._gold = 0
@@ -185,13 +205,11 @@ class ChunkEvaluator(Evaluator):
         pred = np.asarray(stats["pred"])
         gold = np.asarray(stats["gold"])
         lengths = np.asarray(stats["length"])
-        for b in range(pred.shape[0]):
-            L = int(lengths[b])
-            pc = set(self._chunks(pred[b], L))
-            gc = set(self._chunks(gold[b], L))
-            self._correct += len(pc & gc)
-            self._pred += len(pc)
-            self._gold += len(gc)
+        pc = self._chunk_codes(pred, lengths)
+        gc = self._chunk_codes(gold, lengths)
+        self._correct += len(np.intersect1d(pc, gc, assume_unique=True))
+        self._pred += len(pc)
+        self._gold += len(gc)
 
     def result(self):
         p = self._correct / max(1, self._pred)

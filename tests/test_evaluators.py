@@ -1,0 +1,77 @@
+"""Evaluator tests vs brute-force oracles (the analog of the reference's
+evaluator unit tests in ``paddle/gserver/tests/test_Evaluator.cpp``)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.train.evaluators import ChunkEvaluator
+
+
+# ------------------------------------------------------------------- chunk
+
+def _oracle_chunks(tags, length, num_types):
+    """Independent IOB oracle following the reference's isChunkBegin/isChunkEnd
+    (ChunkEvaluator.cpp:236): B- begins; I-k begins when no k-span is active."""
+    chunks = []
+    start = typ = None
+    for t in range(length):
+        tag = int(tags[t])
+        is_o = tag >= 2 * num_types
+        tt = None if is_o else tag // 2
+        is_b = (not is_o) and tag % 2 == 0
+        if start is not None and (is_o or is_b or tt != typ):
+            chunks.append((start, t - 1, typ))
+            start = typ = None
+        if not is_o and start is None:
+            start, typ = t, tt
+    if start is not None:
+        chunks.append((start, length - 1, typ))
+    return set(chunks)
+
+
+def test_chunk_begin_on_i_after_o():
+    """I-tag after O opens a chunk (malformed sequences), matching conlleval."""
+    ev = ChunkEvaluator(num_tag_types=2)
+    # tags: B-0=0 I-0=1 B-1=2 I-1=3 O=4
+    pred = np.array([[4, 1, 1, 4, 3]])          # O I-0 I-0 O I-1
+    gold = np.array([[0, 1, 1, 4, 2]])          # B-0 I-0 I-0 O B-1
+    ev.update({"pred": pred, "gold": gold, "length": np.array([5])})
+    # pred chunks: (1,2,0),(4,4,1); gold chunks: (0,2,0),(4,4,1) → 1 correct
+    assert ev._pred == 2 and ev._gold == 2 and ev._correct == 1
+
+
+def test_chunk_i_after_different_type_begins():
+    def spans(tags):
+        ev = ChunkEvaluator(num_tag_types=3)
+        arr = np.array([tags])
+        ev.update({"pred": arr, "gold": arr,
+                   "length": np.array([len(tags)])})
+        return ev._pred, _oracle_chunks(np.array(tags), len(tags), 3)
+
+    # B-0 I-1 (type switch inside) → two chunks
+    assert spans([0, 3]) == (2, {(0, 0, 0), (1, 1, 1)})
+    # B-0 B-0 → two chunks
+    assert spans([0, 0]) == (2, {(0, 0, 0), (1, 1, 0)})
+    # I-2 at t=0 begins → one chunk
+    assert spans([5, 5]) == (1, {(0, 1, 2)})
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_chunk_vectorized_matches_oracle(seed):
+    """Vectorized batch extraction == per-token oracle on random tag soup."""
+    rng = np.random.RandomState(seed)
+    num_types = 3
+    B, T = 8, 17
+    pred = rng.randint(0, 2 * num_types + 1, size=(B, T))
+    gold = rng.randint(0, 2 * num_types + 1, size=(B, T))
+    lengths = rng.randint(0, T + 1, size=(B,))
+    ev = ChunkEvaluator(num_tag_types=num_types)
+    ev.update({"pred": pred, "gold": gold, "length": lengths})
+    correct = npred = ngold = 0
+    for b in range(B):
+        pc = _oracle_chunks(pred[b], lengths[b], num_types)
+        gc = _oracle_chunks(gold[b], lengths[b], num_types)
+        correct += len(pc & gc)
+        npred += len(pc)
+        ngold += len(gc)
+    assert (ev._correct, ev._pred, ev._gold) == (correct, npred, ngold)

@@ -109,6 +109,17 @@ def test_lambda_rank_prefers_correct_order():
     assert lg < lb
 
 
+def test_lambda_rank_no_overflow_on_extreme_scores():
+    """Strongly mis-ordered pairs (sigma*diff < -88) must stay finite — the
+    logistic term uses softplus, not log1p(exp(.))."""
+    r = jnp.array([[3.0, 0.0]])
+    s = jnp.array([[-200.0, 200.0]])
+    loss = costs.lambda_rank_ndcg(s, r)
+    assert np.isfinite(np.asarray(loss)).all()
+    g = jax.grad(lambda ss: costs.lambda_rank_ndcg(ss, r).sum())(s)
+    assert np.isfinite(np.asarray(g)).all()
+
+
 def test_reduce_masked():
     x = jnp.array([1.0, 2.0, 3.0])
     m = jnp.array([1.0, 1.0, 0.0])

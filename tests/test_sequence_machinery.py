@@ -64,6 +64,26 @@ def test_rnn_segment_reset(rng):
                                np.asarray(out_b[0]), rtol=1e-5)
 
 
+def test_rnn_segment_reset_reversed(rng):
+    """Reversed packed rows: the reversed scan must reset state when entering
+    each segment from its END, so packed == per-segment also for reverse=True
+    (the BiRNN backward pass over packed rows)."""
+    cell = nn.LSTMCell(5, use_peepholes=False)
+    rnn = nn.RNN(cell, reverse=True)
+    a = jax.random.normal(rng, (1, 2, 3))
+    bx = jax.random.normal(jax.random.fold_in(rng, 1), (1, 3, 3))
+    packed = jnp.concatenate([a, bx], axis=1)           # [1, 5, 3]
+    seg_starts = jnp.array([[1, 0, 1, 0, 0]], jnp.float32)
+    vs = rnn.init(rng, packed, segment_starts=seg_starts)
+    out_packed, _ = rnn.apply(vs, packed, segment_starts=seg_starts)
+    out_a, _ = rnn.apply(vs, a)
+    out_b, _ = rnn.apply(vs, bx)
+    np.testing.assert_allclose(np.asarray(out_packed[0, :2]),
+                               np.asarray(out_a[0]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_packed[0, 2:]),
+                               np.asarray(out_b[0]), rtol=1e-5)
+
+
 def test_bidirectional(rng):
     bi = nn.BiRNN(nn.GRUCell(4), nn.GRUCell(4))
     x = jax.random.normal(rng, (2, 6, 3))
