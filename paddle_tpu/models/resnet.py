@@ -43,14 +43,17 @@ class BasicBlock(Module):
         super().__init__(name=name)
         self.c1 = ConvBN(features, 3, stride=stride, name="c1")
         self.c2 = ConvBN(features, 3, act="", name="c2")
+        # Declared here (like every other submodule); only called — and thus
+        # only parameterized — when the block actually changes shape.
+        self.shortcut = ConvBN(features, 1, stride=stride, act="",
+                               name="shortcut")
         self.stride = stride
         self.features = features
 
     def forward(self, x, train=False):
         h = self.c2(self.c1(x, train=train), train=train)
         if self.stride != 1 or x.shape[-1] != self.features:
-            x = ConvBN(self.features, 1, stride=self.stride, act="",
-                       name="shortcut")(x, train=train)
+            x = self.shortcut(x, train=train)
         return jnp.maximum(h + x, 0.0)
 
 
@@ -64,15 +67,15 @@ class Bottleneck(Module):
         self.c1 = ConvBN(features, 1, name="c1")
         self.c2 = ConvBN(features, 3, stride=stride, name="c2")
         self.c3 = ConvBN(features * 4, 1, act="", name="c3")
+        self.shortcut = ConvBN(features * 4, 1, stride=stride, act="",
+                               name="shortcut")
         self.stride = stride
         self.features = features
 
     def forward(self, x, train=False):
         h = self.c3(self.c2(self.c1(x, train=train), train=train), train=train)
-        out_ch = self.features * 4
-        if self.stride != 1 or x.shape[-1] != out_ch:
-            x = ConvBN(out_ch, 1, stride=self.stride, act="",
-                       name="shortcut")(x, train=train)
+        if self.stride != 1 or x.shape[-1] != self.features * 4:
+            x = self.shortcut(x, train=train)
         return jnp.maximum(h + x, 0.0)
 
 

@@ -139,14 +139,16 @@ class Conv2D(Module):
         cin = x.shape[-1]
         w = self.param("w", self.w_init,
                        (kh, kw, cin // self.groups, self.features))
+        # Output stays in compute dtype (the MXU accumulates f32 internally
+        # for bf16 operands); upcasting via preferred_element_type would break
+        # the conv rhs-transpose rule, which requires operand dtypes to match.
         y = lax.conv_general_dilated(
             pol.cast_compute(x), pol.cast_compute(w),
             window_strides=self.stride, padding=self.padding,
             rhs_dilation=self.dilation, feature_group_count=self.groups,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=pol.accum_dtype)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.use_bias:
-            y = y + self.param("b", I.zeros, (self.features,))
+            y = y + self.param("b", I.zeros, (self.features,)).astype(y.dtype)
         return self.act(y)
 
 
@@ -170,10 +172,9 @@ class DepthwiseConv2D(Conv2D):
             pol.cast_compute(x), pol.cast_compute(w),
             window_strides=self.stride, padding=self.padding,
             rhs_dilation=self.dilation, feature_group_count=cin,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=pol.accum_dtype)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.use_bias:
-            y = y + self.param("b", I.zeros, (features,))
+            y = y + self.param("b", I.zeros, (features,)).astype(y.dtype)
         return self.act(y)
 
 
@@ -264,19 +265,23 @@ class BatchNorm(Module):
         axes = tuple(range(x.ndim - 1))
         mean_s = self.state("mean", I.zeros, (c,))
         var_s = self.state("var", I.ones, (c,))
+        # Statistics and normalization in float32 regardless of the compute
+        # policy (bf16 batch moments are too coarse); output returns to the
+        # activation dtype so the surrounding convs stay on the bf16 MXU path.
+        xf = x.astype(jnp.float32)
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
             m = self.momentum
             self.update_state("mean", m * mean_s + (1 - m) * mean)
             self.update_state("var", m * var_s + (1 - m) * var)
         else:
             mean, var = mean_s, var_s
-        y = (x - mean) * lax.rsqrt(var + self.eps)
+        y = (xf - mean) * lax.rsqrt(var + self.eps)
         if self.use_scale_shift:
             y = y * self.param("scale", I.ones, (c,)) + \
                 self.param("shift", I.zeros, (c,))
-        return y
+        return y.astype(x.dtype)
 
 
 class LayerNorm(Module):
