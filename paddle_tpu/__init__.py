@@ -12,3 +12,4 @@ __version__ = "0.1.0"
 from . import core
 from .core import (Module, Sequential, SeqBatch, initializers, make_mesh,
                    default_mesh, use_mesh)
+from . import parallel

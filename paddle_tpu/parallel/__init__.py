@@ -1,0 +1,25 @@
+"""Model / tensor / sequence parallelism over the device mesh.
+
+The reference's model parallelism is ``ParallelNeuralNetwork`` — layers pinned
+to devices, executed by per-device worker threads with task queues
+(``/root/reference/paddle/gserver/gradientmachines/ParallelNeuralNetwork.h:36-53``)
+— and its collectives are NCCL ops (``paddle/operators/nccl_op.cc:66``).
+TPU-native, both collapse into *sharding annotations*: parameters are laid out
+over mesh axes with :mod:`jax.sharding`, XLA's SPMD partitioner inserts the
+all-gathers/reduce-scatters over ICI, and one jit'd step runs everywhere.
+
+This package owns:
+  - :mod:`.sharding` — pattern-based parameter sharding rules and helpers to
+    build sharded train states (``ShardingRules``, ``sharded_init``).
+  - :mod:`.ring` — ring attention over ``ppermute`` for the ``seq`` mesh axis
+    (sequence/context parallelism; exceeds the 2017 reference, SURVEY.md §5).
+"""
+
+from .sharding import (ShardingRules, spec_tree, named_shardings,
+                       shard_tree, sharded_init)
+from .ring import ring_attention, make_ring_attention
+
+__all__ = [
+    "ShardingRules", "spec_tree", "named_shardings", "shard_tree",
+    "sharded_init", "ring_attention", "make_ring_attention",
+]

@@ -1,0 +1,97 @@
+"""Ring attention: sequence/context parallelism over the ``seq`` mesh axis.
+
+Long sequences are sharded along time; each device holds a [B, T/n, H, D]
+slice of q/k/v. Attention against the full sequence is computed blockwise:
+devices rotate their k/v shards around the ring with ``lax.ppermute`` (ICI
+neighbor exchanges, overlapped with the block matmuls by XLA's async
+collectives) while accumulating a streaming softmax (flash-attention style
+log-sum-exp running max/sum), so the full [T, T] score matrix never
+materializes and memory stays O(T/n * T/n) per step.
+
+The 2017 reference has no sequence parallelism (SURVEY.md §5 records its
+absence); this is the forward-looking capability row. Design follows the
+public blockwise/ring-attention recipe (psum-free: only neighbor ppermute).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ring_attention", "make_ring_attention"]
+
+_NEG = -1e30  # finite "-inf": keeps fully-masked rows NaN-free
+
+
+def ring_attention(q, k, v, axis_name: str, axis_size: int,
+                   causal: bool = False, scale: Optional[float] = None):
+    """Blockwise ring attention — call INSIDE shard_map.
+
+    q, k, v: local shards [B, Tlocal, H, D], time sharded over ``axis_name``
+    (axis static size ``axis_size``). Returns the local output shard
+    [B, Tlocal, H, D]. Softmax statistics accumulate in float32.
+    """
+    n = axis_size
+    idx = lax.axis_index(axis_name)
+    b, tl, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+    q_pos = idx * tl + jnp.arange(tl)                       # global positions
+
+    # Derive the accumulators from q (zeroed) so they carry q's exact
+    # device-varying axes — plain constants would trip shard_map's
+    # varying-axes check on the scan carry (constants are "unvarying", the
+    # updated accumulators vary over the ring axis and any batch axes).
+    zero_rows = jnp.swapaxes(jnp.sum(qf, axis=-1) * 0.0, 1, 2)  # [B, H, Tl]
+    acc0 = qf * 0.0                                             # [B, Tl, H, D]
+    m0 = zero_rows + _NEG                                       # running max
+    l0 = zero_rows                                              # running sum
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        kb, vb, acc, m, l = carry
+        src = (idx - i) % n                 # ring owner of the block we hold
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
+        if causal:
+            k_pos = src * tl + jnp.arange(tl)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = (acc * jnp.swapaxes(corr, 1, 2)[..., None]
+                   + jnp.einsum("bhqk,bkhd->bqhd", p,
+                                vb.astype(jnp.float32)))
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (kb, vb, acc_new, m_new, l_new), None
+
+    (_, _, acc, m, l), _ = lax.scan(step, (k, v, acc0, m0, l0),
+                                    jnp.arange(n))
+    out = acc / jnp.swapaxes(l, 1, 2)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
+                        batch_axis: Optional[str] = None,
+                        causal: bool = False):
+    """Wrap :func:`ring_attention` in shard_map over ``mesh``: takes GLOBAL
+    [B, T, H, D] arrays (time sharded over ``seq_axis``, optionally batch over
+    ``batch_axis``) and returns the global output."""
+    try:
+        from jax import shard_map
+    except ImportError:            # older jax
+        from jax.experimental.shard_map import shard_map
+
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[seq_axis]
+    spec = P(batch_axis, seq_axis, None, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, axis_size=n,
+                           causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)

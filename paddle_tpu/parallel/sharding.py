@@ -1,0 +1,116 @@
+"""Parameter-sharding rules: the TPU-native ``ParallelNeuralNetwork``.
+
+The reference pins layers to devices via a per-layer ``device`` config attr
+(``/root/reference/paddle/gserver/gradientmachines/ParallelNeuralNetwork.h:36``,
+``proto/ModelConfig.proto`` LayerConfig.device). Here the analog is a table of
+``(path pattern, PartitionSpec)`` rules mapping parameter-tree paths onto mesh
+axes; XLA's SPMD partitioner turns the layout into compute+collectives. E.g.::
+
+    rules = ShardingRules([
+        ("*/hidden/w", P(None, "model")),   # column-parallel
+        ("*/hidden/b", P("model")),
+        ("*/out/w",    P("model", None)),   # row-parallel
+    ])                                       # everything else replicated
+
+Shardings for optimizer state are not declared anywhere: ``Trainer`` builds
+them by running ``optimizer.init`` EAGERLY on already-committed params —
+eager ``zeros_like`` on a sharded array inherits its sharding, so every
+param-shaped slot gets the param's layout and scalars stay replicated (the
+analog of the pserver's blockwise-sharded optimizer state,
+``pserver/ParameterServer2.h:73``). Under jit the zeros would be
+value-independent constants and land on one device.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import List, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardingRules", "spec_tree", "named_shardings", "shard_tree",
+           "sharded_init"]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+class ShardingRules:
+    """Ordered ``(fnmatch pattern, PartitionSpec)`` rules; first match wins,
+    default replicated. Patterns match the slash-joined parameter path
+    (e.g. ``encoder/Linear_0/w``)."""
+
+    def __init__(self, rules: Sequence[Tuple[str, P]], default: P = P()):
+        self.rules = list(rules)
+        self.default = default
+
+    def spec_for(self, path: str) -> P:
+        for pat, spec in self.rules:
+            if fnmatch.fnmatchcase(path, pat):
+                return spec
+        return self.default
+
+    def __call__(self, tree):
+        return spec_tree(tree, self)
+
+
+def spec_tree(tree, rules: Union[ShardingRules, Sequence[Tuple[str, P]]]):
+    """Map a params pytree to a same-structure pytree of PartitionSpecs."""
+    if not isinstance(rules, ShardingRules):
+        rules = ShardingRules(rules)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: rules.spec_for(_path_str(path)), tree)
+
+
+def named_shardings(mesh: Mesh, specs):
+    """PartitionSpec pytree -> NamedSharding pytree on ``mesh``."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_tree(mesh: Mesh, tree, specs=None):
+    """Commit a pytree to the mesh with the given specs (default replicated).
+    ``specs`` may be a spec pytree or ShardingRules."""
+    if specs is None:
+        specs = jax.tree_util.tree_map(lambda _: P(), tree)
+    elif isinstance(specs, ShardingRules):
+        specs = specs(tree)
+    return jax.device_put(tree, named_shardings(mesh, specs))
+
+
+def sharded_init(model, rng, *args, mesh: Mesh, rules=None, **kwargs):
+    """Initialize a model with parameters created directly in their sharded
+    layout (no host-memory full copy — required once a model outgrows one
+    chip). Returns ``(variables, param_specs)``.
+
+    ``rules`` may be a :class:`ShardingRules`, a PartitionSpec pytree
+    matching the params tree, or None (replicated). Runs ``model.init``
+    under jit with ``out_shardings`` derived from it, so each parameter is
+    materialized already partitioned.
+    """
+
+    def init_fn(r):
+        return model.init(r, *args, **kwargs)
+
+    shapes = jax.eval_shape(init_fn, rng)
+    if rules is None or isinstance(rules, (ShardingRules, list, tuple)):
+        param_specs = spec_tree(shapes["params"], rules or ShardingRules([]))
+    else:
+        param_specs = rules
+    out_specs = {c: (param_specs if c == "params"
+                     else jax.tree_util.tree_map(lambda _: P(), shapes[c]))
+                 for c in shapes}
+    out_sh = {c: named_shardings(mesh, s) for c, s in out_specs.items()}
+    variables = jax.jit(init_fn, out_shardings=out_sh)(rng)
+    return variables, param_specs

@@ -67,8 +67,13 @@ class Trainer:
         ``batch['x']``).
       evaluator: optional EvaluatorSet/Evaluator whose stats are computed
         inside the compiled step.
-      param_sharding: optional pytree of PartitionSpecs for model parallelism;
-        default fully replicated.
+      param_sharding: optional model-parallel layout — either a
+        ``parallel.ShardingRules`` or a pytree of PartitionSpecs matching the
+        params tree. Params are materialized in that layout at init;
+        optimizer state inherits each param's layout (eager
+        ``optimizer.init`` on committed params — eager zeros_like
+        propagates sharding); XLA inserts the collectives. Default fully
+        replicated.
     """
 
     def __init__(self, model: Module, loss_fn: Callable, optimizer: Optimizer,
@@ -82,6 +87,7 @@ class Trainer:
         self.stats = StatSet("trainer")
         self._forward = forward or self._default_forward
         self._param_sharding = param_sharding
+        self._param_specs = None
         self._train_step = None
         self._eval_step = None
         self._donate = donate
@@ -102,14 +108,40 @@ class Trainer:
         non-standard inputs (custom ``forward=`` arg) implement
         ``init_variables(rng, batch)``."""
         batch = jax.tree_util.tree_map(jnp.asarray, sample_batch)
-        if hasattr(self.model, "init_variables"):
-            variables = self.model.init_variables(rng, batch)
+        if self._param_sharding is not None:
+            from ..parallel import sharding as shard_lib
+            if hasattr(self.model, "init_variables"):
+                variables = self.model.init_variables(rng, batch)
+                specs = self._param_sharding
+                if isinstance(specs, shard_lib.ShardingRules):
+                    specs = specs(variables["params"])
+                params = shard_lib.shard_tree(self.mesh, variables["params"],
+                                              specs)
+                state = shard_lib.shard_tree(self.mesh,
+                                             variables.get("state", {}))
+            else:
+                # Materialize params directly in their sharded layout — no
+                # full replicated copy on one device first.
+                variables, specs = shard_lib.sharded_init(
+                    self.model, rng, batch["x"], mesh=self.mesh,
+                    rules=self._param_sharding, train=True)
+                params = variables["params"]
+                state = variables.get("state", {})
+            self._param_specs = specs
         else:
-            variables = self.model.init(rng, batch["x"], train=True)
-        opt_state = self.optimizer.init(variables["params"])
-        self.train_state = TrainState(variables["params"],
-                                      variables.get("state", {}),
-                                      opt_state, jnp.zeros((), jnp.int32))
+            if hasattr(self.model, "init_variables"):
+                variables = self.model.init_variables(rng, batch)
+            else:
+                variables = self.model.init(rng, batch["x"], train=True)
+            params = variables["params"]
+            state = variables.get("state", {})
+        # Param-shaped optimizer slots inherit each param's committed layout:
+        # eager zeros_like/ops on sharded arrays propagate sharding (under
+        # jit they would be value-independent constants and land on one
+        # device).
+        opt_state = self.optimizer.init(params)
+        self.train_state = TrainState(params, state, opt_state,
+                                      jnp.zeros((), jnp.int32))
         return self.train_state
 
     # -- compiled steps ------------------------------------------------------
@@ -144,17 +176,21 @@ class Trainer:
                      if evaluator is not None else {})
             return new_params, new_state, new_opt, step + 1, loss, stats
 
-        # Shardings: params/opt replicated (or user-specified for model
-        # parallelism), batch sharded over the data axis. XLA inserts the
-        # gradient all-reduce over ICI — the entire pserver tier collapses here.
-        repl = NamedSharding(mesh, P())
-        data = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
-        pspec = self._param_sharding or repl
+        # Shardings: batch sharded over the data axis, params replicated
+        # (default) or committed to the user's model-parallel layout at
+        # init — in that case shardings are taken from the committed inputs
+        # and SPMD propagation lays out the rest. XLA inserts the gradient
+        # all-reduce over ICI — the entire pserver tier collapses here.
         donate = (0, 1, 2) if self._donate else ()
-        self._train_step = jax.jit(
-            step_fn,
-            in_shardings=(pspec, repl, pspec, repl, data, repl),
-            donate_argnums=donate)
+        if self._param_sharding is None:
+            repl = NamedSharding(mesh, P())
+            data = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+            self._train_step = jax.jit(
+                step_fn,
+                in_shardings=(repl, repl, repl, repl, data, repl),
+                donate_argnums=donate)
+        else:
+            self._train_step = jax.jit(step_fn, donate_argnums=donate)
 
     def _build_eval_step(self):
         model = self.model
@@ -271,15 +307,32 @@ class Trainer:
     def restore(self, checkpoint_dir: str, pass_id: Optional[int] = None):
         loaded = ckpt_lib.load_checkpoint(checkpoint_dir, pass_id)
         put = lambda tree: jax.tree_util.tree_map(jnp.asarray, tree)
+        params = put(loaded["params"])
+        state = put(loaded.get("state", {}))
+        if self._param_sharding is not None:
+            # Re-commit the model-parallel layout (checkpoints hold host
+            # arrays; without this a resumed run would continue replicated).
+            from ..parallel import sharding as shard_lib
+            specs = self._param_specs
+            if specs is None:
+                specs = self._param_sharding
+                if isinstance(specs, shard_lib.ShardingRules):
+                    specs = specs(params)
+                self._param_specs = specs
+            params = shard_lib.shard_tree(self.mesh, params, specs)
+            state = shard_lib.shard_tree(self.mesh, state)
         # Rebuild optimizer-state pytree type (tuples/namedtuples flattened to
         # plain containers by the npz round-trip) by grafting leaves onto a
-        # freshly-built state skeleton.
-        params = put(loaded["params"])
+        # freshly-built state skeleton — then commit each loaded leaf to the
+        # skeleton leaf's (possibly sharded) layout.
         skeleton = self.optimizer.init(params)
         flat_loaded = jax.tree_util.tree_leaves(put(loaded["opt_state"]))
         treedef = jax.tree_util.tree_structure(skeleton)
         opt_state = jax.tree_util.tree_unflatten(treedef, flat_loaded)
-        self.train_state = TrainState(params, put(loaded.get("state", {})),
-                                      opt_state,
+        if self._param_sharding is not None:
+            opt_state = jax.tree_util.tree_map(
+                lambda skel, val: jax.device_put(val, skel.sharding),
+                skeleton, opt_state)
+        self.train_state = TrainState(params, state, opt_state,
                                       jnp.asarray(loaded["step"], jnp.int32))
         return self.train_state

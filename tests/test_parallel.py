@@ -1,0 +1,183 @@
+"""Parallelism tests on the virtual 8-device CPU mesh — the analog of the
+reference's in-process cluster tests (``test_CompareSparse.cpp:64``,
+``ParallelNeuralNetwork.h:36``): tensor-parallel training must match
+replicated training; ring attention must match dense attention."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu import optim, parallel
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import costs
+from paddle_tpu.train import Trainer
+
+
+class MLP(Module):
+    def __init__(self, hidden=32, classes=8):
+        super().__init__()
+        self.hidden = nn.Linear(hidden, act="relu", name="hidden")
+        self.out = nn.Linear(classes, name="out")
+
+    def forward(self, x, train=False):
+        return self.out(self.hidden(x))
+
+
+def _batch(nprng, n=32, d=16, classes=8):
+    return {
+        "x": nprng.normal(size=(n, d)).astype(np.float32),
+        "label": nprng.randint(0, classes, size=n).astype(np.int32),
+    }
+
+
+MLP_RULES = parallel.ShardingRules([
+    ("*/hidden/w", P(None, "model")),     # column parallel
+    ("*/hidden/b", P("model")),
+    ("*/out/w", P("model", None)),        # row parallel
+])
+
+
+def _train_losses(mesh, param_sharding, batches, rng):
+    trainer = Trainer(
+        model=MLP(),
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
+        optimizer=optim.momentum(0.1, 0.9),
+        mesh=mesh, param_sharding=param_sharding, donate=False)
+    trainer.init(rng, batches[0])
+    trainer._build_train_step()
+    ts = trainer.train_state
+    p, s, o, st = ts.params, ts.state, ts.opt_state, ts.step
+    losses = []
+    for hb in batches:
+        b = trainer._shard(hb)
+        p, s, o, st, loss, stats = trainer._train_step(
+            p, s, o, st, b, jax.random.PRNGKey(7))
+        losses.append(float(loss))
+    return losses, p
+
+
+def test_tensor_parallel_matches_replicated(nprng, rng):
+    """data x model mesh with sharded params == pure-DP replicated params
+    (same global batches, same rng) — the ParallelNeuralNetwork equivalence."""
+    batches = [_batch(nprng) for _ in range(5)]
+    mesh_dp = pt.make_mesh({"data": 8})
+    mesh_tp = pt.make_mesh({"data": 2, "model": 4})
+    losses_dp, p_dp = _train_losses(mesh_dp, None, batches, rng)
+    losses_tp, p_tp = _train_losses(mesh_tp, MLP_RULES, batches, rng)
+    np.testing.assert_allclose(losses_dp, losses_tp, rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p_dp),
+                    jax.tree_util.tree_leaves(p_tp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_param_sharding_actually_shards(nprng, rng):
+    mesh = pt.make_mesh({"data": 2, "model": 4})
+    trainer = Trainer(
+        model=MLP(),
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
+        optimizer=optim.adam(1e-3),
+        mesh=mesh, param_sharding=MLP_RULES, donate=False)
+    trainer.init(rng, _batch(nprng))
+    root = next(iter(trainer.train_state.params))
+    w = trainer.train_state.params[root]["hidden"]["w"]
+    spec = w.sharding.spec
+    assert tuple(spec) == (None, "model")
+    # optimizer state inherited the layout by SPMD propagation
+    m_leaves = [x for x in jax.tree_util.tree_leaves(
+        trainer.train_state.opt_state) if getattr(x, "ndim", 0) == 2
+        and x.shape == w.shape]
+    assert m_leaves, "adam should carry param-shaped slots"
+    for leaf in m_leaves:
+        assert tuple(leaf.sharding.spec) == (None, "model")
+
+
+def test_sharded_restore_recommits_layout(nprng, rng, tmp_path):
+    """save -> restore with param_sharding keeps the tensor-parallel layout
+    (params, state, and optimizer slots)."""
+    mesh = pt.make_mesh({"data": 2, "model": 4})
+    def make():
+        return Trainer(
+            model=MLP(),
+            loss_fn=lambda out, b: costs.softmax_cross_entropy(
+                out, b["label"]),
+            optimizer=optim.adam(1e-3),
+            mesh=mesh, param_sharding=MLP_RULES, donate=False)
+    t1 = make()
+    t1.init(rng, _batch(nprng))
+    t1.save(str(tmp_path), 0)
+    t2 = make()
+    t2.init(rng, _batch(nprng))          # builds _param_specs
+    t2.restore(str(tmp_path), 0)
+    root = next(iter(t2.train_state.params))
+    w = t2.train_state.params[root]["hidden"]["w"]
+    assert tuple(w.sharding.spec) == (None, "model")
+    for leaf in jax.tree_util.tree_leaves(t2.train_state.opt_state):
+        if getattr(leaf, "shape", None) == w.shape:
+            assert tuple(leaf.sharding.spec) == (None, "model")
+
+
+def test_sharded_init_layout(nprng, rng):
+    mesh = pt.make_mesh({"data": 2, "model": 4})
+    model = MLP(hidden=64)
+    x = jnp.asarray(nprng.normal(size=(8, 16)).astype(np.float32))
+    variables, specs = parallel.sharded_init(model, rng, x, mesh=mesh,
+                                             rules=MLP_RULES)
+    root = next(iter(variables["params"]))
+    w = variables["params"][root]["hidden"]["w"]
+    assert tuple(w.sharding.spec) == (None, "model")
+    assert specs[root]["hidden"]["w"] == P(None, "model")
+    # replicated leaf
+    b = variables["params"][root]["out"]["b"]
+    assert tuple(b.sharding.spec) == ()
+
+
+# ---------------------------------------------------------------- ring attn
+
+def _dense_attention(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        t = q.shape[1]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(nprng, causal):
+    mesh = pt.make_mesh({"data": 2, "seq": 4})
+    B, T, H, D = 2, 16, 2, 4
+    q = jnp.asarray(nprng.normal(size=(B, T, H, D)).astype(np.float32))
+    k = jnp.asarray(nprng.normal(size=(B, T, H, D)).astype(np.float32))
+    v = jnp.asarray(nprng.normal(size=(B, T, H, D)).astype(np.float32))
+    ring = parallel.make_ring_attention(mesh, seq_axis="seq", causal=causal)
+    out = jax.jit(ring)(q, k, v)
+    ref = _dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_match_dense(nprng):
+    mesh = pt.make_mesh({"seq": 8})
+    B, T, H, D = 1, 16, 1, 4
+    q = jnp.asarray(nprng.normal(size=(B, T, H, D)).astype(np.float32))
+    k = jnp.asarray(nprng.normal(size=(B, T, H, D)).astype(np.float32))
+    v = jnp.asarray(nprng.normal(size=(B, T, H, D)).astype(np.float32))
+    ring = parallel.make_ring_attention(mesh, seq_axis="seq", causal=True)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_attention(q, k, v, True) ** 2)
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
