@@ -1,6 +1,7 @@
 """Model zoo — the acceptance workloads from BASELINE.json (MNIST LeNet,
 ResNet, seq2seq attention NMT, sequence tagging, CTR) built on paddle_tpu.nn."""
 
+from .ctr import CTR_SHARDING_RULES, SparseLR, WideDeepCTR
 from .image_zoo import AlexNet, GoogLeNet, VGG, vgg16, vgg19
 from .mnist import LeNet, MnistMLP
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,

@@ -1,0 +1,94 @@
+"""CTR models — the reference's sparse quick-start demos, TPU-native.
+
+Reference: ``/root/reference/v1_api_demo/quick_start/trainer_config.lr.py``
+(wide logistic regression over sparse ids) and ``trainer_config.emb.py``
+(embedding + fc). The reference trains these with row-sharded embedding
+tables on parameter servers, prefetching only the rows present in each batch
+(``trainer/RemoteParameterUpdater.h:265`` SparseRemoteParameterUpdater,
+``math/SparseRowMatrix.h:31``, ``pserver/SparseParameterDistribution.cpp``).
+
+TPU-native, the entire sparse-distribution tier collapses into a *sharding*:
+the table rows are laid out over the ``model`` mesh axis
+(:data:`CTR_SHARDING_RULES`), lookups become XLA gathers with the collective
+traffic inserted by SPMD, and the scatter-add gradient of ``jnp.take`` is the
+SelectedRows analog — only touched rows produce updates.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.module import Module, Sequential
+from .. import nn
+from ..parallel import ShardingRules
+
+__all__ = ["WideDeepCTR", "SparseLR", "CTR_SHARDING_RULES"]
+
+# Row-shard every embedding table over the `model` axis — the pserver
+# row-sharding analog. First match wins; everything else replicated.
+CTR_SHARDING_RULES = ShardingRules([
+    ("*/wide/w", P("model", None)),
+    ("*/deep/w", P("model", None)),
+])
+
+
+def _global_field_ids(ids, num_fields: int, vocab_per_field: int):
+    """Map field-local ids [B, F] into one table's row space: field f owns
+    rows [f*vocab, (f+1)*vocab). Padding (-1) is preserved."""
+    offs = jnp.arange(num_fields, dtype=ids.dtype) * vocab_per_field
+    return jnp.where(ids >= 0, ids + offs[None, :], -1)
+
+
+class SparseLR(Module):
+    """Wide logistic regression over sparse categorical fields
+    (reference: ``trainer_config.lr.py`` — sparse_binary_vector -> fc).
+
+    ``ids [B, F]`` carry field-local ids; each field f gets its own row range
+    ``[f*vocab, (f+1)*vocab)`` of one big weight table. Returns logits [B].
+    """
+
+    def __init__(self, num_fields: int, vocab_per_field: int, name=None):
+        super().__init__(name=name)
+        self.num_fields = num_fields
+        self.vocab = vocab_per_field
+        self.wide = nn.Embedding(num_fields * vocab_per_field, 1,
+                                 name="wide")
+
+    def forward(self, ids, train=False):
+        g = _global_field_ids(ids, self.num_fields, self.vocab)
+        logit = self.wide(g)[..., 0].sum(-1)            # [B]
+        b = self.param("b", lambda r, s, d: jnp.zeros(s, d), ())
+        return logit + b
+
+
+class WideDeepCTR(Module):
+    """Wide (sparse LR) + deep (embedding -> MLP) click model
+    (reference: ``trainer_config.emb.py`` embedding path combined with the
+    ``lr`` wide path; the 2016 wide&deep recipe the demo family approximates).
+    Returns logits [B]."""
+
+    def __init__(self, num_fields: int, vocab_per_field: int,
+                 emb_dim: int = 16, hidden: Sequence[int] = (64, 32),
+                 name=None):
+        super().__init__(name=name)
+        self.num_fields = num_fields
+        self.vocab = vocab_per_field
+        self.emb_dim = emb_dim
+        self.wide = nn.Embedding(num_fields * vocab_per_field, 1, name="wide")
+        self.deep = nn.Embedding(num_fields * vocab_per_field, emb_dim,
+                                 name="deep")
+        self.mlp = Sequential(
+            *[nn.Linear(h, act="relu", name=f"fc{i}")
+              for i, h in enumerate(hidden)],
+            nn.Linear(1, name="out"), name="mlp")
+
+    def forward(self, ids, train=False):
+        g = _global_field_ids(ids, self.num_fields, self.vocab)
+        wide_logit = self.wide(g)[..., 0].sum(-1)                   # [B]
+        e = self.deep(g)                                            # [B,F,D]
+        flat = e.reshape(e.shape[0], self.num_fields * self.emb_dim)
+        deep_logit = self.mlp(flat)[:, 0]                           # [B]
+        return wide_logit + deep_logit

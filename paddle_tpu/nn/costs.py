@@ -17,7 +17,8 @@ import jax.numpy as jnp
 
 __all__ = [
     "softmax_cross_entropy", "cross_entropy_with_probs", "soft_binary_ce",
-    "multi_binary_ce", "mse", "smooth_l1", "huber_regression",
+    "binary_logistic", "multi_binary_ce", "mse", "smooth_l1",
+    "huber_regression",
     "huber_classification", "hinge", "rank_cost", "lambda_rank_ndcg",
     "sum_cost", "nce_loss", "hsigmoid_loss", "reduce",
 ]
@@ -71,6 +72,15 @@ def soft_binary_ce(probs, targets, weight=None, eps=1e-7):
     p = jnp.clip(probs.astype(jnp.float32), eps, 1 - eps)
     l = -(targets * jnp.log(p) + (1 - targets) * jnp.log1p(-p))
     return _weight(l.sum(-1) if l.ndim > 1 else l, weight)
+
+
+def binary_logistic(logits, labels, weight=None):
+    """Per-example binary cross-entropy on logits [B] with 0/1 labels [B]
+    (reference: the quick_start LR demo's classification cost — sigmoid +
+    binary CE)."""
+    z = logits.astype(jnp.float32)
+    y = labels.astype(jnp.float32)
+    return _weight(_softplus(z) - y * z, weight)
 
 
 def multi_binary_ce(logits, targets, weight=None):

@@ -3,5 +3,5 @@ paddle/trainer, v2 SGD event loop, gserver evaluators, ParamUtil checkpoints).""
 
 from . import checkpoint, events, evaluators
 from .evaluators import (Auc, ChunkEvaluator, ClassificationError, Evaluator,
-                         EvaluatorSet, PrecisionRecall)
+                         EvaluatorSet, PnPair, PrecisionRecall, RankAuc)
 from .trainer import Trainer, TrainState

@@ -17,7 +17,7 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = ["Evaluator", "ClassificationError", "PrecisionRecall", "Auc",
-           "ChunkEvaluator", "EvaluatorSet"]
+           "RankAuc", "PnPair", "ChunkEvaluator", "EvaluatorSet"]
 
 
 class Evaluator:
@@ -105,18 +105,22 @@ class Auc(Evaluator):
     """ROC AUC via fixed-bin histogram (reference: ``AucEvaluator`` — same
     binned approach, Evaluator.cpp)."""
 
-    def __init__(self, num_bins: int = 1024, name="auc"):
+    def __init__(self, num_bins: int = 1024, from_logits: bool = False,
+                 name="auc"):
         self.name = name
         self.num_bins = num_bins
+        self.from_logits = from_logits
         self.reset()
 
     def batch_stats(self, outputs, batch):
+        import jax
         labels = batch["label"].astype(jnp.int32)
         if outputs.ndim > 1 and outputs.shape[-1] == 2:
-            import jax
             score = jax.nn.softmax(outputs, -1)[..., 1]
         else:
             score = outputs[..., 0] if outputs.ndim > 1 else outputs
+            if self.from_logits:      # map logits into [0,1) for binning
+                score = jax.nn.sigmoid(score)
         idx = jnp.clip((score * self.num_bins).astype(jnp.int32), 0,
                        self.num_bins - 1)
         pos = jnp.zeros(self.num_bins).at[idx].add(labels == 1)
@@ -142,6 +146,123 @@ class Auc(Evaluator):
         fpr = np.concatenate([[0.0], fp / tot_n])
         auc = float(np.trapezoid(tpr, fpr))
         return {self.name: auc}
+
+
+class RankAuc(Evaluator):
+    """Exact ROC AUC from accumulated (score, label) pairs (reference:
+    ``RankAucEvaluator``, ``gserver/evaluators/Evaluator.cpp`` — computes AUC
+    over the full score column). Holds every score on the host until
+    ``result`` — exact but O(N) memory; use the binned :class:`Auc` for
+    unbounded streams."""
+
+    def __init__(self, name="rankauc"):
+        self.name = name
+        self.reset()
+
+    def batch_stats(self, outputs, batch):
+        score = outputs[..., 0] if outputs.ndim > 1 else outputs
+        return {"score": score, "label": batch["label"],
+                "weight": batch.get("weight",
+                                    jnp.ones(score.shape[0]))}
+
+    def reset(self):
+        self._scores, self._labels, self._weights = [], [], []
+
+    def update(self, stats):
+        lab = np.asarray(stats["label"])
+        keep = lab >= 0                       # -1 = padding, as everywhere
+        self._scores.append(np.asarray(stats["score"], np.float64)[keep])
+        self._labels.append(lab[keep])
+        self._weights.append(np.asarray(stats["weight"], np.float64)[keep])
+
+    def result(self):
+        if not self._scores:
+            return {self.name: 0.5}
+        s = np.concatenate(self._scores)
+        y = np.concatenate(self._labels)
+        w = np.concatenate(self._weights)
+        order = np.argsort(s, kind="stable")
+        s, y, w = s[order], y[order], w[order]
+        # average rank per tied-score group (Mann-Whitney with ties)
+        ranks = np.empty(len(s))
+        i = 0
+        cum = 0.0
+        while i < len(s):
+            j = i
+            while j < len(s) and s[j] == s[i]:
+                j += 1
+            block_w = w[i:j].sum()
+            # weighted average 1-based rank of the tied block
+            ranks[i:j] = cum + (block_w + 1.0) / 2.0
+            cum += block_w
+            i = j
+        pos = y == 1
+        w_pos = w[pos].sum()
+        w_neg = w[~pos].sum()
+        if w_pos == 0 or w_neg == 0:
+            return {self.name: 0.5}
+        auc = ((ranks[pos] * w[pos]).sum() - w_pos * (w_pos + 1) / 2.0) / (
+            w_pos * w_neg)
+        return {self.name: float(auc)}
+
+
+class PnPair(Evaluator):
+    """Positive-negative pair ordering accuracy within query groups
+    (reference: ``PnpairEvaluator``, ``gserver/evaluators/Evaluator.cpp`` —
+    counts correctly-ordered / mis-ordered / tied (pos, neg) score pairs per
+    query). ``batch['query']`` gives group ids (defaults to one global
+    group); groups must not span batches."""
+
+    def __init__(self, name="pnpair"):
+        self.name = name
+        self.reset()
+
+    def batch_stats(self, outputs, batch):
+        score = outputs[..., 0] if outputs.ndim > 1 else outputs
+        return {"score": score, "label": batch["label"],
+                "query": batch.get("query",
+                                   jnp.zeros(score.shape[0], jnp.int32))}
+
+    def reset(self):
+        self._correct = self._wrong = self._tie = 0.0
+
+    def update(self, stats):
+        s = np.asarray(stats["score"], np.float64)
+        y = np.asarray(stats["label"])
+        q = np.asarray(stats["query"])
+        keep = y >= 0
+        s, y, q = s[keep], y[keep], q[keep]
+        # One lexsort for the whole batch, then per-group rank counting —
+        # O(B log B) instead of a full-batch mask per query id. Within each
+        # group, Mann-Whitney: correct + tie/2 = sum(pos ranks) - npos(npos+1)/2;
+        # ties counted per equal-score block.
+        order = np.lexsort((s, q))
+        s, y, q = s[order], y[order], q[order]
+        starts = np.flatnonzero(np.r_[True, q[1:] != q[:-1]])
+        bounds = np.r_[starts, len(q)]
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            sg, yg = s[lo:hi], y[lo:hi]
+            npos = int((yg == 1).sum())
+            nneg = int((yg == 0).sum())
+            if not npos or not nneg:
+                continue
+            # average 1-based ranks over tied blocks (sg already sorted)
+            blk = np.flatnonzero(np.r_[True, sg[1:] != sg[:-1]])
+            blk = np.r_[blk, len(sg)]
+            ranks = np.repeat((blk[:-1] + blk[1:] + 1) / 2.0, np.diff(blk))
+            bp = np.add.reduceat((yg == 1).astype(np.float64), blk[:-1])
+            bn = np.add.reduceat((yg == 0).astype(np.float64), blk[:-1])
+            tie = float((bp * bn).sum())
+            u = ranks[yg == 1].sum() - npos * (npos + 1) / 2.0
+            correct = u - 0.5 * tie
+            self._correct += correct
+            self._tie += tie
+            self._wrong += npos * nneg - correct - tie
+
+    def result(self):
+        total = self._correct + self._wrong + self._tie
+        acc = ((self._correct + 0.5 * self._tie) / total) if total else 0.5
+        return {self.name: acc, "pnpair_pairs": total}
 
 
 class ChunkEvaluator(Evaluator):

@@ -4,7 +4,52 @@ evaluator unit tests in ``paddle/gserver/tests/test_Evaluator.cpp``)."""
 import numpy as np
 import pytest
 
-from paddle_tpu.train.evaluators import ChunkEvaluator
+from paddle_tpu.train.evaluators import ChunkEvaluator, PnPair, RankAuc
+
+
+# ------------------------------------------------------------------ rankauc
+
+def _auc_oracle(scores, labels):
+    """O(n^2) pairwise AUC with tie credit 0.5."""
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    diff = pos[:, None] - neg[None, :]
+    return ((diff > 0).sum() + 0.5 * (diff == 0).sum()) / diff.size
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_rankauc_matches_pairwise_oracle(seed):
+    rng = np.random.RandomState(seed)
+    scores = np.round(rng.normal(size=200), 1)      # rounding forces ties
+    labels = rng.randint(0, 2, size=200)
+    ev = RankAuc()
+    # stream in three chunks
+    for lo, hi in [(0, 70), (70, 150), (150, 200)]:
+        ev.update({"score": scores[lo:hi], "label": labels[lo:hi],
+                   "weight": np.ones(hi - lo)})
+    got = ev.result()["rankauc"]
+    assert abs(got - _auc_oracle(scores, labels)) < 1e-9
+
+
+def test_rankauc_degenerate():
+    ev = RankAuc()
+    ev.update({"score": np.array([0.3, 0.7]), "label": np.array([1, 1]),
+               "weight": np.ones(2)})
+    assert ev.result()["rankauc"] == 0.5
+
+
+# ------------------------------------------------------------------- pnpair
+
+def test_pnpair_grouped():
+    ev = PnPair()
+    # query 0: pos 0.9 vs negs (0.1, 0.9) -> 1 correct, 1 tie
+    # query 1: pos 0.2 vs neg 0.5 -> 1 wrong
+    ev.update({"score": np.array([0.9, 0.1, 0.9, 0.2, 0.5]),
+               "label": np.array([1, 0, 0, 1, 0]),
+               "query": np.array([0, 0, 0, 1, 1])})
+    res = ev.result()
+    assert res["pnpair_pairs"] == 3
+    assert abs(res["pnpair"] - (1 + 0.5) / 3) < 1e-12
 
 
 # ------------------------------------------------------------------- chunk
