@@ -13,3 +13,5 @@ from . import core
 from .core import (Module, Sequential, SeqBatch, initializers, make_mesh,
                    default_mesh, use_mesh)
 from . import parallel
+from . import inference
+from .inference import export, infer, load_inference_model

@@ -1,6 +1,7 @@
 """Core: module system, mesh/device abstraction, sequence representation, dtypes."""
 
-from . import initializers
+from . import config, initializers
+from .config import build_module, module_config
 from .dtypes import Policy, bfloat16_compute, current_policy, float32, use_policy
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                    default_mesh, local_mesh, make_mesh, named_sharding,

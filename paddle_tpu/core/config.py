@@ -1,0 +1,195 @@
+"""Model IR — "config is data", the TPU-native ``ModelConfig``.
+
+The reference's whole v1 surface rests on serializable model configs: Python
+generates a protobuf graph (``/root/reference/python/paddle/trainer/
+config_parser.py:4289`` -> ``proto/ModelConfig.proto:656``) that the C++
+engine instantiates, and deployment ships exactly that config next to the
+weights (``trainer/MergeModel.cpp:17``). Here the graph engine is the Module
+tree itself, so the IR records *how to rebuild the tree*: every Module
+subclass auto-registers, every instantiation records its constructor args
+(hyperparameters only — arrays never appear in configs), and
+:func:`module_config` / :func:`build_module` round-trip a model through plain
+JSON. Shared submodule instances (tied weights) serialize as references.
+
+Security: :func:`build_module` only instantiates classes from ``paddle_tpu``
+or explicitly registered ones unless ``trusted=True`` — a model file is data,
+not code.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Dict
+
+__all__ = ["register_module", "register_callable", "module_config",
+           "build_module", "config_to_json", "config_from_json"]
+
+MODULE_REGISTRY: Dict[str, type] = {}
+_FN_BY_NAME: Dict[str, Callable] = {}
+_FN_BY_ID: Dict[int, str] = {}
+
+
+def _qualname(cls: type) -> str:
+    # ':' separates the importable module from the (possibly nested, dotted)
+    # class qualname so the two can be split apart unambiguously on load.
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def register_module(cls: type) -> type:
+    """Register a Module class for IR round-trips (automatic for every
+    Module subclass via ``__init_subclass__``)."""
+    MODULE_REGISTRY[_qualname(cls)] = cls
+    return cls
+
+
+def register_callable(name: str, fn: Callable) -> Callable:
+    """Register a plain callable (initializer etc.) so it may appear in
+    constructor args."""
+    _FN_BY_NAME[name] = fn
+    _FN_BY_ID[id(fn)] = name
+    return fn
+
+
+def _register_builtin_callables():
+    from . import initializers as I
+    for name in getattr(I, "__all__", dir(I)):
+        fn = getattr(I, name, None)
+        if callable(fn) and id(fn) not in _FN_BY_ID:
+            register_callable(f"initializers.{name}", fn)
+
+
+_register_builtin_callables()
+
+
+def _is_module(x) -> bool:
+    return hasattr(x, "_init_record") and hasattr(x, "forward")
+
+
+def _encode(x, memo: Dict[int, int], mods: list):
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    if _is_module(x):
+        if id(x) in memo:
+            return {"__ref__": memo[id(x)]}
+        rec = x._init_record
+        if "<locals>" in rec["cls"].__qualname__:
+            raise TypeError(
+                f"{rec['cls'].__qualname__} is a local class (e.g. a "
+                f"no_params wrapper) and cannot be rebuilt from a config")
+        idx = len(mods)
+        memo[id(x)] = idx
+        mods.append(None)          # reserve slot (stable index under nesting)
+        mods[idx] = {
+            "class": _qualname(rec["cls"]),
+            "args": [_encode(a, memo, mods) for a in rec["args"]],
+            "kwargs": {k: _encode(v, memo, mods)
+                       for k, v in rec["kwargs"].items()},
+        }
+        return {"__ref__": idx}
+    if isinstance(x, type):
+        if _qualname(x) not in MODULE_REGISTRY:
+            raise TypeError(f"unregistered class in config: {x!r}")
+        return {"__cls__": _qualname(x)}
+    if callable(x):
+        name = _FN_BY_ID.get(id(x))
+        if name is None:
+            raise TypeError(
+                f"non-serializable callable in constructor args: {x!r}; "
+                f"register it with paddle_tpu.core.config.register_callable")
+        return {"__fn__": name}
+    if isinstance(x, tuple):
+        return {"__tuple__": [_encode(v, memo, mods) for v in x]}
+    if isinstance(x, list):
+        return [_encode(v, memo, mods) for v in x]
+    if isinstance(x, dict):
+        if any(not isinstance(k, str) for k in x):
+            raise TypeError(
+                "dict constructor args must have string keys to survive a "
+                f"JSON round-trip; got keys {list(x)!r}")
+        return {"__dict__": {k: _encode(v, memo, mods)
+                             for k, v in x.items()}}
+    import numpy as np
+    if isinstance(x, (np.integer,)):
+        return int(x)
+    if isinstance(x, (np.floating,)):
+        return float(x)
+    raise TypeError(f"non-serializable constructor arg: {type(x)!r}")
+
+
+def module_config(model) -> dict:
+    """Serialize a Module tree to a JSON-safe dict (the ModelConfig analog)."""
+    if not _is_module(model):
+        raise TypeError(f"not a Module: {model!r}")
+    memo: Dict[int, int] = {}
+    mods: list = []
+    root = _encode(model, memo, mods)
+    return {"format": 1, "modules": mods, "root": root["__ref__"]}
+
+
+def _resolve_class(qual: str, trusted: bool) -> type:
+    if qual in MODULE_REGISTRY:
+        return MODULE_REGISTRY[qual]
+    if ":" not in qual:
+        raise ValueError(f"malformed class reference {qual!r}")
+    mod_name = qual.split(":", 1)[0]
+    if not trusted and not (mod_name == "paddle_tpu"
+                            or mod_name.startswith("paddle_tpu.")):
+        raise ValueError(
+            f"refusing to import {qual!r} from an untrusted model config; "
+            f"pass trusted=True or pre-register the class")
+    importlib.import_module(mod_name)   # import triggers auto-registration
+    if qual not in MODULE_REGISTRY:
+        raise KeyError(f"{qual} did not register as a Module")
+    return MODULE_REGISTRY[qual]
+
+
+def _decode(x, built: list, cfgs: list, trusted: bool):
+    if isinstance(x, dict):
+        if "__ref__" in x:
+            return _build_ref(x["__ref__"], built, cfgs, trusted)
+        if "__cls__" in x:
+            return _resolve_class(x["__cls__"], trusted)
+        if "__fn__" in x:
+            if x["__fn__"] not in _FN_BY_NAME:
+                raise KeyError(f"unknown callable {x['__fn__']!r}")
+            return _FN_BY_NAME[x["__fn__"]]
+        if "__tuple__" in x:
+            return tuple(_decode(v, built, cfgs, trusted)
+                         for v in x["__tuple__"])
+        if "__dict__" in x:
+            return {k: _decode(v, built, cfgs, trusted)
+                    for k, v in x["__dict__"].items()}
+        raise ValueError(f"malformed config node: {x!r}")
+    if isinstance(x, list):
+        return [_decode(v, built, cfgs, trusted) for v in x]
+    return x
+
+
+def _build_ref(idx: int, built: list, cfgs: list, trusted: bool):
+    if built[idx] is None:
+        cfg = cfgs[idx]
+        cls = _resolve_class(cfg["class"], trusted)
+        args = [_decode(a, built, cfgs, trusted) for a in cfg["args"]]
+        kwargs = {k: _decode(v, built, cfgs, trusted)
+                  for k, v in cfg["kwargs"].items()}
+        built[idx] = cls(*args, **kwargs)
+    return built[idx]
+
+
+def build_module(config: dict, trusted: bool = False):
+    """Rebuild the Module tree from :func:`module_config` output."""
+    if config.get("format") != 1:
+        raise ValueError(f"unknown config format: {config.get('format')!r}")
+    cfgs = config["modules"]
+    built: list = [None] * len(cfgs)
+    return _build_ref(config["root"], built, cfgs, trusted)
+
+
+def config_to_json(config: dict) -> str:
+    import json
+    return json.dumps(config, indent=2, sort_keys=True)
+
+
+def config_from_json(text: str) -> dict:
+    import json
+    return json.loads(text)

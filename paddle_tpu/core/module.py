@@ -113,6 +113,30 @@ class Module:
     def __init__(self, name: Optional[str] = None):
         object.__setattr__(self, "_name", name)
 
+    def __init_subclass__(cls, **kw):
+        """Every Module subclass auto-registers in the model-IR registry and
+        records its constructor args on instantiation, making any model
+        serializable to a config ("config is data" — the reference's
+        ModelConfig contract, ``proto/ModelConfig.proto:656``; see
+        ``paddle_tpu.core.config``)."""
+        super().__init_subclass__(**kw)
+        import functools
+
+        from . import config as _config
+        if "<locals>" not in cls.__qualname__:
+            _config.register_module(cls)
+        orig = cls.__init__
+
+        @functools.wraps(orig)
+        def recording_init(self, *args, **kwargs):
+            if not hasattr(self, "_init_record"):   # outermost subclass wins
+                object.__setattr__(self, "_init_record",
+                                   {"cls": cls, "args": args,
+                                    "kwargs": kwargs})
+            orig(self, *args, **kwargs)
+
+        cls.__init__ = recording_init
+
     # -- naming ---------------------------------------------------------------
 
     def __setattr__(self, key, value):

@@ -1,0 +1,106 @@
+"""Export / inference: deploy a trained model without its Python source.
+
+Reference: the C inference API loads a *merged model* — config proto +
+parameters in one artifact (``/root/reference/paddle/capi/
+gradient_machine.h:51`` ``paddle_gradient_machine_create_for_inference_with_
+parameters``, produced by ``trainer/MergeModel.cpp:17``) — and ``paddle.infer``
+runs forward-only (``python/paddle/v2/inference.py``).
+
+TPU-native: :func:`export` writes a directory bundling the model IR
+(``model.json``, see ``paddle_tpu.core.config``) with the variables
+(npz + CRC manifest, same format as training checkpoints);
+:func:`load_inference_model` rebuilds the Module tree from the IR — no user
+model code needed — and returns an :class:`InferenceModel` whose ``predict``
+is a jit'd forward (``method=`` reaches alternative entry points, e.g. beam
+``generate`` on seq2seq models).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import __version__
+from .core import config as config_lib
+from .train.checkpoint import (_flatten, _unflatten, atomic_dir,
+                               verify_manifest, write_manifest)
+
+__all__ = ["export", "load_inference_model", "InferenceModel", "infer"]
+
+_MODEL_FILE = "model.json"
+_VARS_FILE = "variables.npz"
+
+
+def export(path: str, model, variables: Dict[str, Any]) -> str:
+    """Write the deployable bundle: model IR + variables (atomic, CRC'd).
+    Multi-host: only process 0 writes (single-controller convention)."""
+    cfg = config_lib.module_config(model)   # validate on every process
+    if jax.process_index() != 0:
+        return path
+    with atomic_dir(path) as tmp:
+        with open(os.path.join(tmp, _MODEL_FILE), "w") as f:
+            f.write(config_lib.config_to_json(
+                {"framework_version": __version__, **cfg}))
+        host = jax.tree_util.tree_map(lambda x: np.asarray(x), variables)
+        np.savez(os.path.join(tmp, _VARS_FILE), **_flatten(host))
+        write_manifest(tmp)
+    return path
+
+
+class InferenceModel:
+    """A rebuilt model + variables with jit-cached forward entry points."""
+
+    def __init__(self, model, variables: Dict[str, Any]):
+        self.model = model
+        self.variables = variables
+        self._jitted: Dict[Any, Any] = {}
+
+    def predict(self, *args, method: Optional[str] = None, **kwargs):
+        """Run forward (train=False semantics; ``method`` selects an
+        alternative entry point such as ``generate``/``decode``). Positional
+        args are traced arrays; keyword args are static configuration
+        (beam sizes etc.) and key the jit cache."""
+        model = self.model
+        try:
+            key = (method, tuple(sorted(kwargs.items())))
+            hash(key)
+        except TypeError:
+            key = None                       # unhashable static kwarg
+        if key is None:
+            return model.apply(self.variables, *args, method=method,
+                               **kwargs)
+        if key not in self._jitted:
+            def fn(variables, *a):
+                return model.apply(variables, *a, method=method, **kwargs)
+            self._jitted[key] = jax.jit(fn)
+        args = tuple(jnp.asarray(a) if isinstance(a, (np.ndarray, list))
+                     else a for a in args)
+        return self._jitted[key](self.variables, *args)
+
+    __call__ = predict
+
+
+def load_inference_model(path: str, trusted: bool = False,
+                         verify_crc: bool = True) -> InferenceModel:
+    """Load an exported bundle; raises on CRC mismatch. ``trusted`` gates
+    importing classes from outside paddle_tpu (a model file is data)."""
+    verify_manifest(path, verify_crc=verify_crc)
+    with open(os.path.join(path, _MODEL_FILE)) as f:
+        cfg = config_lib.config_from_json(f.read())
+    model = config_lib.build_module(cfg, trusted=trusted)
+    with np.load(os.path.join(path, _VARS_FILE), allow_pickle=False) as z:
+        variables = _unflatten({k: z[k] for k in z.files})
+    variables = jax.tree_util.tree_map(jnp.asarray, variables)
+    return InferenceModel(model, variables)
+
+
+def infer(path_or_model, *args, method: Optional[str] = None, **kwargs):
+    """One-shot convenience (the ``paddle.infer`` surface,
+    ``v2/inference.py``): load (if given a path) and run forward."""
+    m = (path_or_model if isinstance(path_or_model, InferenceModel)
+         else load_inference_model(path_or_model))
+    return m.predict(*args, method=method, **kwargs)

@@ -15,6 +15,7 @@ Multi-host: only process 0 writes (single-controller pattern); all hosts read.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import shutil
@@ -24,7 +25,49 @@ from typing import Any, Dict, Optional
 import numpy as np
 import jax
 
-__all__ = ["save_checkpoint", "load_checkpoint", "latest_pass", "pass_dir"]
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_pass", "pass_dir",
+           "atomic_dir", "write_manifest", "verify_manifest"]
+
+_MANIFEST = "manifest.json"
+
+
+@contextlib.contextmanager
+def atomic_dir(path: str):
+    """Write into ``path + '.tmp'``; atomically rename over ``path`` when the
+    block succeeds (the Go pserver's temp-file + rename recipe)."""
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp, exist_ok=True)
+    yield tmp
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.rename(tmp, path)
+
+
+def _file_crc(path: str) -> int:
+    with open(path, "rb") as f:
+        return zlib.crc32(f.read())
+
+
+def write_manifest(d: str, meta: Optional[Dict[str, Any]] = None) -> None:
+    """CRC every file in ``d`` into ``manifest.json`` (plus ``meta``)."""
+    files = {f: {"crc32": _file_crc(os.path.join(d, f))}
+             for f in sorted(os.listdir(d)) if f != _MANIFEST}
+    with open(os.path.join(d, _MANIFEST), "w") as f:
+        json.dump({**(meta or {}), "files": files}, f, indent=2)
+
+
+def verify_manifest(d: str, verify_crc: bool = True) -> Dict[str, Any]:
+    """Load ``manifest.json``; raise on CRC mismatch (the Go pserver's
+    integrity check, ``go/pserver/service.go:346``)."""
+    with open(os.path.join(d, _MANIFEST)) as f:
+        manifest = json.load(f)
+    if verify_crc:
+        for fname, info in manifest["files"].items():
+            if _file_crc(os.path.join(d, fname)) != info["crc32"]:
+                raise IOError(f"crc mismatch in {os.path.join(d, fname)}")
+    return manifest
 
 
 def _flatten(tree, prefix=""):
@@ -93,24 +136,11 @@ def save_checkpoint(root: str, pass_id: int, tree: Dict[str, Any],
     if jax.process_index() != 0:
         return pass_dir(root, pass_id)
     final = pass_dir(root, pass_id)
-    tmp = final + ".tmp"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp, exist_ok=True)
-    manifest = {"pass_id": pass_id, "files": {}}
-    for coll, sub in tree.items():
-        host_tree = jax.tree_util.tree_map(lambda x: np.asarray(x), sub)
-        flat = _flatten(host_tree)
-        path = os.path.join(tmp, f"{coll}.npz")
-        np.savez(path, **flat)
-        with open(path, "rb") as f:
-            crc = zlib.crc32(f.read())
-        manifest["files"][coll] = {"crc32": crc}
-    with open(os.path.join(tmp, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=2)
-    if os.path.exists(final):
-        shutil.rmtree(final)
-    os.rename(tmp, final)
+    with atomic_dir(final) as tmp:
+        for coll, sub in tree.items():
+            host_tree = jax.tree_util.tree_map(lambda x: np.asarray(x), sub)
+            np.savez(os.path.join(tmp, f"{coll}.npz"), **_flatten(host_tree))
+        write_manifest(tmp, {"pass_id": pass_id})
     if keep_last:
         _gc(root, keep_last)
     return final
@@ -141,17 +171,12 @@ def load_checkpoint(root: str, pass_id: Optional[int] = None,
         if pass_id is None:
             raise FileNotFoundError(f"no checkpoints under {root}")
     d = pass_dir(root, pass_id)
-    with open(os.path.join(d, "manifest.json")) as f:
-        manifest = json.load(f)
+    manifest = verify_manifest(d, verify_crc=verify_crc)
     out = {}
-    for coll, meta in manifest["files"].items():
-        path = os.path.join(d, f"{coll}.npz")
-        if verify_crc:
-            with open(path, "rb") as f:
-                crc = zlib.crc32(f.read())
-            if crc != meta["crc32"]:
-                raise IOError(f"checkpoint corrupt: crc mismatch in {path}")
-        with np.load(path, allow_pickle=False) as z:
-            out[coll] = _unflatten({k: z[k] for k in z.files})
+    for fname in manifest["files"]:
+        if not fname.endswith(".npz"):
+            continue
+        with np.load(os.path.join(d, fname), allow_pickle=False) as z:
+            out[fname[:-len(".npz")]] = _unflatten({k: z[k] for k in z.files})
     out["pass_id"] = manifest["pass_id"]
     return out

@@ -1,0 +1,139 @@
+"""Model IR + export/inference tests — the analog of the reference's
+merged-model deployment (MergeModel.cpp + C-API inference) and config
+round-trips (config_parser -> ModelConfig -> GradientMachine::create)."""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.module import Module, Sequential
+from paddle_tpu.core import config as config_lib
+from paddle_tpu.inference import export, infer, load_inference_model
+from paddle_tpu.models import (LeNet, Seq2SeqAttention, SparseLR,
+                               WideDeepCTR, resnet_cifar)
+
+
+class TinyMLP(Module):
+    def __init__(self, hidden=16, classes=4, name=None):
+        super().__init__(name=name)
+        self.h = nn.Linear(hidden, act="relu", name="h")
+        self.out = nn.Linear(classes, name="out")
+
+    def forward(self, x, train=False):
+        return self.out(self.h(x))
+
+
+class TiedPair(Module):
+    """Same Linear instance applied twice — weight sharing must survive the
+    config round-trip as a shared reference."""
+
+    def __init__(self, dim=8, name=None):
+        super().__init__(name=name)
+        self.shared = nn.Linear(dim, name="shared")
+
+    def forward(self, x, train=False):
+        return self.shared(self.shared(x))
+
+
+def test_config_roundtrip_rebuilds_identical_model(rng):
+    model = TinyMLP(hidden=12, classes=3)
+    cfg = config_lib.module_config(model)
+    text = config_lib.config_to_json(cfg)
+    rebuilt = config_lib.build_module(config_lib.config_from_json(text))
+    x = jnp.ones((2, 5))
+    v1 = model.init(rng, x)
+    v2 = rebuilt.init(rng, x)
+    np.testing.assert_array_equal(np.asarray(model.apply(v1, x)),
+                                  np.asarray(rebuilt.apply(v2, x)))
+
+
+@pytest.mark.parametrize("factory,sample", [
+    (lambda: TinyMLP(), np.ones((2, 5), np.float32)),
+    (lambda: LeNet(), np.ones((2, 28, 28, 1), np.float32)),
+    (lambda: resnet_cifar(depth_n=1), np.ones((2, 32, 32, 3), np.float32)),
+    (lambda: SparseLR(4, 11), np.zeros((3, 4), np.int32)),
+    (lambda: WideDeepCTR(4, 11, emb_dim=4, hidden=(8,)),
+     np.zeros((3, 4), np.int32)),
+])
+def test_export_reload_bitwise_equal_forward(tmp_path, rng, factory, sample):
+    model = factory()
+    x = jnp.asarray(sample)
+    variables = model.init(rng, x, train=True)
+    path = os.path.join(str(tmp_path), "bundle")
+    export(path, model, variables)
+    loaded = load_inference_model(path)
+    want = np.asarray(jax.jit(
+        lambda v, x: model.apply(v, x))(variables, x))
+    got = np.asarray(loaded.predict(x))
+    np.testing.assert_array_equal(want, got)   # bitwise
+
+
+def test_export_reload_seq2seq_beam_decode(tmp_path, rng):
+    model = Seq2SeqAttention(src_vocab=20, tgt_vocab=18, emb_dim=8,
+                             hidden=8)
+    src = jnp.asarray(np.random.RandomState(0).randint(1, 20, size=(2, 6)))
+    src_len = jnp.asarray([6, 4])
+    batch = {"src": src, "src_len": src_len,
+             "tgt": jnp.zeros((2, 6), jnp.int32),
+             "tgt_len": jnp.asarray([5, 5])}
+    variables = model.init_variables(rng, batch)
+    path = os.path.join(str(tmp_path), "nmt")
+    export(path, model, variables)
+    loaded = load_inference_model(path)
+    want_tok, want_sc = model.generate(variables, src, src_len, beam_size=3,
+                                       max_len=7)
+    got_tok, got_sc = loaded.predict(src, src_len, K=3, max_len=7,
+                                     length_penalty=0.0,
+                                     method="_beam_search")
+    np.testing.assert_array_equal(np.asarray(want_tok), np.asarray(got_tok))
+    np.testing.assert_allclose(np.asarray(want_sc), np.asarray(got_sc),
+                               rtol=1e-6)
+
+
+def test_weight_sharing_survives_roundtrip(rng):
+    model = TiedPair(dim=6)
+    cfg = config_lib.module_config(model)
+    rebuilt = config_lib.build_module(cfg)
+    assert rebuilt.shared is not None
+    x = jnp.ones((2, 6))
+    v = rebuilt.init(rng, x)
+    # one shared Linear: exactly one param subtree
+    root = next(iter(v["params"]))
+    assert list(v["params"][root].keys()) == ["shared"]
+    np.testing.assert_array_equal(
+        np.asarray(model.apply(model.init(rng, x), x)),
+        np.asarray(rebuilt.apply(v, x)))
+
+
+def test_untrusted_class_refused(tmp_path):
+    cfg = {"format": 1, "root": 0, "modules": [
+        {"class": "os:system", "args": [], "kwargs": {}}]}
+    with pytest.raises(ValueError, match="untrusted"):
+        config_lib.build_module(cfg)
+
+
+def test_corrupt_export_detected(tmp_path, rng):
+    model = TinyMLP()
+    x = jnp.ones((2, 5))
+    variables = model.init(rng, x)
+    path = os.path.join(str(tmp_path), "bundle")
+    export(path, model, variables)
+    with open(os.path.join(path, "variables.npz"), "r+b") as f:
+        f.seek(50)
+        f.write(b"\xff\xff\xff")
+    with pytest.raises(IOError, match="crc"):
+        load_inference_model(path)
+
+
+def test_infer_convenience(tmp_path, rng):
+    model = TinyMLP()
+    x = jnp.ones((2, 5))
+    variables = model.init(rng, x)
+    path = os.path.join(str(tmp_path), "bundle")
+    export(path, model, variables)
+    out = infer(path, x)
+    assert out.shape == (2, 4)
