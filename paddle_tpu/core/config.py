@@ -113,6 +113,14 @@ def _encode(x, memo: Dict[int, int], mods: list):
         return int(x)
     if isinstance(x, (np.floating,)):
         return float(x)
+    if isinstance(x, np.ndarray) or type(x).__module__.startswith("jax"):
+        # Small constant arrays in constructor args (e.g. detection priors)
+        # serialize as dtype-tagged nested lists — the ModelConfig analog of
+        # the reference's inline parameter blobs.
+        arr = np.asarray(x)
+        return {"__ndarray__": arr.astype(np.float64).tolist()
+                if arr.dtype.kind == "f" else arr.tolist(),
+                "dtype": arr.dtype.name, "shape": list(arr.shape)}
     raise TypeError(f"non-serializable constructor arg: {type(x)!r}")
 
 
@@ -159,6 +167,10 @@ def _decode(x, built: list, cfgs: list, trusted: bool):
         if "__dict__" in x:
             return {k: _decode(v, built, cfgs, trusted)
                     for k, v in x["__dict__"].items()}
+        if "__ndarray__" in x:
+            import numpy as np
+            return np.asarray(x["__ndarray__"],
+                              dtype=np.dtype(x["dtype"])).reshape(x["shape"])
         raise ValueError(f"malformed config node: {x!r}")
     if isinstance(x, list):
         return [_decode(v, built, cfgs, trusted) for v in x]

@@ -7,4 +7,5 @@ from .mnist import LeNet, MnistMLP
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152, resnet_cifar)
 from .seq2seq import Seq2SeqAttention
+from .ssd import SSDHead
 from .tagging import LinearCrfTagger, RnnCrfTagger

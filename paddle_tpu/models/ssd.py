@@ -1,0 +1,89 @@
+"""SSD detection head — the model-side wiring for the detection layer family.
+
+Reference: the SSD configuration the reference's detection layers serve
+(``gserver/layers/PriorBox.cpp``, ``MultiBoxLossLayer.cpp``,
+``DetectionOutputLayer.cpp``; demo config ``v1_api_demo`` SSD-style nets).
+
+TPU-first: priors for all feature maps are concatenated host-side into one
+static [P, 4] constant; the per-map loc/conf convolutions stay NHWC 3x3 convs
+(MXU-friendly), reshaped and concatenated into the fixed [B, P, ...] tensors
+that :class:`~paddle_tpu.nn.detection.MultiBoxLoss` /
+:class:`~paddle_tpu.nn.detection.DetectionOutput` consume.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn.layers import Conv2D
+from paddle_tpu.nn.detection import (DetectionOutput, MultiBoxLoss, prior_box)
+
+__all__ = ["SSDHead"]
+
+
+class SSDHead(Module):
+    """Multi-scale loc/conf heads + static priors.
+
+    ``feature_shapes[i]`` is the (H, W) of the i-th backbone feature map;
+    ``min_sizes[i]`` / ``max_sizes[i]`` size the priors of that map (SSD's
+    per-scale assignment). ``forward(features)`` takes the list of NHWC
+    feature maps and returns ``(loc [B, P, 4], conf [B, P, num_classes])``.
+    """
+
+    def __init__(self, num_classes: int,
+                 feature_shapes: Sequence[Tuple[int, int]],
+                 image_shape: Tuple[int, int],
+                 min_sizes: Sequence[float],
+                 max_sizes: Sequence[float] = (),
+                 aspect_ratios: Sequence[float] = (2.0,),
+                 variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+                 name: str = "ssd_head"):
+        super().__init__(name=name)
+        self.num_classes = num_classes
+        self.feature_shapes = [tuple(s) for s in feature_shapes]
+        self.image_shape = tuple(image_shape)
+        self.min_sizes = list(min_sizes)
+        self.max_sizes = list(max_sizes)
+        self.aspect_ratios = list(aspect_ratios)
+        self.variance = tuple(variance)
+
+        priors, variances = [], []
+        self._num_priors_per_cell = []
+        for i, fs in enumerate(self.feature_shapes):
+            mx = [self.max_sizes[i]] if self.max_sizes else []
+            b, v = prior_box(fs, self.image_shape, [self.min_sizes[i]], mx,
+                             self.aspect_ratios, self.variance)
+            priors.append(b)
+            variances.append(v)
+            self._num_priors_per_cell.append(b.shape[0] // (fs[0] * fs[1]))
+        self.priors = jnp.concatenate(priors, 0)
+        self.variances = jnp.concatenate(variances, 0)
+
+        self.loc_convs = [Conv2D(n * 4, kernel=3, padding="SAME",
+                                 name=f"loc{i}")
+                          for i, n in enumerate(self._num_priors_per_cell)]
+        self.conf_convs = [Conv2D(n * num_classes, kernel=3, padding="SAME",
+                                  name=f"conf{i}")
+                           for i, n in enumerate(self._num_priors_per_cell)]
+
+    def forward(self, features):
+        assert len(features) == len(self.feature_shapes)
+        locs, confs = [], []
+        for i, feat in enumerate(features):
+            B = feat.shape[0]
+            loc = self.loc_convs[i](feat).reshape(B, -1, 4)
+            conf = self.conf_convs[i](feat).reshape(B, -1, self.num_classes)
+            locs.append(loc)
+            confs.append(conf)
+        return jnp.concatenate(locs, 1), jnp.concatenate(confs, 1)
+
+    def multibox_loss(self, **kw) -> MultiBoxLoss:
+        return MultiBoxLoss(self.priors, self.variances, self.num_classes,
+                            **kw)
+
+    def detection_output(self, **kw) -> DetectionOutput:
+        return DetectionOutput(self.priors, self.variances, self.num_classes,
+                               **kw)

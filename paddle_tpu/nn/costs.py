@@ -97,11 +97,17 @@ def mse(output, target, weight=None):
     return _weight(0.5 * (d * d).sum(-1), weight)
 
 
+def smooth_l1_elementwise(output, target, delta: float = 1.0):
+    """Per-element smooth-L1 (shared by :func:`smooth_l1` and the SSD
+    multibox loss)."""
+    d = jnp.abs((output - target).astype(jnp.float32))
+    return jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+
+
 def smooth_l1(output, target, weight=None, delta: float = 1.0):
     """Smooth-L1 (reference: ``SmoothL1CostLayer``; fluid smooth_l1_op)."""
-    d = jnp.abs((output - target).astype(jnp.float32))
-    l = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
-    return _weight(l.sum(-1), weight)
+    return _weight(smooth_l1_elementwise(output, target, delta).sum(-1),
+                   weight)
 
 
 def huber_regression(output, target, weight=None, delta: float = 1.0):
@@ -234,3 +240,34 @@ def build_hsigmoid_codes(labels, num_classes: int):
         signs.append(jnp.where(valid, 1.0 - 2.0 * bit, 0.0))
         c = parent
     return jnp.stack(codes, -1), jnp.stack(signs, -1)
+
+
+def cross_entropy_over_beam(path_scores, gold_idx, gold_score=None,
+                            valid_mask=None):
+    """Cross-entropy over beam-search candidate paths (reference:
+    ``CrossEntropyOverBeamLayer.cpp`` / ``CrossEntropyOverBeam.h`` — softmax
+    over all candidate paths of the beam tree; when the gold sequence fell
+    off the beam it is appended as an extra path, ``goldAsExtraPath_``).
+
+    ``path_scores [B, N]``: final scores of the N candidate paths per
+    sequence. ``gold_idx [B]``: index of the gold path among candidates, or
+    -1 if gold fell off the beam — in which case ``gold_score [B]`` (the
+    model's score of the gold path) is appended as an N+1-th candidate.
+    ``valid_mask [B, N]`` masks out padding candidates. Returns the mean
+    negative log-probability of the gold path.
+    """
+    B, N = path_scores.shape
+    if gold_score is None:
+        gold_score = jnp.zeros((B,), path_scores.dtype)
+    if valid_mask is None:
+        valid_mask = jnp.ones((B, N), bool)
+    off_beam = gold_idx < 0
+    # static shape: always append the extra column; it only participates
+    # (and is the target) when gold is off-beam
+    extra = jnp.where(off_beam, gold_score, -jnp.inf)
+    scores = jnp.concatenate([jnp.where(valid_mask, path_scores, -jnp.inf),
+                              extra[:, None]], axis=1)
+    target = jnp.where(off_beam, N, jnp.maximum(gold_idx, 0))
+    logp = jax.nn.log_softmax(scores, axis=-1)
+    nll = -jnp.take_along_axis(logp, target[:, None], 1)[:, 0]
+    return jnp.mean(nll)

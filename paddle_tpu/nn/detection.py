@@ -1,0 +1,368 @@
+"""SSD-style detection family — prior boxes, multibox loss, NMS output, ROI
+pooling.
+
+Reference: ``paddle/gserver/layers/PriorBox.cpp``, ``MultiBoxLossLayer.cpp``,
+``DetectionOutputLayer.cpp``, ``DetectionUtil.cpp``, ``ROIPoolLayer.cpp``.
+
+TPU-first design notes:
+- Prior boxes depend only on static shapes, so they are generated host-side
+  (numpy) at module-build/trace time and baked into the program as constants —
+  no per-step device work at all.
+- Matching, hard-negative mining, and NMS are the classically "dynamic" parts
+  of SSD. Here they are all static-shape and jit-safe: ground truth arrives
+  padded ([B, G, 4] with a -1 label for padding), bipartite matching is a
+  ``lax.fori_loop`` of G global-argmax steps over a [P, G] overlap matrix
+  (exactly the reference's greedy bipartite phase, DetectionUtil.cpp:234),
+  negative mining uses the rank-of-rank trick instead of a host sort, and NMS
+  is a fixed-K ``fori_loop`` of select-max-then-suppress. Everything batches
+  over images with ``vmap``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import costs
+
+__all__ = ["prior_box", "iou_matrix", "encode_boxes", "decode_boxes",
+           "match_priors", "MultiBoxLoss", "nms", "DetectionOutput",
+           "ROIPool"]
+
+
+def prior_box(feature_shape: Tuple[int, int],
+              image_shape: Tuple[int, int],
+              min_sizes: Sequence[float],
+              max_sizes: Sequence[float] = (),
+              aspect_ratios: Sequence[float] = (),
+              variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+              clip: bool = True,
+              flip: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Generate SSD prior boxes for one feature map.
+
+    Returns ``(boxes, variances)``, each ``[H*W*num_priors, 4]`` with boxes as
+    normalized ``(xmin, ymin, xmax, ymax)``. Per-cell ordering matches the
+    reference (``PriorBox.cpp`` forward): for each min_size — the ar=1 box,
+    then the ``sqrt(min*max)`` box, then the remaining aspect ratios (with
+    reciprocals appended when ``flip``).
+    """
+    fh, fw = feature_shape
+    ih, iw = image_shape
+    step_w = iw / fw
+    step_h = ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        ars.append(float(ar))
+        if flip:
+            ars.append(1.0 / float(ar))
+    if max_sizes:
+        assert len(max_sizes) == len(min_sizes)
+    boxes = []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + 0.5) * step_w
+            cy = (y + 0.5) * step_h
+            for s, mn in enumerate(min_sizes):
+                bw = bh = float(mn)
+                boxes.append((cx - bw / 2, cy - bh / 2,
+                              cx + bw / 2, cy + bh / 2))
+                if max_sizes:
+                    bw = bh = math.sqrt(mn * max_sizes[s])
+                    boxes.append((cx - bw / 2, cy - bh / 2,
+                                  cx + bw / 2, cy + bh / 2))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    bw = mn * math.sqrt(ar)
+                    bh = mn / math.sqrt(ar)
+                    boxes.append((cx - bw / 2, cy - bh / 2,
+                                  cx + bw / 2, cy + bh / 2))
+    b = np.asarray(boxes, np.float32) / np.array([iw, ih, iw, ih], np.float32)
+    if clip:
+        b = np.clip(b, 0.0, 1.0)
+    var = np.tile(np.asarray(variance, np.float32)[None, :], (b.shape[0], 1))
+    return jnp.asarray(b), jnp.asarray(var)
+
+
+def _area(boxes):
+    w = jnp.maximum(boxes[..., 2] - boxes[..., 0], 0.0)
+    h = jnp.maximum(boxes[..., 3] - boxes[..., 1], 0.0)
+    return w * h
+
+
+def iou_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise Jaccard overlap, [N, 4] x [M, 4] -> [N, M] (reference:
+    ``DetectionUtil.cpp:91``)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _area(a)[:, None] + _area(b)[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _center_size(boxes):
+    wh = boxes[..., 2:] - boxes[..., :2]
+    c = (boxes[..., :2] + boxes[..., 2:]) / 2
+    return c, wh
+
+
+def encode_boxes(priors, variances, gt):
+    """Center-size encode gt against priors (``encodeBBoxWithVar``,
+    ``DetectionUtil.cpp:112``)."""
+    pc, pwh = _center_size(priors)
+    gc, gwh = _center_size(gt)
+    pwh = jnp.maximum(pwh, 1e-8)
+    d_c = (gc - pc) / pwh / variances[..., :2]
+    d_wh = jnp.log(jnp.maximum(jnp.abs(gwh / pwh), 1e-8)) / variances[..., 2:]
+    return jnp.concatenate([d_c, d_wh], -1)
+
+
+def decode_boxes(priors, variances, loc):
+    """Inverse of :func:`encode_boxes` (``decodeBBoxWithVar``)."""
+    pc, pwh = _center_size(priors)
+    c = loc[..., :2] * variances[..., :2] * pwh + pc
+    wh = jnp.exp(loc[..., 2:] * variances[..., 2:]) * pwh
+    return jnp.concatenate([c - wh / 2, c + wh / 2], -1)
+
+
+def match_priors(priors: jnp.ndarray, gt_boxes: jnp.ndarray,
+                 gt_valid: jnp.ndarray, overlap_threshold: float = 0.5):
+    """SSD matching for one image: greedy bipartite then per-prior threshold
+    (``matchBBox``, ``DetectionUtil.cpp:234``). Returns ``(match_idx [P]
+    int32, -1 = unmatched, overlaps [P])``. ``gt_valid`` is a [G] bool mask
+    over padded ground truth rows.
+    """
+    P = priors.shape[0]
+    G = gt_boxes.shape[0]
+    ov = iou_matrix(priors, gt_boxes)           # [P, G]
+    ov = jnp.where(gt_valid[None, :], ov, 0.0)
+    ov = jnp.where(ov > 1e-6, ov, 0.0)
+    best_overlap = jnp.max(ov, axis=1)
+
+    def bipartite_step(_, state):
+        match, avail = state                    # avail: [P,G] pairs still open
+        masked = jnp.where(avail, ov, -1.0)
+        flat = jnp.argmax(masked)
+        i, j = flat // G, flat % G
+        ok = masked[i, j] > 0.0
+        match = jnp.where(ok, match.at[i].set(j), match)
+        avail = jnp.where(ok, avail.at[i, :].set(False).at[:, j].set(False),
+                          jnp.zeros_like(avail))
+        return match, avail
+
+    match0 = jnp.full((P,), -1, jnp.int32)
+    avail0 = jnp.broadcast_to(gt_valid[None, :], (P, G))
+    match, _ = lax.fori_loop(0, G, bipartite_step, (match0, avail0))
+
+    # Per-prediction phase: any still-unmatched prior takes its best gt if
+    # the overlap clears the threshold.
+    best_gt = jnp.argmax(ov, axis=1).astype(jnp.int32)
+    take = (match < 0) & (best_overlap >= overlap_threshold)
+    match = jnp.where(take, best_gt, match)
+    return match, best_overlap
+
+
+class MultiBoxLoss(Module):
+    """SSD multibox loss: smooth-L1 localisation on matched priors + softmax
+    confidence with hard negative mining (reference:
+    ``MultiBoxLossLayer.cpp``; knobs at ``:31-34``).
+
+    ``forward(loc_preds [B,P,4], conf_preds [B,P,C], gt_boxes [B,G,4],
+    gt_labels [B,G] with -1 padding)`` -> scalar loss (sum of loc+conf,
+    normalised by the number of matched priors, as the reference does).
+    """
+
+    def __init__(self, priors, variances, num_classes: int,
+                 overlap_threshold: float = 0.5, neg_pos_ratio: float = 3.0,
+                 neg_overlap: float = 0.5, background_id: int = 0,
+                 name: str = "multibox_loss"):
+        super().__init__(name=name)
+        self.priors = priors
+        self.variances = variances
+        self.num_classes = num_classes
+        self.overlap_threshold = overlap_threshold
+        self.neg_pos_ratio = neg_pos_ratio
+        self.neg_overlap = neg_overlap
+        self.background_id = background_id
+
+    def forward(self, loc_preds, conf_preds, gt_boxes, gt_labels):
+        def per_image(loc_p, conf_p, g_box, g_lab):
+            valid = g_lab >= 0
+            match, overlap = match_priors(self.priors, g_box, valid,
+                                          self.overlap_threshold)
+            pos = match >= 0
+            npos = jnp.sum(pos)
+            safe_match = jnp.maximum(match, 0)
+            # --- localisation: smooth L1 on positives
+            gt_for_prior = g_box[safe_match]
+            loc_t = encode_boxes(self.priors, self.variances, gt_for_prior)
+            sl1 = costs.smooth_l1_elementwise(loc_p, loc_t)
+            loc_loss = jnp.sum(jnp.where(pos[:, None], sl1, 0.0))
+            # --- confidence: CE vs matched label (background for negatives)
+            conf_t = jnp.where(pos, g_lab[safe_match], self.background_id)
+            logp = jax.nn.log_softmax(conf_p, -1)
+            ce = -jnp.take_along_axis(logp, conf_t[:, None], 1)[:, 0]
+            # hard negative mining: candidates are unmatched priors whose
+            # best overlap is below neg_overlap; keep the highest-loss
+            # neg_pos_ratio * npos of them (rank-of-rank, no host sort)
+            neg_cand = (~pos) & (overlap < self.neg_overlap)
+            neg_loss = jnp.where(neg_cand, ce, -jnp.inf)
+            order = jnp.argsort(-neg_loss)
+            rank = jnp.argsort(order)
+            num_neg = jnp.minimum((self.neg_pos_ratio * npos).astype(jnp.int32),
+                                  jnp.sum(neg_cand))
+            neg = neg_cand & (rank < num_neg)
+            conf_loss = jnp.sum(jnp.where(pos | neg, ce, 0.0))
+            return loc_loss, conf_loss, npos
+
+        loc_l, conf_l, npos = jax.vmap(per_image)(
+            loc_preds, conf_preds, gt_boxes, gt_labels)
+        denom = jnp.maximum(jnp.sum(npos), 1).astype(loc_preds.dtype)
+        return (jnp.sum(loc_l) + jnp.sum(conf_l)) / denom
+
+
+def nms(boxes: jnp.ndarray, scores: jnp.ndarray, max_out: int,
+        iou_threshold: float = 0.45,
+        score_threshold: float = 0.01) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Jit-safe greedy NMS (``applyNMSFast``, ``DetectionUtil.cpp:432``).
+
+    Fixed ``max_out`` iterations of select-highest-then-suppress over static
+    shapes. Returns ``(indices [max_out], keep_mask [max_out])``; slots past
+    the survivor count have ``keep=False``.
+    """
+    alive = scores > score_threshold
+
+    def step(_, state):
+        alive, idxs, keep, k = state
+        s = jnp.where(alive, scores, -jnp.inf)
+        i = jnp.argmax(s)
+        ok = s[i] > -jnp.inf
+        overl = iou_matrix(boxes[i][None, :], boxes)[0]
+        alive = alive & (overl <= iou_threshold)
+        alive = alive.at[i].set(False)
+        idxs = jnp.where(ok, idxs.at[k].set(i.astype(jnp.int32)), idxs)
+        keep = jnp.where(ok, keep.at[k].set(True), keep)
+        return alive, idxs, keep, k + jnp.where(ok, 1, 0)
+
+    idxs0 = jnp.zeros((max_out,), jnp.int32)
+    keep0 = jnp.zeros((max_out,), bool)
+    _, idxs, keep, _ = lax.fori_loop(0, max_out, step,
+                                     (alive, idxs0, keep0, 0))
+    return idxs, keep
+
+
+class DetectionOutput(Module):
+    """Decode + per-class NMS + cross-class top-k (reference:
+    ``DetectionOutputLayer.cpp``; ``getDetectionIndices`` at
+    ``DetectionUtil.cpp:466``).
+
+    ``forward(loc_preds [B,P,4], conf_preds [B,P,C])`` ->
+    ``[B, keep_top_k, 6]`` rows of ``(label, score, xmin, ymin, xmax, ymax)``
+    with ``label = -1`` padding. Fixed output shape keeps the whole decode
+    path inside one XLA program.
+    """
+
+    def __init__(self, priors, variances, num_classes: int,
+                 background_id: int = 0, nms_threshold: float = 0.45,
+                 nms_top_k: int = 64, keep_top_k: int = 32,
+                 confidence_threshold: float = 0.01,
+                 name: str = "detection_output"):
+        super().__init__(name=name)
+        self.priors = priors
+        self.variances = variances
+        self.num_classes = num_classes
+        self.background_id = background_id
+        self.nms_threshold = nms_threshold
+        self.nms_top_k = nms_top_k
+        self.keep_top_k = keep_top_k
+        self.confidence_threshold = confidence_threshold
+
+    def forward(self, loc_preds, conf_preds):
+        classes = [c for c in range(self.num_classes)
+                   if c != self.background_id]
+
+        def per_image(loc_p, conf_p):
+            boxes = decode_boxes(self.priors, self.variances, loc_p)
+            probs = jax.nn.softmax(conf_p, -1)
+            rows = []
+            for c in classes:
+                idxs, keep = nms(boxes, probs[:, c], self.nms_top_k,
+                                 self.nms_threshold,
+                                 self.confidence_threshold)
+                sc = jnp.where(keep, probs[idxs, c], -1.0)
+                lab = jnp.where(keep, c, -1).astype(jnp.float32)
+                rows.append(jnp.concatenate(
+                    [lab[:, None], sc[:, None], boxes[idxs]], -1))
+            allrows = jnp.concatenate(rows, 0)      # [(C-1)*nms_top_k, 6]
+            if allrows.shape[0] < self.keep_top_k:
+                # keep the output shape at the documented keep_top_k even
+                # when few classes/candidates exist
+                fill = jnp.full((self.keep_top_k - allrows.shape[0], 6), -1.0,
+                                allrows.dtype)
+                allrows = jnp.concatenate([allrows, fill], 0)
+            top = jnp.argsort(-allrows[:, 1])[:self.keep_top_k]
+            out = allrows[top]
+            # blank out slots whose score fell below threshold / padding
+            good = out[:, 1] > 0
+            return jnp.where(good[:, None], out,
+                             jnp.full_like(out, -1.0))
+
+        return jax.vmap(per_image)(loc_preds, conf_preds)
+
+
+class ROIPool(Module):
+    """Max ROI pooling (reference: ``ROIPoolLayer.cpp`` — rounded roi corners
+    at ``:97-100``, floor/ceil bin edges at ``:114-117``, empty bins -> 0).
+
+    ``forward(features [B,H,W,C], rois [R,5])`` with roi rows
+    ``(batch_idx, x1, y1, x2, y2)`` in image coordinates ->
+    ``[R, ph, pw, C]``. Bins are realised as boolean masks over the feature
+    map (one fused masked-max per bin) — static shapes, no gather scatter.
+    """
+
+    def __init__(self, pooled_height: int, pooled_width: int,
+                 spatial_scale: float, name: str = "roi_pool"):
+        super().__init__(name=name)
+        self.ph = pooled_height
+        self.pw = pooled_width
+        self.spatial_scale = spatial_scale
+
+    def forward(self, features, rois):
+        B, H, W, C = features.shape
+        hh = jnp.arange(H)
+        ww = jnp.arange(W)
+
+        def per_roi(roi):
+            b = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * self.spatial_scale)
+            y1 = jnp.round(roi[2] * self.spatial_scale)
+            x2 = jnp.round(roi[3] * self.spatial_scale)
+            y2 = jnp.round(roi[4] * self.spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            bin_h = rh / self.ph
+            bin_w = rw / self.pw
+            fmap = features[b]                   # [H, W, C]
+            ph_i = jnp.arange(self.ph)
+            pw_i = jnp.arange(self.pw)
+            hstart = jnp.clip(jnp.floor(ph_i * bin_h) + y1, 0, H)
+            hend = jnp.clip(jnp.ceil((ph_i + 1) * bin_h) + y1, 0, H)
+            wstart = jnp.clip(jnp.floor(pw_i * bin_w) + x1, 0, W)
+            wend = jnp.clip(jnp.ceil((pw_i + 1) * bin_w) + x1, 0, W)
+            hmask = (hh[None, :] >= hstart[:, None]) & \
+                    (hh[None, :] < hend[:, None])         # [ph, H]
+            wmask = (ww[None, :] >= wstart[:, None]) & \
+                    (ww[None, :] < wend[:, None])         # [pw, W]
+            mask = hmask[:, None, :, None] & wmask[None, :, None, :]
+            vals = jnp.where(mask[..., None], fmap[None, None], -jnp.inf)
+            out = jnp.max(vals, axis=(2, 3))              # [ph, pw, C]
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        return jax.vmap(per_roi)(rois)

@@ -35,7 +35,8 @@ __all__ = [
     "SumToOneNorm", "DataNorm", "L2Distance", "CosSim", "OuterProd", "ConvShift",
     "SlopeIntercept", "Pad2D", "Crop2D", "Resize", "Rotate", "Addto", "Concat",
     "MixedLayer", "FullMatrixProjection", "TableProjection", "IdentityProjection",
-    "DotMulProjection", "ContextProjection",
+    "DotMulProjection", "ContextProjection", "CrossMapNormal", "RowConv",
+    "Conv3D", "Conv3DTranspose", "Pool3D", "SelectiveFC", "SamplingId",
 ]
 
 Pair = Union[int, Tuple[int, int]]
@@ -736,3 +737,219 @@ class MixedLayer(Module):
         if self.use_bias:
             y = y + self.param("b", I.zeros, (y.shape[-1],))
         return self.act(y)
+
+
+class CrossMapNormal(Module):
+    """Local response normalisation across channel maps (reference:
+    ``function/CrossMapNormalOp.cpp`` — ``f(x) = x * (1 + scale *
+    SUM_window(x^2))^(-pow)`` with the window of ``size`` maps centred at
+    each channel; layer wrapper ``CMRProjectionNormLayer``). NHWC.
+
+    The config-helper surface (``img_cmrnorm_layer``) passes
+    ``scale = alpha / size``; this module takes ``scale``/``power`` directly
+    like the function layer does.
+    """
+
+    def __init__(self, size: int = 5, scale: float = 0.0001,
+                 power: float = 0.75, name=None):
+        super().__init__(name=name)
+        self.size = size
+        self.scale = scale
+        self.power = power
+
+    def forward(self, x):
+        half = (self.size - 1) // 2
+        sq = x * x
+        # sum over a channel window: pad C then window-sum via cumsum diff
+        pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) +
+                      [(half, self.size - 1 - half)])
+        csum = jnp.cumsum(pad, axis=-1)
+        csum = jnp.pad(csum, [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+        win = csum[..., self.size:] - csum[..., :-self.size]
+        denom = (1.0 + self.scale * win) ** (-self.power)
+        return x * denom
+
+
+class RowConv(Module):
+    """Lookahead row convolution over packed sequences (reference:
+    ``function/RowConvOp.cpp`` — ``out[t] = sum_k filter[k] * in[t+k]``
+    elementwise per feature, truncated at each sequence end; from the
+    DeepSpeech2 architecture).
+
+    ``forward(x [B, T, D], lengths [B])``; context rows beyond a sequence's
+    length contribute zero, matching the reference's per-sequence truncation.
+    """
+
+    def __init__(self, context: int, w_init=I.zeros, name=None):
+        super().__init__(name=name)
+        self.context = context
+        self.w_init = w_init
+
+    def forward(self, x, lengths=None):
+        B, T, D = x.shape
+        if lengths is None:
+            lengths = jnp.full((B,), T)
+        w = self.param("w", self.w_init, (self.context, D))
+        idx = jnp.arange(T)
+        out = jnp.zeros_like(x)
+        for k in range(self.context):
+            shifted = jnp.roll(x, -k, axis=1)
+            valid = (idx + k < lengths[:, None])[..., None]
+            out = out + jnp.where(valid, shifted, 0.0) * w[k]
+        return out
+
+
+class Conv3D(Module):
+    """3-D convolution, NDHWC/DHWIO (reference: ``Conv3DLayer.cpp``). One
+    ``lax.conv_general_dilated`` call — XLA tiles it onto the MXU the same
+    way as 2-D convs."""
+
+    def __init__(self, features: int, kernel, stride=1, padding="SAME",
+                 act="", use_bias=True, w_init=I.fan_in_uniform,
+                 b_init=I.zeros, name=None):
+        super().__init__(name=name)
+        self.features = features
+        self.kernel = (kernel,) * 3 if isinstance(kernel, int) else tuple(kernel)
+        self.stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+        self.padding = padding
+        self.act = activations.get(act)
+        self.use_bias = use_bias
+        self.w_init = w_init
+        self.b_init = b_init
+
+    def forward(self, x):
+        pol = current_policy()
+        w = self.param("w", self.w_init,
+                       self.kernel + (x.shape[-1], self.features))
+        pad = self.padding
+        if isinstance(pad, int):
+            pad = [(pad, pad)] * 3
+        # No preferred_element_type on convs: the rhs-transpose rule in the
+        # conv gradient requires operand dtypes to match (same constraint as
+        # Conv2D above).
+        y = lax.conv_general_dilated(
+            pol.cast_compute(x), pol.cast_compute(w),
+            window_strides=self.stride, padding=pad,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.use_bias:
+            y = y + self.param("b", self.b_init,
+                               (self.features,)).astype(y.dtype)
+        return self.act(y)
+
+
+class Conv3DTranspose(Module):
+    """3-D transposed convolution (reference: ``DeConv3DLayer.cpp``)."""
+
+    def __init__(self, features: int, kernel, stride=1, padding="SAME",
+                 act="", use_bias=True, w_init=I.fan_in_uniform,
+                 b_init=I.zeros, name=None):
+        super().__init__(name=name)
+        self.features = features
+        self.kernel = (kernel,) * 3 if isinstance(kernel, int) else tuple(kernel)
+        self.stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+        self.padding = padding
+        self.act = activations.get(act)
+        self.use_bias = use_bias
+        self.w_init = w_init
+        self.b_init = b_init
+
+    def forward(self, x):
+        pol = current_policy()
+        w = self.param("w", self.w_init,
+                       self.kernel + (x.shape[-1], self.features))
+        pad = self.padding
+        if isinstance(pad, int):
+            pad = [(pad, pad)] * 3
+        y = lax.conv_transpose(
+            pol.cast_compute(x), pol.cast_compute(w),
+            strides=self.stride, padding=pad,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.use_bias:
+            y = y + self.param("b", self.b_init,
+                               (self.features,)).astype(y.dtype)
+        return self.act(y)
+
+
+class Pool3D(Module):
+    """3-D max/avg pooling, NDHWC (reference: ``Pool3DLayer.cpp``)."""
+
+    def __init__(self, kind: str, window, stride=None, padding="VALID",
+                 name=None):
+        super().__init__(name=name)
+        assert kind in ("max", "avg")
+        self.kind = kind
+        self.window = (window,) * 3 if isinstance(window, int) else tuple(window)
+        stride = stride if stride is not None else window
+        self.stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+        self.padding = padding
+
+    def forward(self, x):
+        dims = (1,) + self.window + (1,)
+        strides = (1,) + self.stride + (1,)
+        if self.kind == "max":
+            return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides,
+                                     self.padding)
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, self.padding)
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                self.padding)
+        return s / cnt
+
+
+class SelectiveFC(Module):
+    """Fully-connected over a per-sample subset of output columns (reference:
+    ``SelectiveFullyConnectedLayer.cpp`` — used for large-vocab softmax where
+    only sampled columns are computed).
+
+    ``forward(x [B, D], sel [B, K])`` computes ``x @ W[:, sel[b]] + b[sel[b]]``
+    per sample — a gather of weight columns followed by a batched matvec
+    (einsum), instead of the reference's sparse-matrix product. ``sel`` ids
+    < 0 yield zeros. ``forward(x)`` without ``sel`` is a plain Linear (the
+    reference's full-matrix mode at inference)."""
+
+    def __init__(self, features: int, act="", use_bias=True,
+                 w_init=I.fan_in_uniform, b_init=I.zeros, name=None):
+        super().__init__(name=name)
+        self.features = features
+        self.act = activations.get(act)
+        self.use_bias = use_bias
+        self.w_init = w_init
+        self.b_init = b_init
+
+    def forward(self, x, sel=None):
+        pol = current_policy()
+        w = self.param("w", self.w_init, (x.shape[-1], self.features))
+        b = self.param("b", self.b_init, (self.features,)) \
+            if self.use_bias else None
+        if sel is None:
+            y = jnp.dot(pol.cast_compute(x), pol.cast_compute(w),
+                        preferred_element_type=pol.accum_dtype)
+            if b is not None:
+                y = y + b
+            return self.act(y)
+        valid = sel >= 0
+        safe = jnp.clip(sel, 0, self.features - 1)
+        w_sel = jnp.take(w, safe, axis=1)          # [D, B, K]
+        w_sel = jnp.moveaxis(w_sel, 1, 0)          # [B, D, K]
+        y = jnp.einsum("bd,bdk->bk", pol.cast_compute(x),
+                       pol.cast_compute(w_sel),
+                       preferred_element_type=pol.accum_dtype)
+        if b is not None:
+            y = y + jnp.take(b, safe)
+        return jnp.where(valid, self.act(y), 0.0)
+
+
+class SamplingId(Module):
+    """Sample an id per row from a (softmax) distribution (reference:
+    ``SamplingIdLayer.cpp`` + ``MultinomialSampler``). Input is logits by
+    default (``from_logits=False`` for probabilities). Needs an ``rngs=
+    {'sample': key}`` stream under apply."""
+
+    def __init__(self, from_logits: bool = True, name=None):
+        super().__init__(name=name)
+        self.from_logits = from_logits
+
+    def forward(self, x):
+        logits = x if self.from_logits else jnp.log(jnp.maximum(x, 1e-30))
+        key = current_rng("sample")
+        return jax.random.categorical(key, logits, axis=-1)

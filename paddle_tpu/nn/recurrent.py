@@ -243,3 +243,82 @@ class BiRNN(Module):
         of, _ = self.fwd(x, mask=mask, segment_starts=segment_starts)
         ob, _ = self.bwd(x, mask=mask, segment_starts=segment_starts)
         return jnp.concatenate([of, ob], axis=-1)
+
+
+class MDLstm(Module):
+    """Two-dimensional multi-directional LSTM over an image grid (reference:
+    ``MDLstmLayer.cpp`` — Graves-style MDLSTM: each cell (i, j) receives
+    recurrent input from its top (i-1, j) and left (i, j-1) neighbours, with
+    one forget gate per direction).
+
+    ``forward(x [B, H, W, D]) -> h [B, H, W, hidden]``. Implemented as a
+    ``lax.scan`` over rows whose carry is the previous row's (h, c)
+    [B, W, hidden], with an inner scan over columns carrying (h_left,
+    c_left) — the same O(H*W) sequential dependency the recurrence itself
+    has. Set ``reverse_h``/``reverse_w`` for the other three scan
+    directions (the reference instantiates 4 directions for full MD-LSTM).
+    """
+
+    def __init__(self, hidden: int, act="tanh", gate_act="sigmoid",
+                 reverse_h: bool = False, reverse_w: bool = False, name=None):
+        super().__init__(name=name)
+        self.hidden = hidden
+        self.act = activations.get(act)
+        self.gate_act = activations.get(gate_act)
+        self.reverse_h = reverse_h
+        self.reverse_w = reverse_w
+
+    def forward(self, x):
+        B, H, W, D = x.shape
+        hd = self.hidden
+        wx = self.param("wx", I.xavier_uniform, (D, 5 * hd))
+        wh_up = self.param("wh_up", I.orthogonal(), (hd, 5 * hd))
+        wh_left = self.param("wh_left", I.orthogonal(), (hd, 5 * hd))
+        b = self.param("b", I.zeros, (5 * hd,))
+
+        if self.reverse_h:
+            x = x[:, ::-1]
+        if self.reverse_w:
+            x = x[:, :, ::-1]
+        # precompute the input contribution for every cell in one matmul
+        zx = jnp.einsum("bhwd,dk->bhwk", x, wx) + b
+
+        def cell(h_up, c_up, h_left, c_left, z_in):
+            z = z_in + h_up @ wh_up + h_left @ wh_left
+            zi, zf1, zf2, zg, zo = jnp.split(z, 5, axis=-1)
+            i = self.gate_act(zi)
+            f_up = self.gate_act(zf1)
+            f_left = self.gate_act(zf2)
+            c = f_up * c_up + f_left * c_left + i * self.act(zg)
+            h = self.gate_act(zo) * self.act(c)
+            return h, c
+
+        def row_step(carry_row, z_row):
+            # carry_row: (h, c) of the row above, each [B, W, hd]
+            h_above, c_above = carry_row
+
+            def col_step(carry_col, inputs):
+                h_left, c_left = carry_col
+                z_in, h_up, c_up = inputs
+                h, c = cell(h_up, c_up, h_left, c_left, z_in)
+                return (h, c), (h, c)
+
+            zeros = jnp.zeros((B, hd), zx.dtype)
+            (_, _), (h_row, c_row) = jax.lax.scan(
+                col_step, (zeros, zeros),
+                (jnp.swapaxes(z_row, 0, 1),
+                 jnp.swapaxes(h_above, 0, 1),
+                 jnp.swapaxes(c_above, 0, 1)))
+            h_row = jnp.swapaxes(h_row, 0, 1)     # [B, W, hd]
+            c_row = jnp.swapaxes(c_row, 0, 1)
+            return (h_row, c_row), h_row
+
+        zeros_row = jnp.zeros((B, W, hd), zx.dtype)
+        _, h_all = jax.lax.scan(row_step, (zeros_row, zeros_row),
+                                jnp.swapaxes(zx, 0, 1))
+        h = jnp.swapaxes(h_all, 0, 1)             # [B, H, W, hd]
+        if self.reverse_h:
+            h = h[:, ::-1]
+        if self.reverse_w:
+            h = h[:, :, ::-1]
+        return h

@@ -63,6 +63,13 @@ def verify_manifest(d: str, verify_crc: bool = True) -> Dict[str, Any]:
     integrity check, ``go/pserver/service.go:346``)."""
     with open(os.path.join(d, _MANIFEST)) as f:
         manifest = json.load(f)
+    # Early manifests keyed entries by collection name ('params') rather than
+    # filename ('params.npz'); normalise so both generations load.
+    manifest["files"] = {
+        (f if os.path.exists(os.path.join(d, f))
+         or not os.path.exists(os.path.join(d, f + ".npz"))
+         else f + ".npz"): info
+        for f, info in manifest["files"].items()}
     if verify_crc:
         for fname, info in manifest["files"].items():
             if _file_crc(os.path.join(d, fname)) != info["crc32"]:

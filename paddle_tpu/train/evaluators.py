@@ -17,7 +17,9 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = ["Evaluator", "ClassificationError", "PrecisionRecall", "Auc",
-           "RankAuc", "PnPair", "ChunkEvaluator", "EvaluatorSet"]
+           "RankAuc", "PnPair", "ChunkEvaluator", "CtcErrorEvaluator",
+           "DetectionMAP", "SumEvaluator", "ColumnSumEvaluator", "ValuePrinter",
+           "MaxIdPrinter", "SequenceTextPrinter", "EvaluatorSet"]
 
 
 class Evaluator:
@@ -337,6 +339,383 @@ class ChunkEvaluator(Evaluator):
         r = self._correct / max(1, self._gold)
         f1 = 2 * p * r / max(1e-9, p + r)
         return {"chunk_precision": p, "chunk_recall": r, "chunk_f1": f1}
+
+
+def _collapse_ctc_path(path: np.ndarray, blank: int) -> np.ndarray:
+    """Best-path → label string: drop blanks, merge repeats not separated by a
+    blank (reference: ``CTCErrorEvaluator.cpp`` ``path2String``)."""
+    if len(path) == 0:
+        return path
+    prev = np.concatenate([[blank], path[:-1]])
+    keep = (path != blank) & ((path != prev) | (prev == blank))
+    return path[keep]
+
+
+def _edit_distance_matrix(gold: np.ndarray, gold_len: np.ndarray,
+                          hyp: np.ndarray, hyp_len: np.ndarray) -> np.ndarray:
+    """Levenshtein DP tables for a whole batch at once, [B, N+1, M+1].
+
+    The left-to-right dependency ``d[i,j] = min(..., d[i,j-1]+1)`` is resolved
+    without a scalar loop: with ``tmp[j] = min(d[i-1,j]+1, d[i-1,j-1]+cost)``,
+    unrolling gives ``d[i,j] = min_{k<=j}(tmp[k]-k) + j`` — a running minimum,
+    so each row is one ``np.minimum.accumulate`` over the batch. Rows (gold
+    positions) remain a Python loop of length N.
+    """
+    B = gold.shape[0]
+    N = int(gold_len.max(initial=0))
+    M = int(hyp_len.max(initial=0))
+    jj = np.arange(M + 1)
+    D = np.empty((B, N + 1, M + 1), np.int32)
+    D[:, 0, :] = jj[None, :]
+    for i in range(1, N + 1):
+        cost = (gold[:, i - 1][:, None] != hyp[:, :M]).astype(np.int32)
+        prev = D[:, i - 1, :]
+        tmp = np.empty((B, M + 1), np.int32)
+        tmp[:, 0] = i
+        np.minimum(prev[:, 1:] + 1, prev[:, :-1] + cost, out=tmp[:, 1:])
+        D[:, i, :] = np.minimum.accumulate(tmp - jj[None, :], axis=1) + jj
+    return D
+
+
+def _backtrace_counts(D: np.ndarray, n: int, m: int):
+    """(substitutions, deletions, insertions) following the reference's
+    tie-break order: match > substitution > deletion > insertion
+    (``CTCErrorEvaluator.cpp`` ``stringAlignment`` backtrace)."""
+    i, j = n, m
+    sub = dele = ins = 0
+    while i and j:
+        if D[i, j] == D[i - 1, j - 1]:
+            i -= 1
+            j -= 1
+        elif D[i, j] == D[i - 1, j - 1] + 1:
+            sub += 1
+            i -= 1
+            j -= 1
+        elif D[i, j] == D[i - 1, j] + 1:
+            dele += 1
+            i -= 1
+        else:
+            ins += 1
+            j -= 1
+    return sub, dele + i, ins + j
+
+
+class CtcErrorEvaluator(Evaluator):
+    """Sequence edit-distance error for CTC models (reference:
+    ``CTCErrorEvaluator.cpp:318``, registered as ``ctc_edit_distance``).
+
+    Per sequence: best-path decode the frame activations (argmax, collapse,
+    blank = num_classes-1), align against the gold label sequence, and
+    normalise distance and per-kind counts by ``max(len(gold), len(hyp))``.
+    Reports error / deletion_error / insertion_error / substitution_error /
+    sequence_error, all averaged over sequences, as the reference does.
+
+    Expects ``outputs`` of shape [B, T, C] with frame lengths in
+    ``batch['length']``, gold labels in ``batch['label']`` ([B, L], padded
+    with -1) with lengths in ``batch['label_length']`` (defaults to counting
+    non-negative labels).
+    """
+
+    def __init__(self, name="ctc_edit_distance"):
+        self.name = name
+        self.reset()
+
+    def batch_stats(self, outputs, batch):
+        # argmax on device; everything else is small host work
+        return {"path": jnp.argmax(outputs, -1),
+                "length": batch["length"],
+                "label": batch["label"],
+                "label_length": batch.get(
+                    "label_length",
+                    jnp.sum(batch["label"] >= 0, axis=-1)),
+                "blank": jnp.asarray(outputs.shape[-1] - 1)}
+
+    def reset(self):
+        self._score = self._del = self._ins = self._sub = 0.0
+        self._seq_err = 0
+        self._num_seq = 0
+
+    def update(self, stats):
+        paths = np.asarray(stats["path"])
+        lengths = np.asarray(stats["length"])
+        labels = np.asarray(stats["label"])
+        label_lens = np.asarray(stats["label_length"])
+        blank = int(stats["blank"])
+        B = paths.shape[0]
+        hyps = [_collapse_ctc_path(paths[b, :lengths[b]], blank)
+                for b in range(B)]
+        hyp_len = np.array([len(h) for h in hyps], np.int32)
+        M = int(hyp_len.max(initial=0))
+        hyp = np.zeros((B, max(M, 1)), labels.dtype)
+        for b, h in enumerate(hyps):
+            hyp[b, :len(h)] = h
+        D = _edit_distance_matrix(labels, label_lens, hyp, hyp_len)
+        for b in range(B):
+            n, m = int(label_lens[b]), int(hyp_len[b])
+            if n == 0:
+                sub, dele, ins = 0, 0, m
+            elif m == 0:
+                sub, dele, ins = 0, n, 0
+            else:
+                sub, dele, ins = _backtrace_counts(D[b], n, m)
+            dist = sub + dele + ins
+            max_len = max(1, n, m)
+            self._score += dist / max_len
+            self._sub += sub / max_len
+            self._del += dele / max_len
+            self._ins += ins / max_len
+            self._seq_err += int(dist != 0)
+            self._num_seq += 1
+
+    def result(self):
+        d = max(1, self._num_seq)
+        return {"error": self._score / d,
+                "deletion_error": self._del / d,
+                "insertion_error": self._ins / d,
+                "substitution_error": self._sub / d,
+                "sequence_error": self._seq_err / d}
+
+
+class SumEvaluator(Evaluator):
+    """Sum of an output column, optionally weighted (reference:
+    ``SumEvaluator``, ``Evaluator.cpp:180``)."""
+
+    def __init__(self, name="sum"):
+        self.name = name
+        self.reset()
+
+    def batch_stats(self, outputs, batch):
+        v = outputs[..., 0] if outputs.ndim > 1 else outputs
+        w = batch.get("weight", jnp.ones(v.shape[0]))
+        return {"sum": jnp.sum(v * w), "count": jnp.sum(w)}
+
+    def reset(self):
+        self._sum = self._count = 0.0
+
+    def update(self, stats):
+        self._sum += float(stats["sum"])
+        self._count += float(stats["count"])
+
+    def result(self):
+        # weights may sum below 1, so guard only against zero (unlike the
+        # integer-count evaluators above)
+        return {self.name: self._sum / (self._count if self._count else 1.0)}
+
+
+class ColumnSumEvaluator(Evaluator):
+    """Per-column mean of an output matrix (reference: ``ColumnSumEvaluator``,
+    ``Evaluator.cpp:276``)."""
+
+    def __init__(self, name="column_sum"):
+        self.name = name
+        self.reset()
+
+    def batch_stats(self, outputs, batch):
+        w = batch.get("weight", jnp.ones(outputs.shape[0]))
+        return {"sum": jnp.sum(outputs * w[:, None], axis=0),
+                "count": jnp.sum(w)}
+
+    def reset(self):
+        self._sum = None
+        self._count = 0.0
+
+    def update(self, stats):
+        s = np.asarray(stats["sum"], np.float64)
+        self._sum = s if self._sum is None else self._sum + s
+        self._count += float(stats["count"])
+
+    def result(self):
+        if self._sum is None:
+            return {self.name: []}
+        return {self.name:
+                (self._sum / (self._count if self._count else 1.0)).tolist()}
+
+
+class DetectionMAP(Evaluator):
+    """Mean average precision for detection (reference:
+    ``DetectionMAPEvaluator.cpp:306``, registered as ``detection_map``).
+
+    Consumes fixed-shape detection output — ``outputs`` is the
+    :class:`~paddle_tpu.nn.detection.DetectionOutput` tensor
+    ``[B, K, 6]`` of ``(label, score, xmin, ymin, xmax, ymax)`` rows padded
+    with label = -1 — and padded ground truth ``batch['gt_box'] [B, G, 4]``,
+    ``batch['gt_label'] [B, G]`` (-1 padding), optional
+    ``batch['gt_difficult'] [B, G]``. Matching, accumulation, and AP follow
+    the reference exactly: per image/class greedy match by descending score
+    with IoU strictly above ``overlap_threshold``, each gt creditable once;
+    AP per class by '11point' (VOC2007) or 'Integral'; mAP averaged over
+    classes with positives, scaled by 100.
+    """
+
+    def __init__(self, overlap_threshold: float = 0.5,
+                 ap_type: str = "11point", evaluate_difficult: bool = False,
+                 name="detection_map"):
+        assert ap_type in ("11point", "Integral")
+        self.name = name
+        self.overlap_threshold = overlap_threshold
+        self.ap_type = ap_type
+        self.evaluate_difficult = evaluate_difficult
+        self.reset()
+
+    def batch_stats(self, outputs, batch):
+        return {"det": outputs, "gt_box": batch["gt_box"],
+                "gt_label": batch["gt_label"],
+                "gt_difficult": batch.get(
+                    "gt_difficult",
+                    jnp.zeros(batch["gt_label"].shape, jnp.int32))}
+
+    def reset(self):
+        self._num_pos = {}       # class -> gt count (non-difficult)
+        self._pairs = {}         # class -> list of (score, is_tp)
+
+    @staticmethod
+    def _iou_matrix(a, b):
+        """Vectorized pairwise IoU, [N,4] x [M,4] -> [N,M] (numpy mirror of
+        ``paddle_tpu.nn.detection.iou_matrix``)."""
+        lt = np.maximum(a[:, None, :2], b[None, :, :2])
+        rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = np.maximum(rb - lt, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        area = lambda x: (np.maximum(x[:, 2] - x[:, 0], 0.0) *
+                          np.maximum(x[:, 3] - x[:, 1], 0.0))
+        union = area(a)[:, None] + area(b)[None, :] - inter
+        return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+    def update(self, stats):
+        det = np.asarray(stats["det"])
+        gt_box = np.asarray(stats["gt_box"])
+        gt_label = np.asarray(stats["gt_label"])
+        gt_diff = np.asarray(stats["gt_difficult"]).astype(bool)
+        for b in range(det.shape[0]):
+            valid_gt = gt_label[b] >= 0
+            for c in np.unique(gt_label[b][valid_gt]):
+                sel = valid_gt & (gt_label[b] == c)
+                n = int(np.sum(sel & ~gt_diff[b])) if not \
+                    self.evaluate_difficult else int(np.sum(sel))
+                self._num_pos[int(c)] = self._num_pos.get(int(c), 0) + n
+            dmask = det[b, :, 0] >= 0
+            for c in np.unique(det[b][dmask, 0]).astype(int):
+                rows = det[b][dmask & (det[b, :, 0] == c)]
+                rows = rows[np.argsort(-rows[:, 1], kind="stable")]
+                gsel = np.flatnonzero(valid_gt & (gt_label[b] == c))
+                pairs = self._pairs.setdefault(int(c), [])
+                visited = np.zeros(len(gsel), bool)
+                iou_all = self._iou_matrix(rows[:, 2:6], gt_box[b][gsel]) \
+                    if len(gsel) else None
+                for r, score in enumerate(rows[:, 1]):
+                    if len(gsel) == 0:
+                        pairs.append((float(score), 0))
+                        continue
+                    ious = iou_all[r]
+                    j = int(np.argmax(ious))
+                    if ious[j] > self.overlap_threshold:
+                        if not self.evaluate_difficult and \
+                                gt_diff[b][gsel[j]]:
+                            continue       # difficult match: ignored entirely
+                        if not visited[j]:
+                            pairs.append((float(score), 1))
+                            visited[j] = True
+                        else:
+                            pairs.append((float(score), 0))
+                    else:
+                        pairs.append((float(score), 0))
+
+    def result(self):
+        aps = []
+        for c, npos in self._num_pos.items():
+            if npos == 0 or c not in self._pairs or not self._pairs[c]:
+                continue
+            pairs = sorted(self._pairs[c], key=lambda p: -p[0])
+            tp = np.cumsum([p[1] for p in pairs])
+            fp = np.cumsum([1 - p[1] for p in pairs])
+            prec = tp / np.maximum(tp + fp, 1)
+            rec = tp / npos
+            if self.ap_type == "11point":
+                ap = 0.0
+                for t in np.arange(0.0, 1.01, 0.1):
+                    mask = rec >= t
+                    ap += (prec[mask].max() if mask.any() else 0.0) / 11.0
+            else:
+                dr = np.diff(np.concatenate([[0.0], rec]))
+                ap = float(np.sum(prec * dr))
+            aps.append(ap)
+        return {self.name: 100.0 * float(np.mean(aps)) if aps else 0.0}
+
+
+class _Printer(Evaluator):
+    """Debug evaluators that log instead of scoring (reference printer
+    family, ``Evaluator.cpp:1020-1357``). ``sink`` defaults to the module
+    logger; pass e.g. ``print`` for tests."""
+
+    def __init__(self, name, sink=None):
+        self.name = name
+        if sink is None:
+            import logging
+            sink = logging.getLogger("paddle_tpu.evaluators").info
+        self._sink = sink
+
+    def reset(self):
+        pass
+
+    def update(self, stats):
+        self._sink("%s: %s" % (self.name, self._format(stats)))
+
+    def result(self):
+        return {}
+
+
+class ValuePrinter(_Printer):
+    """Logs output value summaries (reference: ``ValuePrinter``)."""
+
+    def __init__(self, name="value_printer", sink=None):
+        super().__init__(name, sink)
+
+    def batch_stats(self, outputs, batch):
+        return {"mean": jnp.mean(outputs), "abs_max": jnp.max(jnp.abs(outputs)),
+                "shape": np.asarray(outputs.shape)}
+
+    def _format(self, stats):
+        return "shape=%s mean=%.6g abs_max=%.6g" % (
+            tuple(np.asarray(stats["shape"]).tolist()),
+            float(stats["mean"]), float(stats["abs_max"]))
+
+
+class MaxIdPrinter(_Printer):
+    """Logs argmax ids per row (reference: ``MaxIdPrinter``)."""
+
+    def __init__(self, name="max_id_printer", sink=None, limit=16):
+        super().__init__(name, sink)
+        self.limit = limit
+
+    def batch_stats(self, outputs, batch):
+        return {"ids": jnp.argmax(outputs, -1)}
+
+    def _format(self, stats):
+        ids = np.asarray(stats["ids"]).ravel()[:self.limit]
+        return "ids=%s" % (ids.tolist(),)
+
+
+class SequenceTextPrinter(_Printer):
+    """Maps id sequences through a vocabulary and logs the text (reference:
+    ``SequenceTextPrinter``, ``Evaluator.cpp:1192``)."""
+
+    def __init__(self, vocab=None, name="seq_text_printer", sink=None):
+        super().__init__(name, sink)
+        self.vocab = vocab or {}
+
+    def batch_stats(self, outputs, batch):
+        return {"ids": outputs, "length": batch.get(
+            "length", jnp.full(outputs.shape[0], outputs.shape[-1]))}
+
+    def _format(self, stats):
+        ids = np.asarray(stats["ids"])
+        lengths = np.asarray(stats["length"])
+        rows = []
+        for b in range(ids.shape[0]):
+            toks = [str(self.vocab.get(int(i), int(i)))
+                    for i in ids[b, :lengths[b]]]
+            rows.append(" ".join(toks))
+        return " | ".join(rows)
 
 
 class EvaluatorSet:

@@ -120,3 +120,144 @@ def test_chunk_vectorized_matches_oracle(seed):
         npred += len(pc)
         ngold += len(gc)
     assert (ev._correct, ev._pred, ev._gold) == (correct, npred, ngold)
+
+
+# --------------------------------------------------------- ctc_edit_distance
+
+def _lev_oracle(a, b):
+    """Plain O(nm) scalar-loop Levenshtein with the reference's backtrace
+    tie-break (match > sub > del > ins), returning (sub, del, ins)."""
+    n, m = len(a), len(b)
+    D = [[0] * (m + 1) for _ in range(n + 1)]
+    for i in range(n + 1):
+        D[i][0] = i
+    for j in range(m + 1):
+        D[0][j] = j
+    for i in range(1, n + 1):
+        for j in range(1, m + 1):
+            c = 0 if a[i - 1] == b[j - 1] else 1
+            D[i][j] = min(D[i - 1][j] + 1, D[i][j - 1] + 1,
+                          D[i - 1][j - 1] + c)
+    i, j, sub, dele, ins = n, m, 0, 0, 0
+    while i and j:
+        if D[i][j] == D[i - 1][j - 1]:
+            i, j = i - 1, j - 1
+        elif D[i][j] == D[i - 1][j - 1] + 1:
+            sub, i, j = sub + 1, i - 1, j - 1
+        elif D[i][j] == D[i - 1][j] + 1:
+            dele, i = dele + 1, i - 1
+        else:
+            ins, j = ins + 1, j - 1
+    return sub, dele + i, ins + j
+
+
+def _collapse_oracle(path, blank):
+    out, prev = [], -1
+    for lab in path:
+        if lab != blank and (not out or lab != out[-1] or prev == blank):
+            out.append(int(lab))
+        prev = lab
+    return out
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_ctc_error_vs_oracle(seed):
+    from paddle_tpu.train.evaluators import CtcErrorEvaluator
+    rng = np.random.RandomState(seed)
+    B, T, C, L = 6, 20, 5, 8          # blank = C-1 = 4
+    ev = CtcErrorEvaluator()
+    paths = rng.randint(0, C, size=(B, T))
+    lengths = rng.randint(3, T + 1, size=B)
+    labels = np.full((B, L), -1)
+    label_lens = rng.randint(0, L + 1, size=B)
+    for b in range(B):
+        labels[b, :label_lens[b]] = rng.randint(0, C - 1, size=label_lens[b])
+    ev.update({"path": paths, "length": lengths, "label": labels,
+               "label_length": label_lens, "blank": C - 1})
+
+    score = sub_t = del_t = ins_t = 0.0
+    seq_err = 0
+    for b in range(B):
+        hyp = _collapse_oracle(paths[b, :lengths[b]], C - 1)
+        gold = list(labels[b, :label_lens[b]])
+        if not gold:
+            sub, dele, ins = 0, 0, len(hyp)
+        elif not hyp:
+            sub, dele, ins = 0, len(gold), 0
+        else:
+            sub, dele, ins = _lev_oracle(gold, hyp)
+        ml = max(1, len(gold), len(hyp))
+        score += (sub + dele + ins) / ml
+        sub_t += sub / ml
+        del_t += dele / ml
+        ins_t += ins / ml
+        seq_err += int(sub + dele + ins != 0)
+    res = ev.result()
+    assert abs(res["error"] - score / B) < 1e-9
+    assert abs(res["substitution_error"] - sub_t / B) < 1e-9
+    assert abs(res["deletion_error"] - del_t / B) < 1e-9
+    assert abs(res["insertion_error"] - ins_t / B) < 1e-9
+    assert abs(res["sequence_error"] - seq_err / B) < 1e-9
+
+
+def test_ctc_perfect_prediction_zero_error():
+    from paddle_tpu.train.evaluators import CtcErrorEvaluator
+    ev = CtcErrorEvaluator()
+    # path "a _ b b _ c" decodes to [a, b, c] with blank=3
+    paths = np.array([[0, 3, 1, 1, 3, 2]])
+    labels = np.array([[0, 1, 2, -1]])
+    ev.update({"path": paths, "length": np.array([6]), "label": labels,
+               "label_length": np.array([3]), "blank": 3})
+    res = ev.result()
+    assert res["error"] == 0.0 and res["sequence_error"] == 0.0
+
+
+def test_ctc_repeat_needs_blank():
+    from paddle_tpu.train.evaluators import CtcErrorEvaluator
+    ev = CtcErrorEvaluator()
+    # "a a" collapses to [a]; gold is [a, a] -> one deletion / maxlen 2
+    ev.update({"path": np.array([[0, 0]]), "length": np.array([2]),
+               "label": np.array([[0, 0]]), "label_length": np.array([2]),
+               "blank": 3})
+    res = ev.result()
+    assert abs(res["error"] - 0.5) < 1e-9
+    assert abs(res["deletion_error"] - 0.5) < 1e-9
+
+
+# ----------------------------------------------------------- sums & printers
+
+def test_sum_and_column_sum():
+    from paddle_tpu.train.evaluators import SumEvaluator, ColumnSumEvaluator
+    s = SumEvaluator()
+    s.update({"sum": 6.0, "count": 3.0})
+    s.update({"sum": 4.0, "count": 2.0})
+    assert abs(s.result()["sum"] - 2.0) < 1e-9
+    c = ColumnSumEvaluator()
+    c.update({"sum": np.array([2.0, 4.0]), "count": 2.0})
+    c.update({"sum": np.array([4.0, 2.0]), "count": 2.0})
+    assert np.allclose(c.result()["column_sum"], [1.5, 1.5])
+
+
+def test_printers_log_without_scoring():
+    from paddle_tpu.train.evaluators import (MaxIdPrinter, SequenceTextPrinter,
+                                             ValuePrinter)
+    lines = []
+    vp = ValuePrinter(sink=lines.append)
+    vp.update({"mean": np.float32(0.5), "abs_max": np.float32(2.0),
+               "shape": np.array([2, 3])})
+    mp = MaxIdPrinter(sink=lines.append)
+    mp.update({"ids": np.array([1, 0, 2])})
+    tp = SequenceTextPrinter(vocab={0: "<s>", 1: "hi", 2: "</s>"},
+                             sink=lines.append)
+    tp.update({"ids": np.array([[0, 1, 2]]), "length": np.array([3])})
+    assert len(lines) == 3
+    assert "mean=" in lines[0] and "ids=[1, 0, 2]" in lines[1]
+    assert "<s> hi </s>" in lines[2]
+    assert vp.result() == {} and mp.result() == {}
+
+
+def test_sum_evaluator_fractional_weights():
+    from paddle_tpu.train.evaluators import SumEvaluator
+    s = SumEvaluator()
+    s.update({"sum": 2.0, "count": 0.5})      # two samples of weight 0.25
+    assert abs(s.result()["sum"] - 4.0) < 1e-9

@@ -1,0 +1,214 @@
+"""Numeric-oracle tests for the long-tail layer set: LRN, RowConv, 3-D
+conv/pool, MDLstm, SelectiveFC, SamplingId, cross_entropy_over_beam
+(the analog of the reference's per-layer cases in ``test_LayerGrad.cpp``)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.layers import (Conv3D, Conv3DTranspose, CrossMapNormal,
+                                  Pool3D, RowConv, SamplingId, SelectiveFC,
+                                  Linear)
+from paddle_tpu.nn.recurrent import MDLstm
+
+
+# --------------------------------------------------------------------- LRN
+
+def test_cross_map_normal_vs_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(2, 4, 4, 6)).astype(np.float32)
+    size, scale, power = 5, 0.01, 0.75
+    mod = CrossMapNormal(size=size, scale=scale, power=power)
+    got = np.asarray(mod.apply({}, jnp.asarray(x)))
+    half = (size - 1) // 2
+    want = np.empty_like(x)
+    C = x.shape[-1]
+    for c in range(C):
+        lo, hi = max(0, c - half), min(C, c - half + size)
+        s = (x[..., lo:hi] ** 2).sum(-1)
+        want[..., c] = x[..., c] * (1.0 + scale * s) ** (-power)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+# ----------------------------------------------------------------- rowconv
+
+def test_row_conv_vs_oracle_and_grad():
+    rng = np.random.RandomState(1)
+    B, T, D, K = 2, 7, 3, 3
+    x = rng.normal(size=(B, T, D)).astype(np.float32)
+    lengths = np.array([7, 4])
+    w = rng.normal(size=(K, D)).astype(np.float32)
+    mod = RowConv(context=K)
+    params = mod.init(jax.random.PRNGKey(0), jnp.asarray(x),
+                      jnp.asarray(lengths))
+    # overwrite the (zero-init) filter with random weights
+    tree = params["params"]
+    node = tree[next(iter(tree))]
+    node["w"] = jnp.asarray(w)
+    got = np.asarray(mod.apply(params, jnp.asarray(x), jnp.asarray(lengths)))
+    want = np.zeros_like(x)
+    for b in range(B):
+        for t in range(lengths[b]):
+            for k in range(K):
+                if t + k < lengths[b]:
+                    want[b, t] += x[b, t + k] * w[k]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def loss(p):
+        return jnp.sum(mod.apply(p, jnp.asarray(x), jnp.asarray(lengths)) ** 2)
+    g = jax.grad(loss)(params)
+    flat = jax.tree_util.tree_leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in flat)
+
+
+# ---------------------------------------------------------------------- 3D
+
+def test_conv3d_vs_oracle():
+    rng = np.random.RandomState(2)
+    x = rng.normal(size=(1, 3, 4, 4, 2)).astype(np.float32)
+    mod = Conv3D(features=3, kernel=2, stride=1, padding="VALID",
+                 use_bias=False)
+    params = mod.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    w = np.asarray(jax.tree_util.tree_leaves(params["params"])[0])
+    got = np.asarray(mod.apply(params, jnp.asarray(x)))
+    assert got.shape == (1, 2, 3, 3, 3)
+    want = np.zeros_like(got)
+    for d in range(2):
+        for i in range(3):
+            for j in range(3):
+                patch = x[0, d:d + 2, i:i + 2, j:j + 2, :]
+                want[0, d, i, j] = np.tensordot(patch, w, axes=([0, 1, 2, 3],
+                                                                [0, 1, 2, 3]))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pool3d_max_and_avg():
+    x = jnp.arange(2 * 2 * 2 * 4 * 1, dtype=jnp.float32).reshape(2, 2, 2, 4, 1)
+    mx = Pool3D("max", window=2, stride=2).apply({}, x)
+    av = Pool3D("avg", window=2, stride=2).apply({}, x)
+    assert mx.shape == (2, 1, 1, 2, 1)
+    xs = np.asarray(x)
+    np.testing.assert_allclose(np.asarray(mx)[0, 0, 0, 0, 0],
+                               xs[0, :2, :2, :2].max())
+    np.testing.assert_allclose(np.asarray(av)[0, 0, 0, 0, 0],
+                               xs[0, :2, :2, :2].mean())
+
+
+def test_conv3d_transpose_shape_inverts_stride():
+    x = jnp.ones((1, 2, 3, 3, 2))
+    mod = Conv3DTranspose(features=4, kernel=2, stride=2, padding="SAME")
+    params = mod.init(jax.random.PRNGKey(0), x)
+    y = mod.apply(params, x)
+    assert y.shape == (1, 4, 6, 6, 4)
+
+
+# ------------------------------------------------------------------ MDLstm
+
+def test_mdlstm_vs_python_recurrence():
+    rng = np.random.RandomState(3)
+    B, H, W, D, hd = 2, 3, 4, 3, 5
+    x = rng.normal(size=(B, H, W, D)).astype(np.float32)
+    mod = MDLstm(hidden=hd)
+    params = mod.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    got = np.asarray(mod.apply(params, jnp.asarray(x)))
+    assert got.shape == (B, H, W, hd)
+
+    tree = params["params"][next(iter(params["params"]))]
+    wx, wh_up, wh_left, b = (np.asarray(tree["wx"]), np.asarray(tree["wh_up"]),
+                             np.asarray(tree["wh_left"]), np.asarray(tree["b"]))
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    hbuf = np.zeros((B, H, W, hd))
+    cbuf = np.zeros((B, H, W, hd))
+    for i in range(H):
+        for j in range(W):
+            h_up = hbuf[:, i - 1, j] if i else np.zeros((B, hd))
+            c_up = cbuf[:, i - 1, j] if i else np.zeros((B, hd))
+            h_l = hbuf[:, i, j - 1] if j else np.zeros((B, hd))
+            c_l = cbuf[:, i, j - 1] if j else np.zeros((B, hd))
+            z = x[:, i, j] @ wx + b + h_up @ wh_up + h_l @ wh_left
+            zi, zf1, zf2, zg, zo = np.split(z, 5, axis=-1)
+            c = sig(zf1) * c_up + sig(zf2) * c_l + sig(zi) * np.tanh(zg)
+            hbuf[:, i, j] = sig(zo) * np.tanh(c)
+            cbuf[:, i, j] = c
+    np.testing.assert_allclose(got, hbuf, rtol=1e-4, atol=1e-5)
+
+
+def test_mdlstm_reverse_directions_differ():
+    x = jnp.asarray(np.random.RandomState(0).normal(
+        size=(1, 3, 3, 2)).astype(np.float32))
+    m1 = MDLstm(hidden=4)
+    p = m1.init(jax.random.PRNGKey(0), x)
+    m2 = MDLstm(hidden=4, reverse_h=True, reverse_w=True)
+    y1 = m1.apply(p, x)
+    y2 = m2.apply(p, x)
+    assert not np.allclose(np.asarray(y1), np.asarray(y2))
+
+
+# ------------------------------------------------------------- SelectiveFC
+
+def test_selective_fc_matches_full_columns():
+    rng = np.random.RandomState(4)
+    B, D, F, K = 3, 5, 11, 4
+    x = rng.normal(size=(B, D)).astype(np.float32)
+    sel = np.stack([rng.choice(F, K, replace=False) for _ in range(B)])
+    sel[0, -1] = -1                          # padding id
+    mod = SelectiveFC(features=F)
+    params = mod.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    full = np.asarray(mod.apply(params, jnp.asarray(x)))
+    part = np.asarray(mod.apply(params, jnp.asarray(x), jnp.asarray(sel)))
+    for b in range(B):
+        for k in range(K):
+            if sel[b, k] < 0:
+                assert part[b, k] == 0.0
+            else:
+                np.testing.assert_allclose(part[b, k], full[b, sel[b, k]],
+                                           rtol=1e-5, atol=1e-6)
+
+
+# -------------------------------------------------------------- SamplingId
+
+def test_sampling_id_follows_distribution():
+    logits = jnp.log(jnp.asarray([[0.8, 0.1, 0.1]] * 4000, jnp.float32))
+    mod = SamplingId()
+    ids = mod.apply({}, logits, rngs={"sample": jax.random.PRNGKey(0)})
+    frac0 = float(np.mean(np.asarray(ids) == 0))
+    assert 0.75 < frac0 < 0.85
+
+
+# -------------------------------------------------- cross_entropy_over_beam
+
+def test_cross_entropy_over_beam_semantics():
+    from paddle_tpu.nn.costs import cross_entropy_over_beam
+    scores = jnp.asarray([[1.0, 2.0, 3.0]])
+    # gold in beam: plain softmax CE over the 3 candidates
+    got = float(cross_entropy_over_beam(scores, jnp.asarray([1])))
+    want = float(-jax.nn.log_softmax(scores[0])[1])
+    assert abs(got - want) < 1e-6
+    # gold off beam: appended as an extra path with its own score
+    got2 = float(cross_entropy_over_beam(scores, jnp.asarray([-1]),
+                                         gold_score=jnp.asarray([4.0])))
+    ext = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    want2 = float(-jax.nn.log_softmax(ext)[3])
+    assert abs(got2 - want2) < 1e-6
+    # padding candidates are masked out
+    got3 = float(cross_entropy_over_beam(
+        jnp.asarray([[1.0, 2.0, -5.0]]), jnp.asarray([1]),
+        valid_mask=jnp.asarray([[True, True, False]])))
+    want3 = float(-jax.nn.log_softmax(jnp.asarray([1.0, 2.0]))[1])
+    assert abs(got3 - want3) < 1e-6
+
+
+def test_conv3d_grad_under_bf16_policy():
+    from paddle_tpu.core import dtypes
+    x = jnp.ones((1, 3, 4, 4, 2))
+    mod = Conv3D(features=2, kernel=2, padding=1)
+    params = mod.init(jax.random.PRNGKey(0), x)
+    with dtypes.use_policy(dtypes.bfloat16_compute):
+        g = jax.grad(lambda p: jnp.sum(mod.apply(p, x)))(params)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(g))
+    y = Conv3DTranspose(features=2, kernel=2, padding=1).apply(
+        Conv3DTranspose(features=2, kernel=2, padding=1).init(
+            jax.random.PRNGKey(0), x), x)
+    assert y.ndim == 5

@@ -140,3 +140,22 @@ def test_weighted_loss_path():
     tr.init(jax.random.PRNGKey(0), next(iter(wreader())))
     tr.train(wreader, num_passes=1)
     assert int(tr.train_state.step) == 2
+
+
+def test_checkpoint_loads_collection_keyed_manifest(tmp_path):
+    """Manifests from the earlier format keyed files by collection name
+    ('params') rather than filename ('params.npz'); both must load."""
+    import json
+    import os
+    from paddle_tpu.train import checkpoint as ckpt
+    tree = {"params": {"w": np.arange(4.0)}}
+    ckpt.save_checkpoint(str(tmp_path), 0, tree)
+    d = ckpt.pass_dir(str(tmp_path), 0)
+    with open(os.path.join(d, "manifest.json")) as f:
+        man = json.load(f)
+    man["files"] = {k[:-len(".npz")] if k.endswith(".npz") else k: v
+                    for k, v in man["files"].items()}
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump(man, f)
+    out = ckpt.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(out["params"]["w"], np.arange(4.0))
