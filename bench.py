@@ -83,8 +83,20 @@ def bench_resnet50(batch_size=128, warmup=3, iters=20):
 
 
 def main():
-    batch_size = 128
-    img_s, ms_step, mfu, loss = bench_resnet50(batch_size=batch_size)
+    import dataclasses
+    import sys
+    from paddle_tpu.utils.flags import TrainerFlags, parse_flags
+
+    @dataclasses.dataclass
+    class BenchFlags(TrainerFlags):
+        batch_size: int = 128
+        warmup: int = 3
+        iters: int = 20
+
+    flags = parse_flags(BenchFlags, sys.argv[1:])
+    batch_size = flags.batch_size
+    img_s, ms_step, mfu, loss = bench_resnet50(
+        batch_size=batch_size, warmup=flags.warmup, iters=flags.iters)
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_s, 2),

@@ -29,8 +29,9 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
-    "SeqBatch", "length_mask", "segment_mask", "causal_mask",
+    "SeqBatch", "NestedSeqBatch", "length_mask", "segment_mask", "causal_mask",
     "pack_sequences", "unpack_sequences", "positions_from_segments",
+    "pack_nested_sequences", "unpack_nested_sequences",
 ]
 
 
@@ -97,6 +98,84 @@ class SeqBatch:
             data[i, :len(s)] = s
             lengths[i] = len(s)
         return SeqBatch(jnp.asarray(data), jnp.asarray(lengths))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(eq=False)
+class NestedSeqBatch:
+    """Two-level ragged batch — the reference's nested sequences
+    (``subSequenceStartPositions``, ``parameter/Argument.h:84-93``), carried
+    dense and static-shaped: ``data [B, S, T, ...]`` where S is the padded
+    subsequence count and T the padded token count per subsequence.
+
+    ``sub_lengths [B, S]`` gives tokens per subsequence (0 = unused slot);
+    ``num_subseqs [B]`` gives subsequences per sequence. Hierarchical models
+    run token-level ops on the flattened ``[B*S, T]`` view (inner RNN per
+    subsequence — the reference's nested ``RecurrentGradientMachine`` frame)
+    and sequence-level ops over the S axis (outer recurrence).
+    """
+    data: jax.Array
+    sub_lengths: jax.Array
+    num_subseqs: jax.Array
+
+    def tree_flatten(self):
+        return (self.data, self.sub_lengths, self.num_subseqs), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_subseqs(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.data.shape[2]
+
+    def token_mask(self) -> jax.Array:
+        """[B, S, T] float validity mask over tokens."""
+        pos = jnp.arange(self.max_len)[None, None, :]
+        return (pos < self.sub_lengths[:, :, None]).astype(jnp.float32)
+
+    def subseq_mask(self) -> jax.Array:
+        """[B, S] float validity mask over subsequence slots."""
+        return length_mask(self.num_subseqs, self.max_subseqs)
+
+    def flat(self) -> SeqBatch:
+        """Collapse to a token-level :class:`SeqBatch` of shape [B*S, T] —
+        each subsequence becomes an independent sequence (the reference's
+        trivial-nesting equivalence, ``test_RecurrentGradientMachine.cpp``)."""
+        B, S = self.data.shape[:2]
+        return SeqBatch(self.data.reshape((B * S,) + self.data.shape[2:]),
+                        self.sub_lengths.reshape(B * S).astype(jnp.int32))
+
+    @staticmethod
+    def from_lists(seqs: Sequence[Sequence[np.ndarray]],
+                   max_subseqs: Optional[int] = None,
+                   max_len: Optional[int] = None,
+                   pad_value=0) -> "NestedSeqBatch":
+        """Build from a list (batch) of lists (subsequences) of [len, ...]
+        arrays (host-side)."""
+        B = len(seqs)
+        S = max_subseqs or max(len(s) for s in seqs)
+        T = max_len or max((len(ss) for s in seqs for ss in s), default=1)
+        first = np.asarray(seqs[0][0])
+        data = np.full((B, S, T) + first.shape[1:], pad_value, first.dtype)
+        sub_lengths = np.zeros((B, S), np.int32)
+        num = np.zeros((B,), np.int32)
+        for i, subs in enumerate(seqs):
+            num[i] = min(len(subs), S)
+            for j, ss in enumerate(subs[:S]):
+                ss = np.asarray(ss)[:T]
+                data[i, j, :len(ss)] = ss
+                sub_lengths[i, j] = len(ss)
+        return NestedSeqBatch(jnp.asarray(data), jnp.asarray(sub_lengths),
+                              jnp.asarray(num))
 
 
 def length_mask(lengths: jax.Array, max_len: int) -> jax.Array:
@@ -174,4 +253,73 @@ def unpack_sequences(data: np.ndarray, segment_ids: np.ndarray) -> List[np.ndarr
     for row, seg in zip(np.asarray(data), np.asarray(segment_ids)):
         for s in range(1, int(seg.max(initial=0)) + 1):
             out.append(row[seg == s])
+    return out
+
+
+def pack_nested_sequences(seqs: Sequence[Sequence[np.ndarray]], row_len: int,
+                          pad_value=0):
+    """Pack nested (sequence-of-subsequence) data into fixed rows with TWO
+    segment levels — the packed-row counterpart of the reference's
+    ``subSequenceStartPositions`` (``Argument.h:84-93``).
+
+    Each outer sequence's subsequences are laid out contiguously; rows carry
+    ``segment_ids`` (one id per outer sequence) and ``sub_segment_ids`` (one
+    id per subsequence, unique within the row). Returns ``(data,
+    segment_ids, sub_segment_ids, positions)``; ``positions`` restart at
+    each *sub*sequence (the inner recurrence boundary). Outer sequences
+    longer than ``row_len`` are truncated whole-subsequence-first.
+    """
+    flat_seqs = []
+    sub_counts = []
+    for subs in seqs:
+        total = 0
+        kept = []
+        for ss in subs:
+            ss = np.asarray(ss)
+            if total + len(ss) > row_len:
+                break
+            kept.append(ss)
+            total += len(ss)
+        if not kept:       # degenerate: truncate the first subsequence
+            kept = [np.asarray(subs[0])[:row_len]]
+        flat_seqs.append(np.concatenate(kept, 0))
+        sub_counts.append([len(k) for k in kept])
+
+    data, segment_ids, _ = pack_sequences(flat_seqs, row_len, pad_value)
+    # Re-derive which packed segment corresponds to which input sequence by
+    # replaying the first-fit order, then mark subsequence boundaries.
+    order = np.argsort([-len(s) for s in flat_seqs], kind="stable")
+    rows, T = segment_ids.shape
+    sub_segment_ids = np.zeros_like(segment_ids)
+    free = np.full(rows, T, np.int32)
+    sub_counter = np.zeros(rows, np.int32)
+    for idx in order:
+        L = len(flat_seqs[idx])
+        slot = -1
+        for r in range(rows):
+            if free[r] >= L:
+                slot = r
+                break
+        off = T - free[slot]
+        pos = off
+        for sublen in sub_counts[idx]:
+            sub_counter[slot] += 1
+            sub_segment_ids[slot, pos:pos + sublen] = sub_counter[slot]
+            pos += sublen
+        free[slot] -= L
+    positions = positions_from_segments(sub_segment_ids)
+    return data, segment_ids, sub_segment_ids, positions
+
+
+def unpack_nested_sequences(data: np.ndarray, segment_ids: np.ndarray,
+                            sub_segment_ids: np.ndarray):
+    """Inverse of :func:`pack_nested_sequences` (order not preserved):
+    returns a list of lists of arrays."""
+    out = []
+    for row, seg, sub in zip(np.asarray(data), np.asarray(segment_ids),
+                             np.asarray(sub_segment_ids)):
+        for s in range(1, int(seg.max(initial=0)) + 1):
+            sel = seg == s
+            subs_here = np.unique(sub[sel])
+            out.append([row[sel & (sub == u)] for u in subs_here if u > 0])
     return out

@@ -322,3 +322,39 @@ class MDLstm(Module):
         if self.reverse_w:
             h = h[:, :, ::-1]
         return h
+
+
+class HierarchicalRNN(Module):
+    """Two-level recurrence over nested sequences (reference: nested
+    ``RecurrentGradientMachine`` — an outer recurrent group stepping over
+    subsequences with an inner RNN per subsequence,
+    ``gserver/gradientmachines/RecurrentGradientMachine.h:428``; equivalence
+    fixture ``gserver/tests/sequence_nest_rnn.conf``).
+
+    ``forward(data [B, S, T, D], sub_lengths [B, S], num_subseqs [B])``:
+    the inner cell runs over each subsequence's tokens (state reset per
+    subsequence — the nested frame boundary), its last state is the
+    subsequence summary; the outer cell then runs over the S summaries.
+    Returns ``(inner_out [B, S, T, Hi], outer_out [B, S, Ho])``. Inner runs
+    batched over B*S (one scan, full MXU batch), outer over S.
+    """
+
+    def __init__(self, inner_cell, outer_cell, name=None):
+        super().__init__(name=name)
+        self.inner = RNN(inner_cell)
+        self.outer = RNN(outer_cell)
+        self._inner_cell = inner_cell
+
+    def forward(self, data, sub_lengths, num_subseqs):
+        B, S, T = data.shape[:3]
+        flat = data.reshape((B * S, T) + data.shape[3:])
+        flat_len = sub_lengths.reshape(B * S)
+        from ..core.sequence import length_mask
+        inner_out, _ = self.inner(flat, mask=length_mask(flat_len, T))
+        inner_out = inner_out.reshape((B, S, T) + inner_out.shape[2:])
+        # subsequence summary = last valid inner state
+        from .sequence_ops import sub_seq_last
+        summaries = sub_seq_last(inner_out, sub_lengths)     # [B, S, Hi]
+        outer_out, _ = self.outer(summaries,
+                                  mask=length_mask(num_subseqs, S))
+        return inner_out, outer_out

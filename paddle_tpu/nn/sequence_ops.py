@@ -18,7 +18,9 @@ import jax.numpy as jnp
 from ..core.sequence import length_mask
 
 __all__ = ["seq_pool", "seq_last", "seq_first", "seq_expand", "seq_concat",
-           "seq_reshape", "seq_slice", "kmax_scores", "max_id", "seq_softmax_pool"]
+           "seq_reshape", "seq_slice", "kmax_scores", "max_id", "seq_softmax_pool",
+           "starts_from_segments", "sub_seq_pool", "sub_seq_last",
+           "select_sub_sequences"]
 
 
 def seq_pool(x, lengths, kind: str = "average"):
@@ -122,3 +124,53 @@ def seq_softmax_pool(x, scores, lengths):
     if w.ndim == 2:
         w = w[..., None]
     return (x * w).sum(1)
+
+
+def starts_from_segments(segment_ids):
+    """[B, T] segment ids -> [B, T] 1/0 flags marking where a new (non-pad)
+    segment begins — the form :class:`~paddle_tpu.nn.recurrent.RNN` takes as
+    ``segment_starts``. Works for either segment level (pass
+    ``sub_segment_ids`` for inner-recurrence resets)."""
+    prev = jnp.concatenate([jnp.full_like(segment_ids[:, :1], -1),
+                            segment_ids[:, :-1]], axis=1)
+    return ((segment_ids != prev) & (segment_ids > 0)).astype(jnp.float32)
+
+
+# ---------------------------------------------------------- nested (sub-)seq
+
+def sub_seq_pool(x, sub_lengths, kind: str = "average"):
+    """Pool each subsequence of a nested batch: ``x [B, S, T, D]``,
+    ``sub_lengths [B, S]`` -> ``[B, S, D]`` (reference:
+    ``SequencePoolLayer`` applied at the sub-sequence level of a nested
+    Argument, ``Argument.h:84-93``)."""
+    B, S = x.shape[:2]
+    flatx = x.reshape((B * S,) + x.shape[2:])
+    out = seq_pool(flatx, sub_lengths.reshape(B * S), kind)
+    return out.reshape((B, S) + out.shape[1:])
+
+
+def sub_seq_last(x, sub_lengths):
+    """Last valid token of each subsequence: [B, S, T, D] -> [B, S, D]
+    (reference: ``SequenceLastInstanceLayer`` on nested input)."""
+    B, S = x.shape[:2]
+    flatx = x.reshape((B * S,) + x.shape[2:])
+    out = seq_last(flatx, sub_lengths.reshape(B * S))
+    return out.reshape((B, S) + out.shape[1:])
+
+
+def select_sub_sequences(x, sub_lengths, indices):
+    """Gather chosen subsequences from a nested batch (reference:
+    ``SubNestedSequenceLayer.cpp`` — selects sub-sequences by the ids
+    produced e.g. by ``KmaxSeqScoreLayer``).
+
+    ``x [B, S, T, D]``, ``indices [B, K]`` (ids into the S axis; -1 pads) ->
+    ``(x' [B, K, T, D], sub_lengths' [B, K])``; padded picks give zeros.
+    """
+    valid = indices >= 0
+    safe = jnp.maximum(indices, 0)
+    gx = jnp.take_along_axis(
+        x, safe[:, :, None, None].astype(jnp.int32), axis=1)
+    gl = jnp.take_along_axis(sub_lengths, safe, axis=1)
+    gx = jnp.where(valid[:, :, None, None], gx, 0)
+    gl = jnp.where(valid, gl, 0)
+    return gx, gl

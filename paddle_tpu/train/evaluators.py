@@ -414,21 +414,27 @@ class CtcErrorEvaluator(Evaluator):
     ``batch['length']``, gold labels in ``batch['label']`` ([B, L], padded
     with -1) with lengths in ``batch['label_length']`` (defaults to counting
     non-negative labels).
+
+    ``blank`` defaults to 0, matching this package's CTC stack
+    (:func:`paddle_tpu.nn.ctc.ctc_loss`); the reference evaluator uses
+    ``num_classes - 1`` — pass ``blank=-1`` to mean "last class".
     """
 
-    def __init__(self, name="ctc_edit_distance"):
+    def __init__(self, blank: int = 0, name="ctc_edit_distance"):
         self.name = name
+        self.blank = blank
         self.reset()
 
     def batch_stats(self, outputs, batch):
         # argmax on device; everything else is small host work
+        blank = self.blank if self.blank >= 0 else outputs.shape[-1] - 1
         return {"path": jnp.argmax(outputs, -1),
                 "length": batch["length"],
                 "label": batch["label"],
                 "label_length": batch.get(
                     "label_length",
                     jnp.sum(batch["label"] >= 0, axis=-1)),
-                "blank": jnp.asarray(outputs.shape[-1] - 1)}
+                "blank": jnp.asarray(blank)}
 
     def reset(self):
         self._score = self._del = self._ins = self._sub = 0.0

@@ -78,7 +78,9 @@ class Trainer:
 
     def __init__(self, model: Module, loss_fn: Callable, optimizer: Optimizer,
                  mesh=None, forward: Optional[Callable] = None,
-                 evaluator=None, param_sharding=None, donate: bool = True):
+                 evaluator=None, param_sharding=None, donate: bool = True,
+                 nan_check: bool = False,
+                 param_stats_period: Optional[int] = None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -91,7 +93,15 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self._donate = donate
+        # nan_check: host-side finiteness trap on the per-step loss (the
+        # reference's feenableexcept analog, TrainerMain.cpp:36); on trip it
+        # names the non-finite param/state leaves before raising.
+        self._nan_check = nan_check
+        # param_stats_period: per-param scale telemetry every N batches (the
+        # reference's --show_parameter_stats_period, TrainerInternal.cpp:81).
+        self._param_stats_period = param_stats_period
         self.train_state: Optional[TrainState] = None
+        self._last_iter_state: Optional[Dict[str, Any]] = None
 
     # -- setup ---------------------------------------------------------------
 
@@ -218,21 +228,39 @@ class Trainer:
               test_reader: Optional[Callable] = None,
               checkpoint_dir: Optional[str] = None,
               checkpoint_keep: int = 3,
+              saving_period: Optional[int] = None,
               log_period: int = 100, rng: Optional[jax.Array] = None,
               resume: bool = False) -> TrainState:
-        """The pass/batch loop (v2 ``SGD.train`` surface + v1 pass checkpoints)."""
+        """The pass/batch loop (v2 ``SGD.train`` surface + v1 pass checkpoints).
+
+        ``saving_period``: also checkpoint every N batches *within* a pass
+        (the reference's ``--saving_period_by_batches``,
+        ``trainer/Trainer.cpp``), recording the data-iterator position.
+        ``resume=True`` then continues mid-pass: with a deterministic
+        ``reader`` the already-consumed batches of the interrupted pass are
+        skipped, reproducing the uninterrupted run (the Go master's
+        task-queue recovery, ``go/master/service.go:313``, done the
+        single-controller way — deterministic data + iterator state in the
+        checkpoint). Evaluator state is not checkpointed, so the resumed
+        pass's metrics cover only its remaining batches.
+        """
         assert self.train_state is not None, "call init() first"
         if self._train_step is None:
             self._build_train_step()
         handler = event_handler or (lambda e: None)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-        start_pass = 0
+        start_pass, skip_batches = 0, 0
         if resume and checkpoint_dir:
             last = ckpt_lib.latest_pass(checkpoint_dir)
             if last is not None:
                 self.restore(checkpoint_dir, last)
-                start_pass = last + 1
+                it = self._last_iter_state
+                if it is not None and not int(it.get("completed", 1)):
+                    start_pass = int(it["pass"])
+                    skip_batches = int(it["next_batch"])
+                else:
+                    start_pass = last + 1
 
         ts = self.train_state
         params, state, opt_state, step = (ts.params, ts.state, ts.opt_state,
@@ -243,6 +271,8 @@ class Trainer:
                 self.evaluator.reset()
             costs = []
             for batch_id, host_batch in enumerate(reader()):
+                if pass_id == start_pass and batch_id < skip_batches:
+                    continue          # deterministic replay skip on resume
                 handler(ev.BeginIteration(pass_id, batch_id))
                 with self.stats.time("shard_batch"):
                     batch = self._shard(host_batch)
@@ -255,6 +285,14 @@ class Trainer:
                 # trainer.train_state (e.g. to save) mid-pass.
                 self.train_state = TrainState(params, state, opt_state, step)
                 cost = float(loss)
+                if self._nan_check and not np.isfinite(cost):
+                    from ..utils import debug as dbg
+                    bad = dbg.nonfinite_leaves(
+                        {"params": params, "state": state})
+                    raise FloatingPointError(
+                        f"non-finite loss {cost} at pass {pass_id} batch "
+                        f"{batch_id} (step {int(step)}); non-finite leaves: "
+                        f"{bad[:8] or 'none (loss only)'}")
                 costs.append(cost)
                 metrics = {}
                 if self.evaluator is not None:
@@ -264,6 +302,17 @@ class Trainer:
                     msg = " ".join(f"{k}={v:.4f}" for k, v in metrics.items())
                     _log.info("pass %d batch %d cost=%.4f %s",
                               pass_id, batch_id + 1, cost, msg)
+                if self._param_stats_period and \
+                        (batch_id + 1) % self._param_stats_period == 0:
+                    self._log_param_stats(pass_id, batch_id)
+                if saving_period and checkpoint_dir and \
+                        (batch_id + 1) % saving_period == 0:
+                    ckpt_lib.save_checkpoint(
+                        checkpoint_dir, pass_id,
+                        {**self.train_state.as_dict(),
+                         "iter": {"pass": pass_id, "next_batch": batch_id + 1,
+                                  "completed": 0}},
+                        keep_last=checkpoint_keep)
                 handler(ev.EndIteration(pass_id, batch_id, int(step), cost,
                                         metrics))
             pass_metrics = (self.evaluator.result()
@@ -275,10 +324,28 @@ class Trainer:
                 pass_metrics["test_cost"] = tc
             if checkpoint_dir:
                 ckpt_lib.save_checkpoint(
-                    checkpoint_dir, pass_id, self.train_state.as_dict(),
+                    checkpoint_dir, pass_id,
+                    {**self.train_state.as_dict(),
+                     "iter": {"pass": pass_id, "next_batch": 0,
+                              "completed": 1}},
                     keep_last=checkpoint_keep)
             handler(ev.EndPass(pass_id, pass_metrics))
         return self.train_state
+
+    def _log_param_stats(self, pass_id: int, batch_id: int):
+        """Per-parameter scale telemetry (``--show_parameter_stats_period``:
+        the reference logs max/avg absolute value per Parameter,
+        ``TrainerInternal.cpp:81-92``)."""
+        flat = jax.tree_util.tree_flatten_with_path(
+            self.train_state.params)[0]
+        for path, leaf in flat:
+            arr = np.asarray(leaf, np.float32)
+            _log.info(
+                "param %s shape=%s abs_max=%.4g abs_avg=%.4g mean=%.4g "
+                "std=%.4g (pass %d batch %d)",
+                jax.tree_util.keystr(path), tuple(arr.shape),
+                float(np.abs(arr).max(initial=0)), float(np.abs(arr).mean()),
+                float(arr.mean()), float(arr.std()), pass_id, batch_id + 1)
 
     def evaluate(self, reader: Callable) -> Tuple[float, Dict[str, float]]:
         assert self.train_state is not None
@@ -306,6 +373,8 @@ class Trainer:
 
     def restore(self, checkpoint_dir: str, pass_id: Optional[int] = None):
         loaded = ckpt_lib.load_checkpoint(checkpoint_dir, pass_id)
+        # iterator position (absent in pre-saving_period checkpoints)
+        self._last_iter_state = loaded.get("iter")
         put = lambda tree: jax.tree_util.tree_map(jnp.asarray, tree)
         params = put(loaded["params"])
         state = put(loaded.get("state", {}))

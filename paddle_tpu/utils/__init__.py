@@ -1,3 +1,6 @@
-"""Utilities: timers/stats, logging (successor of paddle/utils)."""
+"""Utilities: timers/profiling (stats), flag/config system (flags), numeric
+hardening (debug) — the paddle/utils tier."""
 
+from . import debug, flags, stats
+from .flags import TrainerFlags, parse_flags
 from .stats import StatSet, global_stats, profile_trace, timer

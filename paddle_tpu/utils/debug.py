@@ -1,0 +1,49 @@
+"""Numeric hardening — the TPU analog of the reference's FP-exception traps
+(``feenableexcept(FE_INVALID|FE_DIVBYZERO|FE_OVERFLOW)`` at trainer start,
+``trainer/TrainerMain.cpp:36``; ``math/tests/test_FPException.cpp``).
+
+On TPU there are no CPU FP traps; the equivalents are (a) XLA-level NaN
+checking via ``jax.config.jax_debug_nans`` (recompiles with per-op checks)
+and (b) cheap host-side finiteness asserts on the scalars/trees that already
+cross to the host each step. :class:`~paddle_tpu.train.Trainer` wires (b) in
+via its ``nan_check`` flag.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+import jax
+
+__all__ = ["enable_nan_checks", "disable_nan_checks", "assert_finite",
+           "nonfinite_leaves"]
+
+
+def enable_nan_checks():
+    """Turn on XLA-level NaN detection (every primitive checked; slow —
+    debugging only, like running the reference under FP traps)."""
+    jax.config.update("jax_debug_nans", True)
+
+
+def disable_nan_checks():
+    jax.config.update("jax_debug_nans", False)
+
+
+def nonfinite_leaves(tree: Any) -> list:
+    """Paths of leaves containing non-finite values (host-side)."""
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            bad.append(jax.tree_util.keystr(path))
+    return bad
+
+
+def assert_finite(tree: Any, name: str = "tree"):
+    """Raise ``FloatingPointError`` naming the offending leaves."""
+    bad = nonfinite_leaves(tree)
+    if bad:
+        raise FloatingPointError(
+            f"non-finite values in {name}: {', '.join(bad[:8])}"
+            + (f" (+{len(bad) - 8} more)" if len(bad) > 8 else ""))

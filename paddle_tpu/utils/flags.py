@@ -1,0 +1,119 @@
+"""Flag/config system — the gflags tier of the reference
+(``paddle/utils/Flags.cpp:18-81``), kept "config is data" (the proto-config
+tier, ``proto/TrainerConfig.proto``) by building every flag set from a plain
+dataclass that serializes to JSON.
+
+Usage::
+
+    @dataclasses.dataclass
+    class MyFlags(TrainerFlags):
+        extra_knob: float = 1.0
+
+    flags = parse_flags(MyFlags)          # CLI > env > json > defaults
+
+Resolution order: command line (``--batch_size 64``) beats environment
+(``PADDLE_TPU_BATCH_SIZE=64``) beats ``--flags_json file`` beats dataclass
+defaults — so a saved config reproduces a run and the CLI can still poke one
+knob (the reference's gflags-over-proto layering).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import typing
+from typing import Any, Optional, Sequence, Type, TypeVar
+
+__all__ = ["TrainerFlags", "parse_flags", "flags_to_json", "flags_from_json"]
+
+T = TypeVar("T")
+
+_ENV_PREFIX = "PADDLE_TPU_"
+
+
+@dataclasses.dataclass
+class TrainerFlags:
+    """The canonical training knobs (mirrors ``Flags.cpp``: batch size, lr,
+    passes, beam width, logging/saving cadence, seed, checkpoint dir)."""
+    batch_size: int = 128
+    learning_rate: float = 0.01
+    num_passes: int = 1
+    beam_size: int = 4
+    log_period: int = 100
+    saving_period: int = 0               # 0 = per-pass checkpoints only
+    checkpoint_dir: str = ""
+    checkpoint_keep: int = 3
+    seed: int = 0
+    resume: bool = False
+    use_bf16: bool = True
+    nan_check: bool = False
+    param_stats_period: int = 0          # --show_parameter_stats_period
+
+
+def _base_type(tp):
+    if typing.get_origin(tp) is typing.Union:       # Optional[X]
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _coerce(tp, raw: str):
+    tp = _base_type(tp)
+    if tp is bool:
+        if isinstance(raw, bool):
+            return raw
+        return str(raw).lower() in ("1", "true", "yes", "on")
+    return tp(raw)
+
+
+def parse_flags(cls: Type[T] = TrainerFlags,
+                argv: Optional[Sequence[str]] = None) -> T:
+    """Build ``cls`` from CLI args / env vars / ``--flags_json`` / defaults.
+
+    ``argv`` defaults to ``sys.argv[1:]``; pass ``[]`` explicitly to ignore
+    the command line (e.g. in tests).
+    """
+    assert dataclasses.is_dataclass(cls), "flags must be a dataclass"
+    # get_type_hints resolves PEP563 string annotations in the defining
+    # module's namespace (a bare eval here would NameError on user types).
+    hints = typing.get_type_hints(cls)
+    parser = argparse.ArgumentParser(prog=cls.__name__, allow_abbrev=False)
+    parser.add_argument("--flags_json", type=str, default=None,
+                        help="JSON file with flag defaults")
+    for f in dataclasses.fields(cls):
+        tp = _base_type(hints[f.name])
+        if tp is bool:
+            parser.add_argument(f"--{f.name}", type=str, default=None,
+                                metavar="BOOL")
+        else:
+            parser.add_argument(f"--{f.name}", type=tp, default=None)
+    ns = parser.parse_args(sys.argv[1:] if argv is None else list(argv))
+
+    values: dict = {}
+    if ns.flags_json:
+        with open(ns.flags_json) as fh:
+            file_vals = json.load(fh)
+        for f in dataclasses.fields(cls):
+            if f.name in file_vals:
+                values[f.name] = file_vals[f.name]
+    for f in dataclasses.fields(cls):
+        env = os.environ.get(_ENV_PREFIX + f.name.upper())
+        if env is not None:
+            values[f.name] = _coerce(hints[f.name], env)
+    for f in dataclasses.fields(cls):
+        cli = getattr(ns, f.name)
+        if cli is not None:
+            values[f.name] = _coerce(hints[f.name], cli)
+    return cls(**values)
+
+
+def flags_to_json(flags) -> str:
+    return json.dumps(dataclasses.asdict(flags), indent=2, sort_keys=True)
+
+
+def flags_from_json(cls: Type[T], text: str) -> T:
+    return cls(**json.loads(text))

@@ -261,3 +261,26 @@ def test_sum_evaluator_fractional_weights():
     s = SumEvaluator()
     s.update({"sum": 2.0, "count": 0.5})      # two samples of weight 0.25
     assert abs(s.result()["sum"] - 4.0) < 1e-9
+
+
+def test_ctc_evaluator_blank_convention():
+    """blank defaults to 0 (this package's ctc_loss convention); blank=-1
+    selects the reference's last-class blank."""
+    import jax.numpy as jnp
+    from paddle_tpu.train.evaluators import CtcErrorEvaluator
+    # logits whose argmax path is [0, 1, 0, 2] over C=4 classes
+    out = np.full((1, 4, 4), -5.0, np.float32)
+    for t, c in enumerate([0, 1, 0, 2]):
+        out[0, t, c] = 5.0
+    batch = {"length": np.array([4]), "label": np.array([[1, 2, -1]]),
+             "label_length": np.array([2])}
+    ev0 = CtcErrorEvaluator()                      # blank=0
+    stats = {k: np.asarray(v) for k, v in
+             ev0.batch_stats(jnp.asarray(out), batch).items()}
+    assert int(stats["blank"]) == 0
+    ev0.update(stats)
+    assert ev0.result()["error"] == 0.0            # path collapses to [1, 2]
+    ev_last = CtcErrorEvaluator(blank=-1)
+    stats = {k: np.asarray(v) for k, v in
+             ev_last.batch_stats(jnp.asarray(out), batch).items()}
+    assert int(stats["blank"]) == 3

@@ -1,0 +1,99 @@
+"""Flag/config system + numeric hardening + param-stats telemetry
+(the analogs of ``utils/Flags.cpp``, ``TrainerMain.cpp:36`` FP traps, and
+``--show_parameter_stats_period``)."""
+
+import dataclasses
+import json
+import logging
+
+import numpy as np
+import jax
+import pytest
+
+from paddle_tpu.utils.flags import (TrainerFlags, flags_from_json,
+                                    flags_to_json, parse_flags)
+
+
+def test_flags_defaults_and_cli():
+    f = parse_flags(TrainerFlags, [])
+    assert f.batch_size == 128 and f.resume is False
+    f = parse_flags(TrainerFlags, ["--batch_size", "64", "--resume", "true",
+                                   "--learning_rate", "0.5"])
+    assert f.batch_size == 64 and f.resume is True
+    assert abs(f.learning_rate - 0.5) < 1e-9
+
+
+def test_flags_env_and_json_precedence(tmp_path, monkeypatch):
+    cfg = tmp_path / "flags.json"
+    cfg.write_text(json.dumps({"batch_size": 32, "num_passes": 7,
+                               "seed": 3}))
+    monkeypatch.setenv("PADDLE_TPU_BATCH_SIZE", "48")
+    f = parse_flags(TrainerFlags, ["--flags_json", str(cfg),
+                                   "--seed", "9"])
+    assert f.num_passes == 7          # from json
+    assert f.batch_size == 48         # env beats json
+    assert f.seed == 9                # cli beats everything
+
+
+def test_flags_subclass_and_roundtrip():
+    @dataclasses.dataclass
+    class MyFlags(TrainerFlags):
+        extra: float = 2.5
+
+    f = parse_flags(MyFlags, ["--extra", "1.25"])
+    assert f.extra == 1.25
+    g = flags_from_json(MyFlags, flags_to_json(f))
+    assert g == f
+
+
+def test_assert_finite_names_bad_leaves():
+    from paddle_tpu.utils.debug import assert_finite, nonfinite_leaves
+    good = {"a": np.ones(3), "b": {"c": np.zeros(2)}}
+    assert_finite(good)
+    bad = {"a": np.ones(3), "b": {"c": np.array([1.0, np.nan])}}
+    leaves = nonfinite_leaves(bad)
+    assert len(leaves) == 1 and "c" in leaves[0]
+    with pytest.raises(FloatingPointError, match="c"):
+        assert_finite(bad, "params")
+
+
+def test_trainer_nan_check_trips():
+    import jax.numpy as jnp
+    from paddle_tpu import optim
+    from paddle_tpu.models import MnistMLP
+    from paddle_tpu.train import Trainer
+
+    # a loss that goes NaN on the second step
+    def poisoned_loss(out, b):
+        return jnp.log(-jnp.abs(out).sum(-1))      # log of negative -> nan
+
+    tr = Trainer(MnistMLP(), poisoned_loss, optim.sgd(0.1), nan_check=True)
+    batch = {"x": np.ones((8, 28, 28, 1), np.float32),
+             "label": np.zeros(8, np.int32)}
+    tr.init(jax.random.PRNGKey(0), batch)
+    with pytest.raises(FloatingPointError, match="non-finite loss"):
+        tr.train(lambda: iter([batch]), num_passes=1, log_period=0)
+
+
+def test_param_stats_telemetry(caplog):
+    from paddle_tpu import optim
+    from paddle_tpu.models import MnistMLP
+    from paddle_tpu.nn import costs
+    from paddle_tpu.train import Trainer
+
+    tr = Trainer(MnistMLP(),
+                 lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
+                 optim.sgd(0.01), param_stats_period=1)
+    batch = {"x": np.ones((8, 28, 28, 1), np.float32),
+             "label": np.zeros(8, np.int32)}
+    tr.init(jax.random.PRNGKey(0), batch)
+    with caplog.at_level(logging.INFO, logger="paddle_tpu.trainer"):
+        tr.train(lambda: iter([batch]), num_passes=1, log_period=0)
+    stats_lines = [r for r in caplog.records if "abs_max" in r.getMessage()]
+    assert stats_lines, "no param-stats telemetry emitted"
+
+
+def test_parse_flags_reads_sys_argv_by_default(monkeypatch):
+    monkeypatch.setattr("sys.argv", ["prog", "--batch_size", "99"])
+    f = parse_flags(TrainerFlags)
+    assert f.batch_size == 99

@@ -1,0 +1,151 @@
+"""Nested (sub-)sequence machinery — the analog of the reference's
+``test_RecurrentGradientMachine.cpp`` nested-vs-plain equivalence suite
+(``sequence_nest_rnn.conf`` vs ``sequence_rnn.conf``)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import (NestedSeqBatch, pack_nested_sequences,
+                                      unpack_nested_sequences)
+from paddle_tpu.nn.recurrent import RNN, HierarchicalRNN, SimpleRNNCell
+from paddle_tpu.nn.sequence_ops import (select_sub_sequences,
+                                        starts_from_segments, sub_seq_last,
+                                        sub_seq_pool)
+
+
+def _nested_data(seed=0, B=3, D=4):
+    rng = np.random.RandomState(seed)
+    seqs = []
+    for _ in range(B):
+        n_sub = rng.randint(1, 4)
+        seqs.append([rng.normal(size=(rng.randint(1, 5), D)).astype(np.float32)
+                     for _ in range(n_sub)])
+    return seqs
+
+
+# ----------------------------------------------------------- representation
+
+def test_nested_batch_roundtrip_and_masks():
+    seqs = _nested_data()
+    nb = NestedSeqBatch.from_lists(seqs)
+    assert nb.data.ndim == 4
+    tm = np.asarray(nb.token_mask())
+    sm = np.asarray(nb.subseq_mask())
+    for i, subs in enumerate(seqs):
+        assert sm[i].sum() == len(subs)
+        for j, ss in enumerate(subs):
+            assert tm[i, j].sum() == len(ss)
+            np.testing.assert_allclose(
+                np.asarray(nb.data)[i, j, :len(ss)], ss)
+
+
+def test_pack_nested_roundtrip():
+    seqs = _nested_data(seed=1, B=5)
+    data, seg, sub, pos = pack_nested_sequences(seqs, row_len=16)
+    got = unpack_nested_sequences(data, seg, sub)
+    want = [[np.asarray(ss) for ss in subs] for subs in seqs]
+    # order is not preserved; match by content
+    def key(subs):
+        return tuple(np.round(np.concatenate(subs).ravel(), 5).tolist())
+    assert sorted(map(key, got)) == sorted(map(key, want))
+    # positions restart at each subsequence
+    for r in range(data.shape[0]):
+        for t in range(data.shape[1]):
+            if sub[r, t] > 0 and (t == 0 or sub[r, t] != sub[r, t - 1]):
+                assert pos[r, t] == 0
+
+
+def test_sub_segment_ids_nest_inside_segments():
+    seqs = _nested_data(seed=2, B=4)
+    data, seg, sub, _ = pack_nested_sequences(seqs, row_len=16)
+    # every token in a subsequence belongs to exactly one outer segment
+    for r in range(seg.shape[0]):
+        for u in np.unique(sub[r]):
+            if u == 0:
+                continue
+            outer = seg[r][sub[r] == u]
+            assert len(np.unique(outer)) == 1 and outer[0] > 0
+
+
+# ------------------------------------------------------------- sub-seq ops
+
+def test_sub_seq_pool_and_last_oracle():
+    seqs = _nested_data(seed=3)
+    nb = NestedSeqBatch.from_lists(seqs)
+    avg = np.asarray(sub_seq_pool(nb.data, nb.sub_lengths, "average"))
+    last = np.asarray(sub_seq_last(nb.data, nb.sub_lengths))
+    for i, subs in enumerate(seqs):
+        for j, ss in enumerate(subs):
+            np.testing.assert_allclose(avg[i, j], ss.mean(0), rtol=1e-5,
+                                       atol=1e-6)
+            np.testing.assert_allclose(last[i, j], ss[-1], rtol=1e-5)
+
+
+def test_select_sub_sequences():
+    seqs = _nested_data(seed=4)
+    nb = NestedSeqBatch.from_lists(seqs)
+    idx = jnp.asarray([[0, -1], [0, 0], [0, -1]], jnp.int32)
+    gx, gl = select_sub_sequences(nb.data, nb.sub_lengths, idx)
+    assert gx.shape[1] == 2
+    np.testing.assert_allclose(np.asarray(gx[0, 0]),
+                               np.asarray(nb.data[0, 0]))
+    assert np.asarray(gl)[0, 1] == 0 and np.asarray(gx[0, 1]).sum() == 0
+
+
+# ------------------------------------------- nested-vs-plain RNN equivalence
+
+def test_hierarchical_inner_equals_flat_rnn():
+    """The inner recurrence over each subsequence must equal a plain RNN run
+    on the subsequences as independent sequences (the reference's
+    sequence_nest_rnn.conf == sequence_rnn.conf assertion)."""
+    seqs = _nested_data(seed=5)
+    nb = NestedSeqBatch.from_lists(seqs)
+    hrnn = HierarchicalRNN(SimpleRNNCell(8), SimpleRNNCell(6))
+    params = hrnn.init(jax.random.PRNGKey(0), nb.data, nb.sub_lengths,
+                      nb.num_subseqs)
+    inner_out, outer_out = hrnn.apply(params, nb.data, nb.sub_lengths,
+                                      nb.num_subseqs)
+
+    # plain RNN with the same inner weights on the flattened view
+    flat = nb.flat()
+    inner_params = params["params"]["HierarchicalRNN_0"]["inner"]
+    from paddle_tpu.core.sequence import length_mask
+    flat_out, _ = hrnn.inner.apply(
+        {"params": {"inner": inner_params}}, flat.data,
+        mask=length_mask(flat.lengths, flat.max_len))
+    B, S, T = nb.data.shape[:3]
+    flat_out = np.asarray(flat_out).reshape(B, S, T, -1)
+    tm = np.asarray(nb.token_mask())
+    np.testing.assert_allclose(np.asarray(inner_out) * tm[..., None],
+                               flat_out * tm[..., None], rtol=1e-5, atol=1e-6)
+    assert outer_out.shape == (B, S, 6)
+
+
+def test_packed_subsegment_rnn_equals_per_subsequence():
+    """RNN over packed rows with sub-segment resets == RNN per subsequence
+    (inner-recurrence boundary honored across packing)."""
+    seqs = _nested_data(seed=6, B=4)
+    data, seg, sub, _ = pack_nested_sequences(seqs, row_len=12)
+    cell = SimpleRNNCell(5)
+    rnn = RNN(cell)
+    x = jnp.asarray(data)
+    params = rnn.init(jax.random.PRNGKey(1), x)
+    starts = starts_from_segments(jnp.asarray(sub))
+    packed_out, _ = rnn.apply(params, x, segment_starts=starts)
+    packed_out = np.asarray(packed_out)
+
+    # oracle: run each subsequence separately through the same weights
+    for subs in unpack_nested_sequences(data, seg, sub):
+        pass  # content-matched below via position scan
+    rows = data.shape[0]
+    for r in range(rows):
+        for u in np.unique(sub[r]):
+            if u == 0:
+                continue
+            sel = np.flatnonzero(sub[r] == u)
+            piece = jnp.asarray(data[r][sel])[None]
+            want, _ = rnn.apply(params, piece)
+            np.testing.assert_allclose(packed_out[r][sel], np.asarray(want)[0],
+                                       rtol=1e-5, atol=1e-6)
