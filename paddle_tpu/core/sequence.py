@@ -196,13 +196,25 @@ def causal_mask(t: int) -> jax.Array:
 
 
 def positions_from_segments(segment_ids: np.ndarray) -> np.ndarray:
-    """Per-token position within its own segment (host-side, numpy)."""
-    b, t = segment_ids.shape
+    """Per-token position within its own segment (host-side). Uses the
+    native kernel (``paddle_tpu/native/packer.cpp``) when built; the Python
+    loop below is the reference fallback and the equality oracle."""
+    seg = np.ascontiguousarray(np.asarray(segment_ids, np.int32))
+    b, t = seg.shape
+    from ..native import lib as _native_lib
+    L = _native_lib()
+    if L is not None:
+        import ctypes
+        out = np.zeros((b, t), np.int32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        L.ptn_positions_from_segments(
+            seg.ctypes.data_as(i32p), b, t, out.ctypes.data_as(i32p))
+        return out
     out = np.zeros((b, t), np.int32)
     for i in range(b):
         pos, prev = 0, 0
         for j in range(t):
-            s = segment_ids[i, j]
+            s = seg[i, j]
             pos = pos + 1 if (s == prev and s != 0) else 0
             out[i, j] = pos
             prev = s
@@ -219,32 +231,57 @@ def pack_sequences(seqs: Sequence[np.ndarray], row_len: int,
     order = np.argsort([-len(s) for s in seqs], kind="stable")
     tail = np.asarray(seqs[0]).shape[1:]
     dtype = np.asarray(seqs[0]).dtype
-    rows: List[np.ndarray] = []
-    segs: List[np.ndarray] = []
-    free: List[int] = []   # free space per row
-    nseg: List[int] = []
+    slots, offsets, n_rows = _first_fit(
+        np.asarray([len(s) for s in seqs], np.int64), order, row_len)
+    data = np.full((n_rows, row_len) + tail, pad_value, dtype)
+    segment_ids = np.zeros((n_rows, row_len), np.int32)
+    nseg = np.zeros(n_rows, np.int32)
     for idx in order:
         s = np.asarray(seqs[idx])[:row_len]
         L = len(s)
+        slot, off = slots[idx], offsets[idx]
+        data[slot, off:off + L] = s
+        nseg[slot] += 1
+        segment_ids[slot, off:off + L] = nseg[slot]
+    return data, segment_ids, positions_from_segments(segment_ids)
+
+
+def _first_fit(lengths: np.ndarray, order: np.ndarray, row_len: int):
+    """First-fit placement in visit ``order``: per-sequence (slot, offset)
+    and the row count. Native kernel when built (``packer.cpp``), Python
+    fallback otherwise — both produce identical placements."""
+    n = len(lengths)
+    from ..native import lib as _native_lib
+    L = _native_lib()
+    if L is not None and n:
+        import ctypes
+        slots = np.zeros(n, np.int32)
+        offsets = np.zeros(n, np.int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        n_rows = L.ptn_pack_first_fit(
+            np.ascontiguousarray(lengths, np.int64).ctypes.data_as(i64p),
+            np.ascontiguousarray(order, np.int64).ctypes.data_as(i64p),
+            n, row_len,
+            slots.ctypes.data_as(i32p), offsets.ctypes.data_as(i32p))
+        return slots, offsets, int(n_rows)
+    slots = np.zeros(n, np.int32)
+    offsets = np.zeros(n, np.int32)
+    free: List[int] = []
+    for idx in order:
+        length = min(int(lengths[idx]), row_len)
         slot = -1
-        for r in range(len(rows)):
-            if free[r] >= L:
+        for r in range(len(free)):
+            if free[r] >= length:
                 slot = r
                 break
         if slot < 0:
-            rows.append(np.full((row_len,) + tail, pad_value, dtype))
-            segs.append(np.zeros((row_len,), np.int32))
             free.append(row_len)
-            nseg.append(0)
-            slot = len(rows) - 1
-        off = row_len - free[slot]
-        rows[slot][off:off + L] = s
-        nseg[slot] += 1
-        segs[slot][off:off + L] = nseg[slot]
-        free[slot] -= L
-    data = np.stack(rows)
-    segment_ids = np.stack(segs)
-    return data, segment_ids, positions_from_segments(segment_ids)
+            slot = len(free) - 1
+        slots[idx] = slot
+        offsets[idx] = row_len - free[slot]
+        free[slot] -= length
+    return slots, offsets, len(free)
 
 
 def unpack_sequences(data: np.ndarray, segment_ids: np.ndarray) -> List[np.ndarray]:
@@ -286,27 +323,19 @@ def pack_nested_sequences(seqs: Sequence[Sequence[np.ndarray]], row_len: int,
         sub_counts.append([len(k) for k in kept])
 
     data, segment_ids, _ = pack_sequences(flat_seqs, row_len, pad_value)
-    # Re-derive which packed segment corresponds to which input sequence by
-    # replaying the first-fit order, then mark subsequence boundaries.
+    # Mark subsequence boundaries using the same placements pack_sequences
+    # used (one _first_fit call — identical policy by construction).
     order = np.argsort([-len(s) for s in flat_seqs], kind="stable")
-    rows, T = segment_ids.shape
+    slots, offsets, _ = _first_fit(
+        np.asarray([len(s) for s in flat_seqs], np.int64), order, row_len)
     sub_segment_ids = np.zeros_like(segment_ids)
-    free = np.full(rows, T, np.int32)
-    sub_counter = np.zeros(rows, np.int32)
+    sub_counter = np.zeros(segment_ids.shape[0], np.int32)
     for idx in order:
-        L = len(flat_seqs[idx])
-        slot = -1
-        for r in range(rows):
-            if free[r] >= L:
-                slot = r
-                break
-        off = T - free[slot]
-        pos = off
+        slot, pos = int(slots[idx]), int(offsets[idx])
         for sublen in sub_counts[idx]:
             sub_counter[slot] += 1
             sub_segment_ids[slot, pos:pos + sublen] = sub_counter[slot]
             pos += sublen
-        free[slot] -= L
     positions = positions_from_segments(sub_segment_ids)
     return data, segment_ids, sub_segment_ids, positions
 

@@ -20,7 +20,8 @@ from typing import Callable, Iterator, Optional, Tuple
 import numpy as np
 
 __all__ = ["data_home", "mnist", "cifar10", "uci_housing", "imdb", "synthetic_nmt",
-           "synthetic_tagging", "synthetic_ctr"]
+           "synthetic_tagging", "synthetic_ctr", "movielens", "conll05",
+           "imikolov", "wmt14", "voc2012", "mq2007", "sentiment", "flowers"]
 
 
 def data_home() -> str:
@@ -242,6 +243,179 @@ def synthetic_ctr(split: str = "train", num_fields: int = 8,
             p = 1.0 / (1.0 + np.exp(-score))
             label = np.int32(rng.rand() < p)
             yield ids, label
+    reader.is_synthetic = True
+    reader.num_samples = n
+    return reader
+
+
+def movielens(split: str = "train", n_users: int = 500, n_movies: int = 300,
+              n: Optional[int] = None):
+    """MovieLens-style rating triples (reference: ``v2/dataset/movielens.py``)
+    yielding ``(user_id, movie_id, user_features [4], movie_genres [6],
+    rating)``. Synthetic fallback: ratings from a hidden low-rank
+    user x movie factor model plus genre affinity, so matrix-factorisation
+    recommenders actually learn."""
+    n = n or (16384 if split == "train" else 2048)
+    g = np.random.RandomState(44)
+    u_fac = g.normal(0, 1, (n_users, 6)).astype(np.float32)
+    m_fac = g.normal(0, 1, (n_movies, 6)).astype(np.float32)
+    m_genre = (g.uniform(size=(n_movies, 6)) > 0.7).astype(np.int32)
+    # per-user genre taste: makes the yielded genre features predictive
+    u_taste = g.normal(0, 0.5, (n_users, 6)).astype(np.float32)
+    u_feat = np.stack([g.randint(0, 2, n_users), g.randint(0, 7, n_users),
+                       g.randint(0, 21, n_users), g.randint(18, 60, n_users)],
+                      axis=1).astype(np.int32)
+
+    def reader():
+        rng = np.random.RandomState(14 if split == "train" else 15)
+        for i in range(n):
+            u = int(rng.randint(0, n_users))
+            m = int(rng.randint(0, n_movies))
+            score = float(u_fac[u] @ m_fac[m]) / 3.0 \
+                + float(u_taste[u] @ m_genre[m]) / 3.0 + 3.0
+            rating = np.float32(np.clip(score + rng.normal(0, 0.3), 1.0, 5.0))
+            yield (np.int32(u), np.int32(m), u_feat[u], m_genre[m], rating)
+    reader.is_synthetic = True
+    reader.num_samples = n
+    return reader
+
+
+def conll05(split: str = "train", vocab: int = 3000, n_labels: int = 13,
+            max_len: int = 40, n: Optional[int] = None):
+    """CoNLL-05 semantic-role-labeling style data (reference:
+    ``v2/dataset/conll05.py``) yielding ``(words, predicate_index,
+    labels)`` with IOB-coded labels. Synthetic fallback: arguments cluster
+    around the predicate so position features matter."""
+    n = n or (4096 if split == "train" else 512)
+
+    def reader():
+        rng = np.random.RandomState(16 if split == "train" else 17)
+        for i in range(n):
+            length = int(rng.randint(5, max_len))
+            words = rng.randint(0, vocab, size=length).astype(np.int32)
+            pred = int(rng.randint(0, length))
+            labels = np.zeros(length, np.int32)    # 0 = O
+            # mark an ARG span adjacent to the predicate with B-/I- codes
+            span_len = int(rng.randint(1, 4))
+            start = max(0, pred - span_len)
+            typ = int(rng.randint(0, (n_labels - 1) // 2))
+            for t in range(start, min(length, start + span_len)):
+                labels[t] = 1 + 2 * typ + (0 if t == start else 1)
+            yield words, np.int32(pred), labels
+    reader.is_synthetic = True
+    reader.num_samples = n
+    return reader
+
+
+def imikolov(split: str = "train", vocab: int = 2000, ngram: int = 5,
+             n: Optional[int] = None):
+    """PTB n-gram language-model windows (reference:
+    ``v2/dataset/imikolov.py``) yielding ``(context [ngram-1], next_word)``.
+    Synthetic fallback: a first-order Markov chain over the vocab so context
+    genuinely predicts the next word."""
+    n = n or (16384 if split == "train" else 2048)
+    g = np.random.RandomState(45)
+    # sparse-ish transition preferences: each word has 4 likely successors
+    succ = g.randint(0, vocab, size=(vocab, 4)).astype(np.int32)
+
+    def reader():
+        rng = np.random.RandomState(18 if split == "train" else 19)
+        w = int(rng.randint(0, vocab))
+        for i in range(n):
+            ctx = []
+            for _ in range(ngram - 1):
+                ctx.append(w)
+                w = int(succ[w, rng.randint(0, 4)]) if rng.rand() < 0.9 \
+                    else int(rng.randint(0, vocab))
+            yield np.asarray(ctx, np.int32), np.int32(
+                succ[ctx[-1], rng.randint(0, 4)] if rng.rand() < 0.9
+                else rng.randint(0, vocab))
+    reader.is_synthetic = True
+    reader.num_samples = n
+    return reader
+
+
+def wmt14(split: str = "train", src_vocab: int = 1000, tgt_vocab: int = 1000,
+          max_len: int = 30, n: Optional[int] = None):
+    """WMT14 en-fr translation surface (reference: ``v2/dataset/wmt14.py``).
+    Zero-egress stand-in: delegates to :func:`synthetic_nmt` (same structure
+    and reserved ids) under the reference's dataset name."""
+    return synthetic_nmt(split, src_vocab, tgt_vocab, max_len, n)
+
+
+def voc2012(split: str = "train", hw: Tuple[int, int] = (96, 96),
+            num_classes: int = 5, max_boxes: int = 4,
+            n: Optional[int] = None):
+    """VOC-style detection data (reference: ``v2/dataset/voc2012.py``)
+    yielding ``(image [H,W,3], gt_boxes [max_boxes,4] normalized xyxy,
+    gt_labels [max_boxes] with -1 padding)``. Synthetic fallback: colored
+    rectangles on noise — class = dominant channel, so detectors learn."""
+    n = n or (2048 if split == "train" else 256)
+    H, W = hw
+
+    def reader():
+        rng = np.random.RandomState(20 if split == "train" else 21)
+        for i in range(n):
+            img = rng.uniform(0, 0.3, size=(H, W, 3)).astype(np.float32)
+            k = int(rng.randint(1, max_boxes + 1))
+            boxes = np.zeros((max_boxes, 4), np.float32)
+            labels = np.full((max_boxes,), -1, np.int32)
+            for b in range(k):
+                x1, y1 = rng.uniform(0, 0.6, 2)
+                w, h = rng.uniform(0.2, 0.35, 2)
+                x2, y2 = min(x1 + w, 1.0), min(y1 + h, 1.0)
+                cls = int(rng.randint(1, num_classes))
+                ch = (cls - 1) % 3
+                img[int(y1 * H):int(y2 * H), int(x1 * W):int(x2 * W), ch] = \
+                    0.8 + 0.2 * rng.rand()
+                boxes[b] = [x1, y1, x2, y2]
+                labels[b] = cls
+            yield img, boxes, labels
+    reader.is_synthetic = True
+    reader.num_samples = n
+    return reader
+
+
+def mq2007(split: str = "train", n_queries: int = 400, docs_per_query: int = 8,
+           n_features: int = 16):
+    """MQ2007 learning-to-rank surface (reference: ``v2/dataset/mq2007.py``)
+    yielding per-query groups ``(features [D, F], relevance [D])`` with
+    graded relevance 0-2 from a hidden linear model."""
+    nq = n_queries if split == "train" else max(1, n_queries // 8)
+    g = np.random.RandomState(46)
+    w_hidden = g.normal(0, 1, n_features).astype(np.float32)
+
+    def reader():
+        rng = np.random.RandomState(22 if split == "train" else 23)
+        for q in range(nq):
+            f = rng.normal(0, 1, (docs_per_query, n_features)).astype(
+                np.float32)
+            score = f @ w_hidden + rng.normal(0, 0.5, docs_per_query)
+            rel = np.digitize(score, [-0.5, 1.0]).astype(np.int32)  # 0/1/2
+            yield f, rel
+    reader.is_synthetic = True
+    reader.num_samples = nq
+    return reader
+
+
+def sentiment(split: str = "train", **kw):
+    """Movie-review sentiment surface (reference:
+    ``v2/dataset/sentiment.py``) — same shape as :func:`imdb`."""
+    return imdb(split, **kw)
+
+
+def flowers(split: str = "train", hw: Tuple[int, int] = (64, 64),
+            num_classes: int = 102, synthetic_n: Optional[int] = None):
+    """Flowers-102 classification surface (reference:
+    ``v2/dataset/flowers.py``) yielding ``(image [H,W,3], label)``;
+    synthetic separable fallback."""
+    n = synthetic_n or (2048 if split == "train" else 256)
+    seed = 24 if split == "train" else 25
+    images, labels = _synth_images(n, num_classes, hw, 3, seed)
+
+    def reader():
+        for i in range(n):
+            yield images[i], labels[i]
     reader.is_synthetic = True
     reader.num_samples = n
     return reader

@@ -1,0 +1,112 @@
+"""Host-side image preprocessing (reference:
+``python/paddle/utils/image_util.py`` + ``preprocess_img.py`` + the
+multi-process loader ``image_multiproc.py``; v2 ``paddle.v2.image``).
+
+All numpy, all HWC float32 (the package's NHWC convention — the reference is
+CHW and converts at the edge). Compose transforms with :func:`pipeline` and
+lift onto a reader with ``data.map_readers``; heavy pipelines parallelize
+with the threaded prefetch reader (``data.buffered``), the analog of the
+reference's multiprocess loader.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["resize", "center_crop", "random_crop", "random_flip",
+           "normalize", "to_chw", "to_hwc", "pipeline", "train_augment",
+           "eval_transform"]
+
+
+def resize(img: np.ndarray, hw: Tuple[int, int]) -> np.ndarray:
+    """Bilinear resize, HWC (the reference uses PIL's default bilinear)."""
+    H, W = img.shape[:2]
+    h, w = hw
+    if (H, W) == (h, w):
+        return img
+    # sample grid (align-corners=False convention)
+    ys = (np.arange(h) + 0.5) * H / h - 0.5
+    xs = (np.arange(w) + 0.5) * W / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+    y1 = np.clip(y0 + 1, 0, H - 1)
+    x1 = np.clip(x0 + 1, 0, W - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    img = img.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def center_crop(img: np.ndarray, hw: Tuple[int, int]) -> np.ndarray:
+    h, w = hw
+    H, W = img.shape[:2]
+    y = max(0, (H - h) // 2)
+    x = max(0, (W - w) // 2)
+    return img[y:y + h, x:x + w]
+
+
+def random_crop(img: np.ndarray, hw: Tuple[int, int],
+                rng: Optional[np.random.RandomState] = None) -> np.ndarray:
+    rng = rng or np.random
+    h, w = hw
+    H, W = img.shape[:2]
+    y = int(rng.randint(0, max(1, H - h + 1)))
+    x = int(rng.randint(0, max(1, W - w + 1)))
+    return img[y:y + h, x:x + w]
+
+
+def random_flip(img: np.ndarray,
+                rng: Optional[np.random.RandomState] = None) -> np.ndarray:
+    rng = rng or np.random
+    return img[:, ::-1] if rng.rand() < 0.5 else img
+
+
+def normalize(img: np.ndarray, mean: Sequence[float],
+              std: Sequence[float] = (1.0, 1.0, 1.0)) -> np.ndarray:
+    """Per-channel (x - mean) / std — the reference's mean-image/mean-value
+    subtraction (``image_util.py`` ``ImageTransformer.set_mean``)."""
+    return ((img.astype(np.float32) - np.asarray(mean, np.float32))
+            / np.asarray(std, np.float32))
+
+
+def to_chw(img: np.ndarray) -> np.ndarray:
+    """HWC -> CHW (only at interop edges; the package itself stays NHWC)."""
+    return np.transpose(img, (2, 0, 1))
+
+
+def to_hwc(img: np.ndarray) -> np.ndarray:
+    return np.transpose(img, (1, 2, 0))
+
+
+def pipeline(*fns: Callable) -> Callable:
+    """Left-to-right composition of image transforms."""
+    def run(img):
+        for f in fns:
+            img = f(img)
+        return img
+    return run
+
+
+def train_augment(crop_hw: Tuple[int, int], resize_hw: Tuple[int, int],
+                  mean: Sequence[float], std: Sequence[float] = (1, 1, 1),
+                  seed: int = 0) -> Callable:
+    """The standard train-time augmentation of ``preprocess_img.py``:
+    resize -> random crop -> random flip -> normalize."""
+    rng = np.random.RandomState(seed)
+    return pipeline(lambda im: resize(im, resize_hw),
+                    lambda im: random_crop(im, crop_hw, rng),
+                    lambda im: random_flip(im, rng),
+                    lambda im: normalize(im, mean, std))
+
+
+def eval_transform(crop_hw: Tuple[int, int], resize_hw: Tuple[int, int],
+                   mean: Sequence[float],
+                   std: Sequence[float] = (1, 1, 1)) -> Callable:
+    """Eval-time: resize -> center crop -> normalize."""
+    return pipeline(lambda im: resize(im, resize_hw),
+                    lambda im: center_crop(im, crop_hw),
+                    lambda im: normalize(im, mean, std))

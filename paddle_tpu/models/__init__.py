@@ -2,10 +2,12 @@
 ResNet, seq2seq attention NMT, sequence tagging, CTR) built on paddle_tpu.nn."""
 
 from .ctr import CTR_SHARDING_RULES, SparseLR, WideDeepCTR
+from .gan import Discriminator, Generator, gan_step_fn
 from .image_zoo import AlexNet, GoogLeNet, VGG, vgg16, vgg19
 from .mnist import LeNet, MnistMLP
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152, resnet_cifar)
 from .seq2seq import Seq2SeqAttention
 from .ssd import SSDHead
+from .vae import VAE, elbo_loss
 from .tagging import LinearCrfTagger, RnnCrfTagger

@@ -1,0 +1,105 @@
+"""GAN demo family (reference: ``v1_api_demo/gan/gan_conf.py`` — MLP
+generator/discriminator over 2-D synthetic samples; ``gan_conf_image.py`` —
+conv MNIST variant; trainer loop ``gan_trainer.py``).
+
+TPU-native: generator and discriminator are ordinary Modules; the
+alternating two-optimizer loop is ONE jit-compiled step that performs the
+discriminator update then the generator update back-to-back (both phases in
+a single XLA program — no host round-trip between the half-steps, unlike
+the reference's two GradientMachines driven from Python).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn.layers import BatchNorm, Linear
+from paddle_tpu.optim.optimizers import Optimizer
+
+__all__ = ["Generator", "Discriminator", "gan_step_fn"]
+
+
+class Generator(Module):
+    """noise [B, Z] -> sample [B, D] (reference ``generator``,
+    ``gan_conf.py:90`` — two hidden relu/bn layers, linear output)."""
+
+    def __init__(self, sample_dim: int, hidden: int = 64,
+                 use_bn: bool = True, name="generator"):
+        super().__init__(name=name)
+        self.h1 = Linear(hidden, act="relu")
+        self.bn = BatchNorm() if use_bn else None
+        self.h2 = Linear(hidden, act="relu")
+        self.out = Linear(sample_dim)
+
+    def forward(self, z, train: bool = True):
+        h = self.h1(z)
+        if self.bn is not None:
+            h = self.bn(h, train=train)
+        return self.out(self.h2(h))
+
+
+class Discriminator(Module):
+    """sample [B, D] -> logit [B, 1] (reference ``discriminator``,
+    ``gan_conf.py:43``)."""
+
+    def __init__(self, hidden: int = 64, name="discriminator"):
+        super().__init__(name=name)
+        self.h1 = Linear(hidden, act="relu")
+        self.h2 = Linear(hidden, act="relu")
+        self.out = Linear(1)
+
+    def forward(self, x, train: bool = True):
+        return self.out(self.h2(self.h1(x)))
+
+
+def gan_step_fn(gen: Generator, disc: Discriminator,
+                g_opt: Optimizer, d_opt: Optimizer):
+    """Build the jit-able alternating step.
+
+    Returns ``step(g_vars, d_vars, g_opt_state, d_opt_state, step_no, real,
+    noise) -> (g_vars, d_vars, g_opt_state, d_opt_state, d_loss, g_loss)``.
+    Non-saturating BCE objectives; the discriminator update sees the
+    generator through ``stop_gradient`` and vice versa.
+    """
+
+    def bce_logits(logits, target):
+        # -[t log s + (1-t) log (1-s)] in the stable softplus form
+        return jnp.mean(jax.nn.softplus(logits) - target * logits)
+
+    def step(g_vars, d_vars, g_opt_state, d_opt_state, step_no, real, noise):
+        # --- discriminator phase: train-mode generator output, but the BN
+        # running-stat update is discarded here — the generator phase below
+        # recomputes and keeps it, so stats advance once per step.
+        fake, _ = gen.apply(g_vars, noise, train=True, mutable=("state",))
+        fake_sg = jax.lax.stop_gradient(fake)
+
+        def d_loss_fn(dp):
+            dv = {"params": dp, "state": d_vars.get("state", {})}
+            real_logit = disc.apply(dv, real)
+            fake_logit = disc.apply(dv, fake_sg)
+            return bce_logits(real_logit, 1.0) + bce_logits(fake_logit, 0.0)
+
+        d_loss, d_grads = jax.value_and_grad(d_loss_fn)(d_vars["params"])
+        d_params, d_opt_state = d_opt.apply(d_grads, d_opt_state,
+                                            d_vars["params"], step_no)
+        d_vars = {"params": d_params, "state": d_vars.get("state", {})}
+
+        # --- generator phase (non-saturating: maximize log D(G(z)))
+        def g_loss_fn(gp):
+            gv = {"params": gp, "state": g_vars.get("state", {})}
+            out, new = gen.apply(gv, noise, train=True, mutable=("state",))
+            logit = disc.apply(d_vars, out)
+            return bce_logits(logit, 1.0), new["state"]
+
+        (g_loss, g_state), g_grads = jax.value_and_grad(
+            g_loss_fn, has_aux=True)(g_vars["params"])
+        g_params, g_opt_state = g_opt.apply(g_grads, g_opt_state,
+                                            g_vars["params"], step_no)
+        g_vars = {"params": g_params, "state": g_state}
+        return (g_vars, d_vars, g_opt_state, d_opt_state, d_loss, g_loss)
+
+    return jax.jit(step)

@@ -1,0 +1,83 @@
+"""ctypes bindings for the native host-pipeline kernels (packer.cpp).
+
+The reference's host data path is C++ (``PyDataProvider2.cpp`` Argument
+assembly); here the packing hot loops compile on first use with the
+in-image g++ into a cached shared object. Everything has a pure-Python
+fallback (``PADDLE_TPU_NO_NATIVE=1`` forces it), and the Python and native
+paths are tested for exact equality.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["lib", "available"]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(__file__), "packer.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_SO = os.path.join(_BUILD_DIR, "libpaddle_tpu_native.so")
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    # pid-unique temp + atomic replace: concurrent cold builds (parallel
+    # jobs / pytest workers) each write their own file and the last rename
+    # wins with a complete .so either way
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return _SO
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first call; None when
+    unavailable (no compiler) or disabled."""
+    global _lib, _tried
+    if os.environ.get("PADDLE_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        try:
+            L = ctypes.CDLL(so)
+        except OSError:
+            return None             # corrupt/partial .so: Python fallback
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        L.ptn_pack_first_fit.restype = ctypes.c_int32
+        L.ptn_pack_first_fit.argtypes = [i64p, i64p, ctypes.c_int64,
+                                         ctypes.c_int64, i32p, i32p]
+        L.ptn_positions_from_segments.restype = None
+        L.ptn_positions_from_segments.argtypes = [i32p, ctypes.c_int64,
+                                                  ctypes.c_int64, i32p]
+        _lib = L
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None

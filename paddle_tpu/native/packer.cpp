@@ -1,0 +1,70 @@
+// Native host-side data-pipeline kernels.
+//
+// The reference keeps its host data path native (ragged Argument assembly in
+// C++: paddle/gserver/dataproviders/PyDataProvider2.cpp converts Python
+// minibatches to packed Argument buffers; sequence bookkeeping lives in
+// paddle/parameter/Argument.cpp). This library is the TPU-native analog for
+// the two host-side hot loops of the packing pipeline:
+//   - first-fit-decreasing bin packing of ragged sequences into fixed rows
+//     (core/sequence.py pack_sequences), and
+//   - per-token segment positions (positions_from_segments).
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+using std::size_t;
+
+extern "C" {
+
+// First-fit packing over sequences visited in the given order.
+// order[i] gives the index of the i-th sequence to place (the Python side
+// passes the stable length-descending order, matching pack_sequences).
+// Outputs, indexed by ORIGINAL sequence index:
+//   slot_out[j]   - row the sequence was placed in
+//   offset_out[j] - starting column
+// Returns the number of rows used.
+int32_t ptn_pack_first_fit(const int64_t* lengths, const int64_t* order,
+                           int64_t n, int64_t row_len,
+                           int32_t* slot_out, int32_t* offset_out) {
+  std::vector<int64_t> free_space;
+  free_space.reserve(64);
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t idx = order[i];
+    int64_t len = lengths[idx];
+    if (len > row_len) len = row_len;  // truncation, as in pack_sequences
+    int32_t slot = -1;
+    for (size_t r = 0; r < free_space.size(); ++r) {
+      if (free_space[r] >= len) { slot = static_cast<int32_t>(r); break; }
+    }
+    if (slot < 0) {
+      free_space.push_back(row_len);
+      slot = static_cast<int32_t>(free_space.size() - 1);
+    }
+    slot_out[idx] = slot;
+    offset_out[idx] = static_cast<int32_t>(row_len - free_space[slot]);
+    free_space[slot] -= len;
+  }
+  return static_cast<int32_t>(free_space.size());
+}
+
+// positions_from_segments: per-token position within its own segment.
+// seg is [b, t] int32 row-major; out the same shape.
+void ptn_positions_from_segments(const int32_t* seg, int64_t b, int64_t t,
+                                 int32_t* out) {
+  for (int64_t i = 0; i < b; ++i) {
+    const int32_t* row = seg + i * t;
+    int32_t* orow = out + i * t;
+    int32_t pos = 0;
+    int32_t prev = 0;
+    for (int64_t j = 0; j < t; ++j) {
+      const int32_t s = row[j];
+      pos = (s == prev && s != 0) ? pos + 1 : 0;
+      orow[j] = pos;
+      prev = s;
+    }
+  }
+}
+
+}  // extern "C"

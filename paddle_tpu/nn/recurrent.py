@@ -343,7 +343,6 @@ class HierarchicalRNN(Module):
         super().__init__(name=name)
         self.inner = RNN(inner_cell)
         self.outer = RNN(outer_cell)
-        self._inner_cell = inner_cell
 
     def forward(self, data, sub_lengths, num_subseqs):
         B, S, T = data.shape[:3]

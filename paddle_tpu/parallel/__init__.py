@@ -18,6 +18,8 @@ This package owns:
 from .sharding import (ShardingRules, spec_tree, named_shardings,
                        shard_tree, sharded_init)
 from .ring import ring_attention, make_ring_attention
+from .multihost import (initialize, is_initialized,
+                        host_sharded_reader, multihost_mesh)
 
 __all__ = [
     "ShardingRules", "spec_tree", "named_shardings", "shard_tree",

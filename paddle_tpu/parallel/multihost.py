@@ -1,0 +1,89 @@
+"""Multi-host orchestration — the TPU-native replacement for the reference's
+pserver/etcd tier (``go/pserver``, ``go/master``; C++
+``pserver/ParameterServer2``).
+
+On TPU pods there is no parameter-server tier to run: every host executes the
+same SPMD program and XLA moves gradients over ICI/DCN. What remains of the
+reference's distributed stack is exactly three host-side concerns, and this
+module wires them together:
+
+  1. process bring-up — :func:`initialize` (the ``paddle.init(trainer_id,
+     pservers=...)`` analog) over ``jax.distributed``;
+  2. disjoint data feeding — :func:`host_sharded_reader` (the Go master's
+     task-queue role, done as deterministic modulo sharding);
+  3. single-writer checkpoints — already enforced by
+     ``train.checkpoint.save_checkpoint`` (process 0 writes, everyone loads).
+
+Recovery model: JAX jobs are gang-scheduled, so elastic recovery =
+restart-all + ``Trainer(..., resume=True)`` from the shared checkpoint dir —
+the capability the reference implements with etcd leases and task requeue
+(``go/master/service.go:313``), collapsed into deterministic data + CRC'd
+checkpoints.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import jax
+
+from ..core import mesh as mesh_lib
+from ..data import reader as reader_lib
+
+__all__ = ["initialize", "is_initialized", "host_sharded_reader",
+           "multihost_mesh"]
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up the JAX distributed runtime (the ``paddle.init`` /
+    ``--trainer_id --pservers`` analog, ``v2/__init__.py:119``).
+
+    No-op when running single-process (the common case in tests and on a
+    single host) — call unconditionally at program start. Arguments default
+    to the standard env vars (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``) so launch scripts stay
+    config-only.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return                      # single-process run; nothing to do
+    kw = {}
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    jax.distributed.initialize(coordinator_address, **kw)
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def host_sharded_reader(reader_fn: Callable) -> Callable:
+    """Give this host its disjoint slice of the global stream (the Go
+    master's GetTask role): ``sharded(reader, host_count, host_id)`` with the
+    live process topology."""
+    return reader_lib.sharded(reader_fn, mesh_lib.host_count(),
+                              mesh_lib.host_id())
+
+
+def multihost_mesh(**axis_sizes) -> "mesh_lib.Mesh":
+    """Build a mesh spanning every device of every host (``make_mesh`` with
+    the global device set — on a pod slice ``jax.devices()`` already includes
+    remote-host devices). Axis sizes follow ``core.mesh.make_mesh``; the
+    ``data`` axis defaults to all devices."""
+    return mesh_lib.make_mesh(axis_sizes or {mesh_lib.DATA_AXIS: -1})

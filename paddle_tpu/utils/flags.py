@@ -99,7 +99,7 @@ def parse_flags(cls: Type[T] = TrainerFlags,
             file_vals = json.load(fh)
         for f in dataclasses.fields(cls):
             if f.name in file_vals:
-                values[f.name] = file_vals[f.name]
+                values[f.name] = _coerce(hints[f.name], file_vals[f.name])
     for f in dataclasses.fields(cls):
         env = os.environ.get(_ENV_PREFIX + f.name.upper())
         if env is not None:
@@ -116,4 +116,6 @@ def flags_to_json(flags) -> str:
 
 
 def flags_from_json(cls: Type[T], text: str) -> T:
-    return cls(**json.loads(text))
+    hints = typing.get_type_hints(cls)
+    return cls(**{k: _coerce(hints[k], v)
+                  for k, v in json.loads(text).items()})

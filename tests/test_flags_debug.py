@@ -97,3 +97,12 @@ def test_parse_flags_reads_sys_argv_by_default(monkeypatch):
     monkeypatch.setattr("sys.argv", ["prog", "--batch_size", "99"])
     f = parse_flags(TrainerFlags)
     assert f.batch_size == 99
+
+
+def test_flags_json_values_are_coerced(tmp_path):
+    import json as _json
+    cfg = tmp_path / "f.json"
+    cfg.write_text(_json.dumps({"learning_rate": "0.25", "resume": "false"}))
+    f = parse_flags(TrainerFlags, ["--flags_json", str(cfg)])
+    assert isinstance(f.learning_rate, float) and f.learning_rate == 0.25
+    assert f.resume is False

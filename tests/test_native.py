@@ -1,0 +1,69 @@
+"""Native host-pipeline kernels (packer.cpp via ctypes): build, exact
+equality with the Python fallbacks, and the packing round-trip under both
+paths (the analog of the reference's CPU-vs-GPU equivalence oracles applied
+to native-vs-Python)."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.core import sequence as seq
+
+
+def _have_gxx():
+    try:
+        subprocess.run(["g++", "--version"], capture_output=True, check=True)
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _have_gxx(), reason="no g++ in image")
+
+
+def test_native_builds_and_loads():
+    assert native.available(), "native lib failed to build with g++ present"
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_positions_native_equals_python(seed, monkeypatch):
+    rng = np.random.RandomState(seed)
+    segs = rng.randint(0, 4, size=(6, 32)).astype(np.int32)
+    got = seq.positions_from_segments(segs)
+    # force the Python path for the oracle
+    monkeypatch.setenv("PADDLE_TPU_NO_NATIVE", "1")
+    want = seq.positions_from_segments(segs)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_first_fit_native_equals_python(seed, monkeypatch):
+    rng = np.random.RandomState(seed)
+    lengths = rng.randint(1, 20, size=50).astype(np.int64)
+    order = np.argsort(-lengths, kind="stable")
+    got = seq._first_fit(lengths, order, 24)
+    monkeypatch.setenv("PADDLE_TPU_NO_NATIVE", "1")
+    want = seq._first_fit(lengths, order, 24)
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+    assert got[2] == want[2]
+
+
+def test_pack_roundtrip_with_native():
+    rng = np.random.RandomState(0)
+    seqs = [rng.normal(size=(rng.randint(1, 12), 3)).astype(np.float32)
+            for _ in range(20)]
+    data, seg, pos = seq.pack_sequences(seqs, row_len=16)
+    out = seq.unpack_sequences(data, seg)
+    key = lambda a: tuple(np.round(a.ravel(), 5).tolist())
+    assert sorted(map(key, out)) == sorted(map(key, seqs))
+    # no token overlap and full coverage
+    assert sum(len(s) for s in out) == sum(len(s) for s in seqs)
+
+
+def test_disable_env_forces_python(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_NO_NATIVE", "1")
+    assert native.lib() is None
