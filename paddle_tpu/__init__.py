@@ -15,3 +15,4 @@ from .core import (Module, Sequential, SeqBatch, initializers, make_mesh,
 from . import parallel
 from . import inference
 from .inference import export, infer, load_inference_model
+from . import config_helpers

@@ -1,0 +1,284 @@
+"""v1-style declarative layer DSL — the second frontend over the model IR.
+
+Reference: ``python/paddle/trainer_config_helpers/layers.py`` (~100 wrapper
+functions returning ``LayerOutput``, ``layers.py:312``) and ``networks.py``
+composites. The v1 API's essence: a config script *describes* a graph as
+data; the engine builds it. Here each helper appends a node to a small DAG
+and ``build_network`` compiles the DAG into ONE serializable
+:class:`NetworkModule` — so the declarative script and the imperative Module
+API meet in the same IR (``core/config.py``), the "one IR, two frontends"
+design SURVEY §7 calls for (the reference solved it the same way:
+``v2/layer.py:263`` reuses the v1 config generator).
+
+Example::
+
+    img  = data_layer("image")
+    h    = fc_layer(img, size=128, act="relu")
+    prob = fc_layer(h, size=10)
+    net  = build_network(prob)          # a Module; init/apply/export as usual
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn import recurrent as R
+from paddle_tpu.nn import sequence_ops as S
+from paddle_tpu.nn.attention import AdditiveAttention
+
+__all__ = [
+    "data_layer", "fc_layer", "embedding_layer", "img_conv_layer",
+    "img_pool_layer", "batch_norm_layer", "dropout_layer", "concat_layer",
+    "addto_layer", "cos_sim", "pooling_layer", "last_seq", "first_seq",
+    "simple_rnn", "lstmemory", "grumemory", "bidirectional_lstm",
+    "simple_img_conv_pool", "build_network", "NetworkModule", "LayerOut",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerOut:
+    """Handle to a DAG node (the reference's ``LayerOutput``)."""
+    graph: "_Graph"
+    idx: int
+
+
+class _Graph:
+    def __init__(self):
+        # node = (module_or_None, input_idxs, data_name_or_None, call_kwargs)
+        self.nodes: List[Tuple[Optional[Module], List[int],
+                               Optional[str], dict]] = []
+
+    def add_data(self, name: str) -> LayerOut:
+        self.nodes.append((None, [], name, {}))
+        return LayerOut(self, len(self.nodes) - 1)
+
+    def add(self, module: Module, inputs: Sequence[LayerOut],
+            **call_kwargs) -> LayerOut:
+        for i in inputs:
+            if i.graph is not self:
+                raise ValueError("layers from different graphs cannot mix")
+        self.nodes.append((module, [i.idx for i in inputs], None,
+                           dict(call_kwargs)))
+        return LayerOut(self, len(self.nodes) - 1)
+
+
+def _graph_of(inputs: Sequence[LayerOut]) -> _Graph:
+    return inputs[0].graph
+
+
+_current: List[_Graph] = []
+
+
+def _ensure_graph() -> _Graph:
+    if not _current:
+        _current.append(_Graph())
+    return _current[-1]
+
+
+def data_layer(name: str) -> LayerOut:
+    """Declare a network input (reference: ``data_layer``). Inputs feed
+    ``NetworkModule.forward`` positionally in declaration order."""
+    return _ensure_graph().add_data(name)
+
+
+def fc_layer(input: LayerOut, size: int, act: str = "",
+             bias_attr: bool = True, name=None) -> LayerOut:
+    return input.graph.add(L.Linear(size, act=act, use_bias=bias_attr,
+                                    name=name), [input])
+
+
+def embedding_layer(input: LayerOut, size: int, vocab: int,
+                    name=None) -> LayerOut:
+    return input.graph.add(L.Embedding(vocab, size, name=name), [input])
+
+
+def img_conv_layer(input: LayerOut, filter_size, num_filters: int,
+                   stride=1, padding="SAME", act: str = "",
+                   name=None) -> LayerOut:
+    return input.graph.add(
+        L.Conv2D(num_filters, kernel=filter_size, stride=stride,
+                 padding=padding, act=act, name=name), [input])
+
+
+def img_pool_layer(input: LayerOut, pool_size, stride=None,
+                   pool_type: str = "max", name=None) -> LayerOut:
+    return input.graph.add(L.Pool2D(pool_type, window=pool_size,
+                                    stride=stride, name=name), [input])
+
+
+def batch_norm_layer(input: LayerOut, act: str = "", name=None) -> LayerOut:
+    out = input.graph.add(L.BatchNorm(name=name), [input])
+    if act:
+        out = out.graph.add(_Activation(act), [out])
+    return out
+
+
+def dropout_layer(input: LayerOut, dropout_rate: float,
+                  name=None) -> LayerOut:
+    return input.graph.add(L.Dropout(dropout_rate, name=name), [input])
+
+
+def concat_layer(inputs: Sequence[LayerOut], name=None) -> LayerOut:
+    return _graph_of(inputs).add(L.Concat(name=name), list(inputs))
+
+
+def addto_layer(inputs: Sequence[LayerOut], act: str = "",
+                name=None) -> LayerOut:
+    return _graph_of(inputs).add(L.Addto(act=act, name=name), list(inputs))
+
+
+def cos_sim(a: LayerOut, b: LayerOut, name=None) -> LayerOut:
+    return a.graph.add(L.CosSim(name=name), [a, b])
+
+
+def pooling_layer(input: LayerOut, lengths: LayerOut,
+                  pooling_type: str = "average", name=None) -> LayerOut:
+    return input.graph.add(_SeqPool(pooling_type, name=name),
+                           [input, lengths])
+
+
+def last_seq(input: LayerOut, lengths: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(_SeqLast(name=name), [input, lengths])
+
+
+def first_seq(input: LayerOut, lengths: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(_SeqFirst(name=name), [input, lengths])
+
+
+def simple_rnn(input: LayerOut, size: int, reverse: bool = False,
+               name=None) -> LayerOut:
+    return input.graph.add(R.RNN(R.SimpleRNNCell(size), reverse=reverse,
+                                 name=name), [input], _take=0)
+
+
+def lstmemory(input: LayerOut, size: int, reverse: bool = False,
+              name=None) -> LayerOut:
+    return input.graph.add(R.RNN(R.LSTMCell(size), reverse=reverse,
+                                 name=name), [input], _take=0)
+
+
+def grumemory(input: LayerOut, size: int, reverse: bool = False,
+              name=None) -> LayerOut:
+    return input.graph.add(R.RNN(R.GRUCell(size), reverse=reverse,
+                                 name=name), [input], _take=0)
+
+
+def bidirectional_lstm(input: LayerOut, size: int, name=None) -> LayerOut:
+    return input.graph.add(
+        R.BiRNN(R.LSTMCell(size), R.LSTMCell(size), name=name), [input])
+
+
+def simple_img_conv_pool(input: LayerOut, filter_size, num_filters: int,
+                         pool_size, act: str = "relu") -> LayerOut:
+    """Composite (reference: ``networks.py`` ``simple_img_conv_pool``)."""
+    conv = img_conv_layer(input, filter_size, num_filters, act=act)
+    return img_pool_layer(conv, pool_size)
+
+
+class _Activation(Module):
+    def __init__(self, act: str, name=None):
+        super().__init__(name=name)
+        self.act = act
+
+    def forward(self, x):
+        from paddle_tpu.nn import activations
+        return activations.get(self.act)(x)
+
+
+class _SeqPool(Module):
+    def __init__(self, kind: str = "average", name=None):
+        super().__init__(name=name)
+        self.kind = kind
+
+    def forward(self, x, lengths):
+        return S.seq_pool(x, lengths, self.kind)
+
+
+class _SeqLast(Module):
+    def forward(self, x, lengths):
+        return S.seq_last(x, lengths)
+
+
+class _SeqFirst(Module):
+    def forward(self, x, lengths):
+        return S.seq_first(x, lengths)
+
+
+class NetworkModule(Module):
+    """The compiled DAG: one serializable Module whose constructor args are
+    the node list itself (modules serialize through the IR's module refs).
+
+    ``forward(*inputs)`` feeds ``data_layer`` nodes in declaration order and
+    evaluates nodes topologically (nodes are appended post-order, so list
+    order IS a topological order).
+    """
+
+    def __init__(self, modules: Sequence[Optional[Module]],
+                 edges: Sequence[Sequence[int]],
+                 data_names: Sequence[Optional[str]],
+                 takes: Sequence[int],
+                 outputs: Sequence[int], name="network"):
+        super().__init__(name=name)
+        self.modules = list(modules)
+        self.edges = [list(e) for e in edges]
+        self.data_names = list(data_names)
+        self.takes = list(takes)
+        self.outputs = list(outputs)
+
+    @staticmethod
+    def _accepted_kwargs(mod, kwargs):
+        """Pass through only the kwargs a node's forward accepts (the graph
+        driver broadcasts e.g. ``train=`` but plain layers don't take it)."""
+        if not kwargs:
+            return kwargs
+        import inspect
+        try:
+            sig = inspect.signature(mod.forward)
+        except (TypeError, ValueError):
+            return kwargs
+        if any(p.kind is inspect.Parameter.VAR_KEYWORD
+               for p in sig.parameters.values()):
+            return kwargs
+        return {k: v for k, v in kwargs.items() if k in sig.parameters}
+
+    def forward(self, *inputs, **kwargs):
+        feed = list(inputs)
+        values: List[Any] = []
+        for mod, ins, dname, take in zip(self.modules, self.edges,
+                                         self.data_names, self.takes):
+            if mod is None:
+                if not feed:
+                    raise ValueError(
+                        f"missing input for data layer {dname!r}")
+                values.append(feed.pop(0))
+            else:
+                out = mod(*[values[i] for i in ins],
+                          **self._accepted_kwargs(mod, kwargs))
+                if take >= 0 and isinstance(out, tuple):
+                    out = out[take]
+                values.append(out)
+        outs = [values[i] for i in self.outputs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def build_network(*outputs: LayerOut, name: str = "network") -> NetworkModule:
+    """Freeze the current graph into a :class:`NetworkModule` and reset the
+    implicit builder (each config script builds one network, like a v1
+    config file)."""
+    if not outputs:
+        raise ValueError("build_network needs at least one output")
+    g = outputs[0].graph
+    for o in outputs:
+        if o.graph is not g:
+            raise ValueError("outputs from different graphs")
+    if _current and _current[-1] is g:
+        _current.pop()
+    mods = [n[0] for n in g.nodes]
+    edges = [n[1] for n in g.nodes]
+    names = [n[2] for n in g.nodes]
+    takes = [n[3].get("_take", -1) for n in g.nodes]
+    return NetworkModule(mods, edges, names, takes,
+                         [o.idx for o in outputs], name=name)

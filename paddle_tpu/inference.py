@@ -29,7 +29,8 @@ from .core import config as config_lib
 from .train.checkpoint import (_flatten, _unflatten, atomic_dir,
                                verify_manifest, write_manifest)
 
-__all__ = ["export", "load_inference_model", "InferenceModel", "infer"]
+__all__ = ["export", "load_inference_model", "InferenceModel", "infer",
+           "merge_model", "dump_config"]
 
 _MODEL_FILE = "model.json"
 _VARS_FILE = "variables.npz"
@@ -104,3 +105,18 @@ def infer(path_or_model, *args, method: Optional[str] = None, **kwargs):
     m = (path_or_model if isinstance(path_or_model, InferenceModel)
          else load_inference_model(path_or_model))
     return m.predict(*args, method=method, **kwargs)
+
+
+def merge_model(path: str, model, variables: Dict[str, Any]) -> str:
+    """Bundle config + parameters into one deployable directory (reference:
+    ``trainer/MergeModel.cpp:17`` and ``python/paddle/utils/merge_model.py``
+    ``merge_v2_model``) — an alias of :func:`export`, named for parity."""
+    return export(path, model, variables)
+
+
+def dump_config(model, indent: int = 2) -> str:
+    """Serialized model config as JSON text (reference:
+    ``python/paddle/utils/dump_config.py`` — prints the generated proto)."""
+    import json
+    from paddle_tpu.core.config import module_config
+    return json.dumps(module_config(model), indent=indent, sort_keys=True)

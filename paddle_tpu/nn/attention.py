@@ -81,16 +81,24 @@ class DotProductAttention(Module):
 
 class MultiHeadAttention(Module):
     """Scaled-dot-product multi-head attention, bf16-friendly, with optional
-    causal + segment masking (packed sequences). Self- or cross-attention."""
+    causal + segment masking (packed sequences). Self- or cross-attention.
+
+    ``use_flash=True`` routes self-attention through the fused Pallas kernel
+    (:mod:`paddle_tpu.nn.pallas_attention`) — linear HBM traffic for long
+    sequences. The flash path supports ``causal=`` but not arbitrary
+    ``mask=`` (flash + mask raises; use packing-aware masks on the XLA
+    path)."""
 
     def __init__(self, num_heads: int, head_dim: Optional[int] = None,
-                 out_dim: Optional[int] = None, name=None):
+                 out_dim: Optional[int] = None, use_flash: bool = False,
+                 name=None):
         super().__init__(name=name)
         self.num_heads = num_heads
         self.head_dim = head_dim
         self.out_dim = out_dim
+        self.use_flash = use_flash
 
-    def forward(self, q_in, kv_in=None, mask=None):
+    def forward(self, q_in, kv_in=None, mask=None, causal: bool = False):
         """q_in [B, Tq, D]; kv_in defaults to q_in (self-attention);
         mask [B, Tq, Tk] (1 = attend)."""
         kv_in = q_in if kv_in is None else kv_in
@@ -108,11 +116,36 @@ class MultiHeadAttention(Module):
         q = proj("wq", q_in, h * hd).reshape(*q_in.shape[:2], h, hd)
         k = proj("wk", kv_in, h * hd).reshape(*kv_in.shape[:2], h, hd)
         v = proj("wv", kv_in, h * hd).reshape(*kv_in.shape[:2], h, hd)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-        logits = logits.astype(jnp.float32)
-        if mask is not None:
-            logits = jnp.where(mask[:, None, :, :] > 0, logits, -1e9)
-        w = jax.nn.softmax(logits, axis=-1).astype(pol.compute_dtype)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", w, v)
+        if self.use_flash:
+            if mask is not None:
+                raise ValueError(
+                    "flash path supports causal=, not arbitrary mask=")
+            if kv_in is not q_in:
+                raise ValueError("flash path is self-attention only; pass "
+                                 "kv_in=None or use use_flash=False")
+            from .pallas_attention import flash_attention
+            T = q.shape[1]
+            # largest divisor of T up to 128 keeps VMEM blocks bounded; a T
+            # with no reasonable divisor must be padded upstream
+            bq = next((b for b in (128, 64, 32, 16, 8) if T % b == 0), None)
+            if bq is None:
+                raise ValueError(
+                    f"flash path needs seq len divisible by 8; pad T={T}")
+            ctx = flash_attention(jnp.moveaxis(q, 2, 1),
+                                  jnp.moveaxis(k, 2, 1),
+                                  jnp.moveaxis(v, 2, 1),
+                                  causal, None, bq, bq)
+            ctx = jnp.moveaxis(ctx, 1, 2).astype(pol.compute_dtype)
+        else:
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+            logits = logits.astype(jnp.float32)
+            if causal:
+                Tq, Tk = logits.shape[-2:]
+                cm = jnp.tril(jnp.ones((Tq, Tk), bool))
+                logits = jnp.where(cm[None, None], logits, -1e9)
+            if mask is not None:
+                logits = jnp.where(mask[:, None, :, :] > 0, logits, -1e9)
+            w = jax.nn.softmax(logits, axis=-1).astype(pol.compute_dtype)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", w, v)
         ctx = ctx.reshape(*q_in.shape[:2], h * hd)
         return proj("wo", ctx, out_d)

@@ -37,6 +37,7 @@ __all__ = [
     "MixedLayer", "FullMatrixProjection", "TableProjection", "IdentityProjection",
     "DotMulProjection", "ContextProjection", "CrossMapNormal", "RowConv",
     "Conv3D", "Conv3DTranspose", "Pool3D", "SelectiveFC", "SamplingId",
+    "ScaleSubRegion",
 ]
 
 Pair = Union[int, Tuple[int, int]]
@@ -953,3 +954,27 @@ class SamplingId(Module):
         logits = x if self.from_logits else jnp.log(jnp.maximum(x, 1e-30))
         key = current_rng("sample")
         return jax.random.categorical(key, logits, axis=-1)
+
+
+class ScaleSubRegion(Module):
+    """Scale a per-sample sub-region of an image by a constant (reference:
+    ``function/ScaleSubRegionOp.cpp`` — 1-based inclusive region indices
+    ``[c1, c2, h1, h2, w1, w2]`` per sample, forward multiplies the region
+    by ``value``). NHWC here; region built as a boolean mask so the op (and
+    its gradient, which scales only in-region, ``:73``) stays jit-safe."""
+
+    def __init__(self, value: float, name=None):
+        super().__init__(name=name)
+        self.value = value
+
+    def forward(self, x, indices):
+        B, H, W, C = x.shape
+        idx = indices.astype(jnp.int32)          # [B, 6], 1-based inclusive
+        cc = jnp.arange(C)[None, :]
+        hh = jnp.arange(H)[None, :]
+        ww = jnp.arange(W)[None, :]
+        cm = (cc >= idx[:, 0:1] - 1) & (cc <= idx[:, 1:2] - 1)   # [B, C]
+        hm = (hh >= idx[:, 2:3] - 1) & (hh <= idx[:, 3:4] - 1)   # [B, H]
+        wm = (ww >= idx[:, 4:5] - 1) & (ww <= idx[:, 5:6] - 1)   # [B, W]
+        mask = hm[:, :, None, None] & wm[:, None, :, None] & cm[:, None, None, :]
+        return jnp.where(mask, x * self.value, x)

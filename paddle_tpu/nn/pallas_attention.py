@@ -1,0 +1,147 @@
+"""Fused blockwise (flash-style) attention in Pallas — the long-context hot
+op where XLA's generic fusion loses: materialising the [T, T] score matrix in
+HBM is O(T^2) bandwidth, while this kernel streams K/V blocks through VMEM
+with an online softmax, keeping HBM traffic linear in T.
+
+Reference-lineage note: the 2017 reference has no attention kernel at all
+(SURVEY §5 long-context row — this is one of the deliberate "exceeds" items);
+its closest machinery is the RNN-era ``ContextProjection``. The algorithm is
+the public flash-attention online-softmax recurrence; the kernel follows the
+Pallas TPU playbook (`/opt/skills/guides/pallas_guide.md`): 2-D grid over
+(batch*heads, query blocks), K/V resident in VMEM, ``fori_loop`` over key
+blocks carrying (running max, denominator, accumulator).
+
+Autodiff: the kernel is forward-only; a ``jax.custom_vjp`` recomputes
+attention for the backward pass (flash-style rematerialisation — no [T, T]
+tensor is saved between forward and backward).
+
+``interpret=None`` auto-selects the Pallas interpreter off-TPU, so the same
+tests run on the CPU harness and the kernel compiles on real chips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention", "reference_attention"]
+
+
+def reference_attention(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Plain softmax attention — the numeric oracle and the backward-pass
+    recomputation target. [B, H, T, D] inputs."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k):
+    # q_ref: [BQ, D]; k_ref/v_ref: [T, D]; o_ref: [BQ, D]
+    bq, d = q_ref.shape
+    t = k_ref.shape[0]
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    def body(kb, carry):
+        m, l, acc = carry
+        ks = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vs = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_idx = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_idx <= q_idx, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # exp(-inf - -inf) guards: rows with no visible keys keep m = -inf
+        p = jnp.exp(s - m_new)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.exp(m - m_new)
+        corr = jnp.where(jnp.isfinite(m), corr, 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, vs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    num_kb = t // block_k
+    if causal:
+        # key blocks strictly after this query block never contribute:
+        # highest visible key is (qi+1)*bq - 1 -> ceil((qi+1)*bq / block_k)
+        num_kb = jnp.minimum(num_kb,
+                             ((qi + 1) * bq + block_k - 1) // block_k)
+    _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, H, T, D = q.shape
+    bq = min(block_q, T)
+    bk = min(block_k, T)
+    assert T % bq == 0 and T % bk == 0, \
+        f"seq len {T} must be a multiple of block sizes ({bq}, {bk})"
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    kern = functools.partial(_attn_kernel, scale=scale, causal=causal,
+                             block_k=bk)
+    out = pl.pallas_call(
+        kern,
+        grid=(B * H, T // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Fused attention over [B, H, T, D]. ``T`` must divide by the block
+    sizes (pack/pad upstream — static shapes are the framework contract).
+    ``interpret`` defaults to True off-TPU so the CPU test harness runs the
+    same kernel through the Pallas interpreter."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # flash-style rematerialisation: recompute attention under vjp instead of
+    # saving the [T, T] probabilities
+    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal,
+                                                         scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

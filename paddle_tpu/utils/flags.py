@@ -61,7 +61,9 @@ def _base_type(tp):
     return tp
 
 
-def _coerce(tp, raw: str):
+def _coerce(tp, raw):
+    if raw is None:               # JSON null for an Optional field
+        return None
     tp = _base_type(tp)
     if tp is bool:
         if isinstance(raw, bool):

@@ -212,3 +212,42 @@ def test_conv3d_grad_under_bf16_policy():
         Conv3DTranspose(features=2, kernel=2, padding=1).init(
             jax.random.PRNGKey(0), x), x)
     assert y.ndim == 5
+
+
+def test_scale_sub_region_vs_oracle():
+    from paddle_tpu.nn.layers import ScaleSubRegion
+    rng = np.random.RandomState(5)
+    x = rng.normal(size=(2, 4, 5, 3)).astype(np.float32)
+    # per-sample 1-based inclusive [c1,c2,h1,h2,w1,w2]
+    idx = np.array([[1, 2, 2, 3, 1, 5],
+                    [3, 3, 1, 4, 2, 2]], np.int32)
+    mod = ScaleSubRegion(value=2.0)
+    got = np.asarray(mod.apply({}, jnp.asarray(x), jnp.asarray(idx)))
+    want = x.copy()
+    for b in range(2):
+        c1, c2, h1, h2, w1, w2 = idx[b]
+        want[b, h1-1:h2, w1-1:w2, c1-1:c2] *= 2.0
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # gradient flows scaled only in-region (reference backward :73)
+    g = jax.grad(lambda x: jnp.sum(mod.apply({}, x, jnp.asarray(idx))))(
+        jnp.asarray(x))
+    gw = np.ones_like(x)
+    for b in range(2):
+        c1, c2, h1, h2, w1, w2 = idx[b]
+        gw[b, h1-1:h2, w1-1:w2, c1-1:c2] = 2.0
+    np.testing.assert_allclose(np.asarray(g), gw, rtol=1e-6)
+
+
+def test_merge_model_and_dump_config(tmp_path):
+    import json
+    from paddle_tpu.inference import dump_config, merge_model, infer
+    from paddle_tpu.nn.layers import Linear
+    m = Linear(3)
+    v = m.init(jax.random.PRNGKey(0), jnp.ones((2, 4)))
+    d = merge_model(str(tmp_path / "deploy"), m, v)
+    out = infer(d, jnp.ones((2, 4)))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(m.apply(v, jnp.ones((2, 4)))),
+                               rtol=1e-6)
+    cfg = json.loads(dump_config(m))
+    assert cfg["modules"] and "root" in cfg

@@ -106,3 +106,16 @@ def test_flags_json_values_are_coerced(tmp_path):
     f = parse_flags(TrainerFlags, ["--flags_json", str(cfg)])
     assert isinstance(f.learning_rate, float) and f.learning_rate == 0.25
     assert f.resume is False
+
+
+def test_flags_optional_none_roundtrip():
+    import dataclasses
+    import typing
+
+    @dataclasses.dataclass
+    class F(TrainerFlags):
+        maybe: typing.Optional[str] = None
+
+    f = F()
+    g = flags_from_json(F, flags_to_json(f))
+    assert g.maybe is None
