@@ -35,6 +35,7 @@ __all__ = [
     "addto_layer", "cos_sim", "pooling_layer", "last_seq", "first_seq",
     "simple_rnn", "lstmemory", "grumemory", "bidirectional_lstm",
     "simple_img_conv_pool", "build_network", "NetworkModule", "LayerOut",
+    "reset_graph",
 ]
 
 
@@ -76,6 +77,12 @@ def _ensure_graph() -> _Graph:
     if not _current:
         _current.append(_Graph())
     return _current[-1]
+
+
+def reset_graph() -> None:
+    """Drop any in-progress config graph (for abandoned scripts / REPLs;
+    ``build_network`` resets automatically)."""
+    _current.clear()
 
 
 def data_layer(name: str) -> LayerOut:
@@ -260,6 +267,10 @@ class NetworkModule(Module):
                 if take >= 0 and isinstance(out, tuple):
                     out = out[take]
                 values.append(out)
+        if feed:
+            raise ValueError(
+                f"{len(feed)} surplus input(s): the network declares "
+                f"{sum(m is None for m in self.modules)} data layer(s)")
         outs = [values[i] for i in self.outputs]
         return outs[0] if len(outs) == 1 else tuple(outs)
 
@@ -274,8 +285,9 @@ def build_network(*outputs: LayerOut, name: str = "network") -> NetworkModule:
     for o in outputs:
         if o.graph is not g:
             raise ValueError("outputs from different graphs")
-    if _current and _current[-1] is g:
-        _current.pop()
+    # reset unconditionally so an earlier abandoned/failed script can't leak
+    # its graph into the next one
+    _current.clear()
     mods = [n[0] for n in g.nodes]
     edges = [n[1] for n in g.nodes]
     names = [n[2] for n in g.nodes]

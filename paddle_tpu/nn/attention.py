@@ -84,10 +84,11 @@ class MultiHeadAttention(Module):
     causal + segment masking (packed sequences). Self- or cross-attention.
 
     ``use_flash=True`` routes self-attention through the fused Pallas kernel
-    (:mod:`paddle_tpu.nn.pallas_attention`) — linear HBM traffic for long
-    sequences. The flash path supports ``causal=`` but not arbitrary
-    ``mask=`` (flash + mask raises; use packing-aware masks on the XLA
-    path)."""
+    (:mod:`paddle_tpu.nn.pallas_attention`) — linear HBM traffic in the
+    forward pass (the backward currently rematerialises full attention, see
+    the kernel module docstring). The flash path supports ``causal=`` but
+    not arbitrary ``mask=`` (flash + mask raises; use packing-aware masks on
+    the XLA path)."""
 
     def __init__(self, num_heads: int, head_dim: Optional[int] = None,
                  out_dim: Optional[int] = None, use_flash: bool = False,

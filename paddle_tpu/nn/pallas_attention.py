@@ -12,8 +12,12 @@ Pallas TPU playbook (`/opt/skills/guides/pallas_guide.md`): 2-D grid over
 blocks carrying (running max, denominator, accumulator).
 
 Autodiff: the kernel is forward-only; a ``jax.custom_vjp`` recomputes
-attention for the backward pass (flash-style rematerialisation — no [T, T]
-tensor is saved between forward and backward).
+attention for the backward pass. Nothing [T, T]-shaped is SAVED between
+forward and backward, but the recomputation itself is the plain XLA
+attention, so the backward pass still materialises [T, T] scores
+transiently — training memory/bandwidth is O(T^2) in the backward. The
+linear-HBM win currently applies to inference and to forward-dominated
+uses; a blockwise Pallas backward is the known follow-up.
 
 ``interpret=None`` auto-selects the Pallas interpreter off-TPU, so the same
 tests run on the CPU harness and the kernel compiles on real chips.

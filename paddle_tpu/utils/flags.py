@@ -61,9 +61,16 @@ def _base_type(tp):
     return tp
 
 
+def _is_optional(tp) -> bool:
+    return (typing.get_origin(tp) is typing.Union
+            and type(None) in typing.get_args(tp))
+
+
 def _coerce(tp, raw):
-    if raw is None:               # JSON null for an Optional field
-        return None
+    if raw is None:
+        if _is_optional(tp):      # JSON null for an Optional field
+            return None
+        raise ValueError(f"null is not valid for a non-Optional {tp} flag")
     tp = _base_type(tp)
     if tp is bool:
         if isinstance(raw, bool):

@@ -112,3 +112,30 @@ def test_batch_norm_layer_with_act():
     p = net.init(jax.random.PRNGKey(0), x)
     y, _ = net.apply(p, x, train=True, mutable=("state",))
     assert (np.asarray(y) >= 0).all()
+
+
+def test_abandoned_graph_does_not_leak():
+    H.data_layer("junk")           # abandoned script
+    H.reset_graph()
+    a = H.data_layer("x")
+    net = H.build_network(H.fc_layer(a, size=2))
+    assert sum(m is None for m in net.modules) == 1
+
+    # build_network itself also resets: a failed script then a new one
+    H.data_layer("junk2")
+    b = H.data_layer("y")          # same (leaked) graph...
+    net2 = H.build_network(H.fc_layer(b, size=2))
+    # ...but after this build, the next script starts clean
+    c = H.data_layer("z")
+    net3 = H.build_network(H.fc_layer(c, size=2))
+    assert sum(m is None for m in net3.modules) == 1
+
+
+def test_surplus_inputs_rejected():
+    a = H.data_layer("x")
+    net = H.build_network(H.fc_layer(a, size=2))
+    x = jnp.ones((2, 3))
+    p = net.init(jax.random.PRNGKey(0), x)
+    import pytest
+    with pytest.raises(ValueError, match="surplus"):
+        net.apply(p, x, x)

@@ -119,3 +119,12 @@ def test_flags_optional_none_roundtrip():
     f = F()
     g = flags_from_json(F, flags_to_json(f))
     assert g.maybe is None
+
+
+def test_flags_null_for_required_field_fails_fast(tmp_path):
+    import json as _json
+    import pytest
+    cfg = tmp_path / "f.json"
+    cfg.write_text(_json.dumps({"batch_size": None}))
+    with pytest.raises(ValueError, match="non-Optional"):
+        parse_flags(TrainerFlags, ["--flags_json", str(cfg)])
