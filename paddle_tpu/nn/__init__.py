@@ -1,10 +1,11 @@
 """Layer library: core layers, activations, costs, sequence ops, recurrent nets,
 attention — the TPU-native successor of paddle/gserver/layers (+ fluid operators)."""
 
-from . import activations, costs, ctc, detection, sequence_ops
+from . import activations, costs, ctc, detection, moe, sequence_ops
 from .attention import (AdditiveAttention, DotProductAttention,
                         MultiHeadAttention)
 from .crf import CRF, crf_decode, crf_log_likelihood
+from .moe import MoEFFN, moe_sharding_rules
 from .detection import (DetectionOutput, MultiBoxLoss, ROIPool,
                         decode_boxes, encode_boxes, iou_matrix, nms,
                         prior_box)
@@ -20,4 +21,5 @@ __all__ = list(_layers_all) + [
     "ctc_loss", "ctc_greedy_decode", "AdditiveAttention", "DotProductAttention",
     "MultiHeadAttention", "detection", "DetectionOutput", "MultiBoxLoss",
     "ROIPool", "prior_box", "nms", "iou_matrix", "encode_boxes", "decode_boxes",
+    "MoEFFN", "moe_sharding_rules", "moe",
 ]

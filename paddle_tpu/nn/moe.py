@@ -1,0 +1,103 @@
+"""Mixture-of-experts FFN with expert parallelism over the ``expert`` mesh
+axis — a forward-looking capability (the 2017 reference has no MoE; the
+mesh declares the axis, ``core/mesh.py``, and this layer is what uses it).
+
+TPU-native shape: the classic static dispatch/combine einsum formulation —
+top-1 routing with a fixed per-expert capacity, dispatch as a one-hot
+[tokens, experts, capacity] tensor, expert FFNs batched over the expert
+dimension. Everything is dense matmuls with static shapes (MXU-friendly, no
+sorting/gathering), and sharding the expert-major weights/activations over
+the ``expert`` axis (see :func:`moe_sharding_rules`) makes XLA insert the
+token all-to-alls over ICI.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers as I
+from paddle_tpu.core.module import Module
+
+__all__ = ["MoEFFN", "moe_sharding_rules"]
+
+
+class MoEFFN(Module):
+    """Top-1 routed expert FFN: ``x [B, T, D] -> [B, T, D]``.
+
+    ``capacity_factor`` sizes each expert's token buffer
+    (``C = ceil(tokens/experts * factor)``); overflowing tokens are dropped
+    (contribute zero — the standard static-capacity trade).
+    ``forward(x, return_aux=True)`` also returns the Switch-style
+    load-balancing auxiliary loss to add to the training objective."""
+
+    def __init__(self, num_experts: int, hidden: int,
+                 capacity_factor: float = 1.25, act: str = "gelu",
+                 name=None):
+        super().__init__(name=name)
+        self.num_experts = num_experts
+        self.hidden = hidden
+        self.capacity_factor = capacity_factor
+        self.act_name = act
+
+    def forward(self, x, return_aux: bool = False):
+        from . import activations
+        B, T, D = x.shape
+        E = self.num_experts
+        N = B * T
+        C = max(1, math.ceil(N / E * self.capacity_factor))
+        act = activations.get(self.act_name)
+
+        wg = self.param("wg", I.xavier_uniform, (D, E))
+        w1 = self.param("w1", I.fan_in_uniform, (E, D, self.hidden))
+        b1 = self.param("b1", I.zeros, (E, self.hidden))
+        w2 = self.param("w2", I.fan_in_uniform, (E, self.hidden, D))
+        b2 = self.param("b2", I.zeros, (E, D))
+
+        xf = x.reshape(N, D)
+        logits = xf @ wg                                    # [N, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                 # [N]
+        gate = jnp.max(probs, axis=-1)                      # [N]
+        # Routing bookkeeping stays int32 regardless of x.dtype: a bf16
+        # cumsum only counts exactly to 256, which would collide capacity
+        # slots on real batch sizes.
+        onehot_i = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [N, E]
+        pos = jnp.cumsum(onehot_i, axis=0) * onehot_i - 1      # [N, E]
+        kept = (pos < C) & (onehot_i > 0)
+        pos_c = jnp.clip(pos, 0, C - 1)
+        pos_onehot = jax.nn.one_hot(pos_c, C, dtype=x.dtype)   # [N, E, C]
+        dispatch = pos_onehot * kept.astype(x.dtype)[..., None]
+        combine = dispatch * gate.astype(x.dtype)[:, None, None]
+        onehot = onehot_i.astype(jnp.float32)
+
+        # [E, C, D] expert inputs; batched expert FFN; combine back
+        expert_in = jnp.einsum("nd,nec->ecd", xf, dispatch)
+        h = act(jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None, :])
+        expert_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+        out = jnp.einsum("ecd,nec->nd", expert_out, combine)
+
+        out = out.reshape(B, T, D)
+        if not return_aux:
+            return out
+        # Switch-style load-balance aux: E * sum_e (frac_tokens_e * mean_prob_e)
+        frac = jnp.mean(onehot, axis=0)
+        mean_prob = jnp.mean(probs.astype(jnp.float32), axis=0)
+        return out, E * jnp.sum(frac * mean_prob)
+
+
+def moe_sharding_rules(expert_axis: str = "expert"):
+    """fnmatch-style ``(pattern, PartitionSpec)`` rules sharding the
+    expert-major MoE weights over the expert mesh axis (feed to
+    :class:`paddle_tpu.parallel.ShardingRules`, composable with other
+    rules)."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        ("*/w1", P(expert_axis, None, None)),
+        ("*/b1", P(expert_axis, None)),
+        ("*/w2", P(expert_axis, None, None)),
+        ("*/b2", P(expert_axis, None)),
+    ]

@@ -18,10 +18,13 @@ This package owns:
 from .sharding import (ShardingRules, spec_tree, named_shardings,
                        shard_tree, sharded_init)
 from .ring import ring_attention, make_ring_attention
+from .ulysses import ulysses_attention, make_ulysses_attention
 from .multihost import (initialize, is_initialized,
                         host_sharded_reader, multihost_mesh)
 
 __all__ = [
     "ShardingRules", "spec_tree", "named_shardings", "shard_tree",
     "sharded_init", "ring_attention", "make_ring_attention",
+    "ulysses_attention", "make_ulysses_attention", "initialize",
+    "is_initialized", "host_sharded_reader", "multihost_mesh",
 ]

@@ -78,11 +78,11 @@ def ring_attention(q, k, v, axis_name: str, axis_size: int,
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
-                        batch_axis: Optional[str] = None,
-                        causal: bool = False):
-    """Wrap :func:`ring_attention` in shard_map over ``mesh``: takes GLOBAL
-    [B, T, H, D] arrays (time sharded over ``seq_axis``, optionally batch over
+def wrap_seq_parallel(attn_fn, mesh: Mesh, seq_axis: str,
+                      batch_axis: Optional[str], causal: bool):
+    """Shared shard_map wrapper for sequence-parallel attention kernels
+    (ring and Ulysses expose the same surface): takes GLOBAL [B, T, H, D]
+    arrays (time sharded over ``seq_axis``, optionally batch over
     ``batch_axis``) and returns the global output."""
     try:
         from jax import shard_map
@@ -91,7 +91,16 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
 
     n = dict(zip(mesh.axis_names, mesh.devices.shape))[seq_axis]
     spec = P(batch_axis, seq_axis, None, None)
-    fn = functools.partial(ring_attention, axis_name=seq_axis, axis_size=n,
+    fn = functools.partial(attn_fn, axis_name=seq_axis, axis_size=n,
                            causal=causal)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)
+
+
+def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
+                        batch_axis: Optional[str] = None,
+                        causal: bool = False):
+    """:func:`ring_attention` over global arrays (see
+    :func:`wrap_seq_parallel`)."""
+    return wrap_seq_parallel(ring_attention, mesh, seq_axis, batch_axis,
+                             causal)

@@ -1,0 +1,69 @@
+"""Ulysses-style all-to-all sequence parallelism — the second long-context
+strategy next to :mod:`.ring` (the build brief names both; the 2017
+reference has neither, SURVEY.md §5).
+
+Where ring attention keeps time sharded and rotates K/V shards around the
+ICI ring, the all-to-all scheme re-shards: heads are scattered and time
+gathered (`lax.all_to_all`), every device computes ordinary full-sequence
+attention for its H/n heads, then the layout is swapped back. Two
+all-to-alls per layer instead of n-1 ppermutes; preferable when
+heads >= devices and the per-device full-T working set fits HBM — the
+standard trade-off between the two schemes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .ring import _NEG, wrap_seq_parallel
+
+__all__ = ["ulysses_attention", "make_ulysses_attention"]
+
+
+def ulysses_attention(q, k, v, axis_name: str, axis_size: int,
+                      causal: bool = False, scale: Optional[float] = None):
+    """All-to-all sequence-parallel attention — call INSIDE shard_map.
+
+    q, k, v: local shards [B, T/n, H, D], time sharded over ``axis_name``.
+    Requires ``H % n == 0``. Returns the local [B, T/n, H, D] output shard.
+    """
+    n = axis_size
+    h = q.shape[2]
+    assert h % n == 0, f"heads {h} must divide by seq-axis size {n}"
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+
+    def scatter_heads(x):
+        # [B, T/n, H, D] -> [B, T, H/n, D]: split heads n ways, gather time
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg = scatter_heads(q).astype(jnp.float32) * scale
+    kg = scatter_heads(k).astype(jnp.float32)
+    vg = scatter_heads(v).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg)
+    if causal:
+        t = s.shape[-1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vg).astype(q.dtype)
+    # [B, T, H/n, D] -> [B, T/n, H, D]: split time n ways, gather heads
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def make_ulysses_attention(mesh: Mesh, seq_axis: str = "seq",
+                           batch_axis: Optional[str] = None,
+                           causal: bool = False):
+    """:func:`ulysses_attention` over global arrays — same surface as
+    :func:`.ring.make_ring_attention` so models can switch strategies by
+    config (shared wrapper: :func:`.ring.wrap_seq_parallel`)."""
+    return wrap_seq_parallel(ulysses_attention, mesh, seq_axis, batch_axis,
+                             causal)
