@@ -14,7 +14,6 @@ token all-to-alls over ICI.
 from __future__ import annotations
 
 import math
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -1,26 +1,24 @@
 """Fused blockwise (flash-style) attention in Pallas — the long-context hot
 op where XLA's generic fusion loses: materialising the [T, T] score matrix in
-HBM is O(T^2) bandwidth, while this kernel streams K/V blocks through VMEM
+HBM is O(T^2) bandwidth, while these kernels stream K/V blocks through VMEM
 with an online softmax, keeping HBM traffic linear in T.
 
 Reference-lineage note: the 2017 reference has no attention kernel at all
 (SURVEY §5 long-context row — this is one of the deliberate "exceeds" items);
 its closest machinery is the RNN-era ``ContextProjection``. The algorithm is
-the public flash-attention online-softmax recurrence; the kernel follows the
-Pallas TPU playbook (`/opt/skills/guides/pallas_guide.md`): 2-D grid over
-(batch*heads, query blocks), K/V resident in VMEM, ``fori_loop`` over key
-blocks carrying (running max, denominator, accumulator).
+the public flash-attention recipe; the kernels follow the Pallas TPU playbook
+(`/opt/skills/guides/pallas_guide.md`): 2-D grid over (batch*heads, row
+blocks), the streamed operand resident in VMEM, ``fori_loop`` over the other
+axis' blocks.
 
-Autodiff: the kernel is forward-only; a ``jax.custom_vjp`` recomputes
-attention for the backward pass. Nothing [T, T]-shaped is SAVED between
-forward and backward, but the recomputation itself is the plain XLA
-attention, so the backward pass still materialises [T, T] scores
-transiently — training memory/bandwidth is O(T^2) in the backward. The
-linear-HBM win currently applies to inference and to forward-dominated
-uses; a blockwise Pallas backward is the known follow-up.
+Training is fully blockwise: the forward saves only O and the per-row
+log-sum-exp L; the backward runs two Pallas kernels (dq over query blocks;
+dk/dv over key blocks) that rebuild each probability tile as
+``exp(s - L)`` — nothing [T, T]-shaped ever exists in HBM, forward or
+backward.
 
 ``interpret=None`` auto-selects the Pallas interpreter off-TPU, so the same
-tests run on the CPU harness and the kernel compiles on real chips.
+tests run on the CPU harness and the kernels compile on real chips.
 """
 
 from __future__ import annotations
@@ -34,11 +32,12 @@ from jax.experimental import pallas as pl
 
 __all__ = ["flash_attention", "reference_attention"]
 
+_NEG = -1e30
+
 
 def reference_attention(q, k, v, causal: bool = False,
                         scale: Optional[float] = None):
-    """Plain softmax attention — the numeric oracle and the backward-pass
-    recomputation target. [B, H, T, D] inputs."""
+    """Plain softmax attention — the numeric oracle. [B, H, T, D] inputs."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -50,8 +49,15 @@ def reference_attention(q, k, v, causal: bool = False,
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k):
-    # q_ref: [BQ, D]; k_ref/v_ref: [T, D]; o_ref: [BQ, D]
+def _causal_mask(qi, bq, kb, bk):
+    q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_idx = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return k_idx <= q_idx
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                 block_k):
+    # q_ref: [BQ, D]; k_ref/v_ref: [T, D]; o_ref: [BQ, D]; lse_ref: [BQ]
     bq, d = q_ref.shape
     t = k_ref.shape[0]
     qi = pl.program_id(1)
@@ -64,23 +70,17 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k):
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_idx = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_idx <= q_idx, s, -jnp.inf)
+            s = jnp.where(_causal_mask(qi, bq, kb, block_k), s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        # exp(-inf - -inf) guards: rows with no visible keys keep m = -inf
         p = jnp.exp(s - m_new)
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
         corr = jnp.exp(m - m_new)
-        corr = jnp.where(jnp.isfinite(m), corr, 0.0)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jax.lax.dot_general(
             p, vs, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
     num_kb = t // block_k
@@ -89,8 +89,86 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k):
         # highest visible key is (qi+1)*bq - 1 -> ceil((qi+1)*bq / block_k)
         num_kb = jnp.minimum(num_kb,
                              ((qi + 1) * bq + block_k - 1) // block_k)
-    _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    lse_ref[:] = m + jnp.log(l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_k):
+    # per-query-block dq: loop over key blocks, rebuilding P = exp(s - lse)
+    bq, d = q_ref.shape
+    t = k_ref.shape[0]
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]                                 # [BQ, 1]
+    delta = delta_ref[:]                             # [BQ, 1]
+
+    def body(kb, dq):
+        ks = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vs = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = jnp.where(_causal_mask(qi, bq, kb, block_k), s, _NEG)
+        p = jnp.exp(s - lse)                         # [BQ, BK]
+        dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(ds, ks, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    num_kb = t // block_k
+    if causal:
+        num_kb = jnp.minimum(num_kb,
+                             ((qi + 1) * bq + block_k - 1) // block_k)
+    dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q):
+    # per-key-block dk/dv: loop over query blocks
+    bk, d = k_ref.shape
+    t = q_ref.shape[0]
+    ki = pl.program_id(1)
+    ks = k_ref[:].astype(jnp.float32)
+    vs = v_ref[:].astype(jnp.float32)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(qb * block_q, block_q), :]   # [BQ, 1]
+        delta = delta_ref[pl.ds(qb * block_q, block_q), :]
+        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = jnp.where(_causal_mask(qb, block_q, ki, bk), s, _NEG)
+        p = jnp.exp(s - lse)                          # [BQ, BK]
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                         # [BQ, BK]
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    num_qb = t // block_q
+    start = jnp.int32(0)
+    if causal:
+        # query blocks strictly before this key block never see it:
+        # first visible query is ki*bk -> floor(ki*bk / block_q)
+        start = (ki * bk) // block_q
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, num_qb, body, (dk0, dv0))
+    # dk accumulated against q*scale, so the scale is already applied
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -104,7 +182,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     vf = v.reshape(B * H, T, D)
     kern = functools.partial(_attn_kernel, scale=scale, causal=causal,
                              block_k=bk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kern,
         grid=(B * H, T // bq),
         in_specs=[
@@ -112,11 +190,88 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            # trailing unit dim keeps the block 2-D (TPU tiling rejects
+            # rank-1 blocks)
+            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D), lse.reshape(B, H, T)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    B, H, T, D = q.shape
+    bq = min(block_q, T)
+    bk = min(block_k, T)
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    gf = g.reshape(B * H, T, D)
+    lsef = lse.reshape(B * H, T, 1)
+    # delta = rowsum(dO * O) — O(T*D) elementwise, fine outside the kernel
+    delta = jnp.sum(gf.astype(jnp.float32)
+                    * out.reshape(B * H, T, D).astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, block_k=bk),
+        grid=(B * H, T // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
+        ],
         out_specs=pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(B, H, T, D)
+    )(qf, kf, vf, gf, lsef, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq),
+        grid=(B * H, T // bk),
+        in_specs=[
+            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(kf, vf, qf, gf, lsef, delta)
+
+    return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D),
+            dv.reshape(B, H, T, D))
+
+
+
+def _resolve_defaults(q, scale, interpret):
+    """One place for the default scale / interpreter-mode decision so the
+    forward, fwd-rule, and bwd-rule can never drift apart."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return scale, interpret
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -126,26 +281,25 @@ def flash_attention(q, k, v, causal: bool = False,
     """Fused attention over [B, H, T, D]. ``T`` must divide by the block
     sizes (pack/pad upstream — static shapes are the framework contract).
     ``interpret`` defaults to True off-TPU so the CPU test harness runs the
-    same kernel through the Pallas interpreter."""
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    same kernels through the Pallas interpreter."""
+    scale, interpret = _resolve_defaults(q, scale, interpret)
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    scale, interpret = _resolve_defaults(q, scale, interpret)
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # flash-style rematerialisation: recompute attention under vjp instead of
-    # saving the [T, T] probabilities
-    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal,
-                                                         scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    scale, interpret = _resolve_defaults(q, scale, interpret)
+    return _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
+                           block_k, interpret)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -13,14 +13,13 @@ standard trade-off between the two schemes.
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from .ring import _NEG, wrap_seq_parallel
 

@@ -91,3 +91,39 @@ def test_mha_flash_guards_and_block_pick():
     bad = _rand((1, 67, 16), 5)        # prime-ish length: must be padded
     with pytest.raises(ValueError, match="divisible"):
         flash.init(jax.random.PRNGKey(0), bad)
+
+
+def test_flash_gradients_noncausal_and_vmapped():
+    # non-causal grads vs reference, plus the custom_vjp under vmap
+    q, k, v = (_rand((2, 2, 128, 16), s) for s in range(3))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, False, None, 64, 64, True)
+                       ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, False) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+    # the custom_vjp must batch correctly under vmap (extra leading dim)
+    qb, kb, vb = (jnp.stack([t, t * 0.5]) for t in (q, k, v))
+    gv = jax.vmap(jax.grad(loss_flash))(qb, kb, vb)
+    g0 = jax.grad(loss_flash)(q, k, v)
+    np.testing.assert_allclose(np.asarray(gv[0]), np.asarray(g0),
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_flash_bf16_forward_backward():
+    q, k, v = (_rand((1, 1, 128, 16), s).astype(jnp.bfloat16)
+               for s in range(3))
+    out = flash_attention(q, k, v, True, None, 64, 64, True)
+    assert out.dtype == jnp.bfloat16
+    g = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, True, None, 64, 64, True)
+        .astype(jnp.float32)))(q)
+    assert g.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(g, np.float32)).all()
