@@ -1,6 +1,6 @@
 """Data pipeline: reader combinators + dataset loaders (successor of
 paddle.v2.reader / paddle.v2.dataset / PyDataProvider2)."""
 
-from . import datasets, image
+from . import datasets, image, recordio
 from .reader import (batched, buffered, chain, compose, cycle, firstn,
                      map_readers, prefetch, sharded, shuffle)

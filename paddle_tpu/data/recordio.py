@@ -1,0 +1,134 @@
+"""Record-file data format — the RecordIO role in the reference's cloud
+data path (Go master partitions datasets over RecordIO chunks,
+``go/master/service.go:30,59,253``; wire schema ``proto/DataFormat.proto``).
+
+Format: a stream of ``[u32 length][u32 crc32][payload]`` records plus a JSON
+sidecar index (``path + '.idx'``) holding every record's byte offset. The
+index is what makes the format *shardable*: hosts partition records
+deterministically without reading each other's bytes — the task-queue role
+collapsed into static sharding (see DESIGN_DECISIONS.md, Go-master row).
+
+Payloads are bytes; :func:`write_samples` / :func:`read_samples` layer a
+numpy (npz) codec on top for dict-of-array samples.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+__all__ = ["RecordWriter", "read_records", "write_samples", "read_samples",
+           "sharded_records", "num_records"]
+
+_HEADER = struct.Struct("<II")           # length, crc32
+
+
+class RecordWriter:
+    """Append-only record writer; writes the index sidecar on close."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "wb")
+        self._offsets: List[int] = []
+
+    def write(self, payload: bytes) -> None:
+        self._offsets.append(self._f.tell())
+        self._f.write(_HEADER.pack(len(payload), zlib.crc32(payload)))
+        self._f.write(payload)
+
+    def close(self) -> None:
+        self._f.close()
+        with open(self.path + ".idx", "w") as f:
+            json.dump({"offsets": self._offsets}, f)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            # failed write: close the data file but do NOT publish an index —
+            # a possibly-truncated file must look incomplete, not valid
+            self._f.close()
+            return
+        self.close()
+
+
+def _read_at(f, offset: int) -> bytes:
+    f.seek(offset)
+    head = f.read(_HEADER.size)
+    if len(head) < _HEADER.size:
+        raise IOError("truncated record header")
+    length, crc = _HEADER.unpack(head)
+    payload = f.read(length)
+    if len(payload) < length:
+        raise IOError("truncated record payload")
+    if zlib.crc32(payload) != crc:
+        raise IOError(f"record crc mismatch at offset {offset}")
+    return payload
+
+
+def _offsets(path: str) -> List[int]:
+    with open(path + ".idx") as f:
+        return json.load(f)["offsets"]
+
+
+def num_records(path: str) -> int:
+    return len(_offsets(path))
+
+
+def read_records(path: str) -> Iterator[bytes]:
+    """Sequential CRC-checked record stream."""
+    offs = _offsets(path)
+    with open(path, "rb") as f:
+        for o in offs:
+            yield _read_at(f, o)
+
+
+def sharded_records(path: str, num_shards: int,
+                    shard_id: int) -> Iterator[bytes]:
+    """This shard's records (index-based seek — no scan over other shards'
+    bytes; the Go master's chunk partitioning done statically)."""
+    offs = _offsets(path)
+    with open(path, "rb") as f:
+        for i in range(shard_id, len(offs), num_shards):
+            yield _read_at(f, offs[i])
+
+
+def _encode_sample(sample: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in sample.items()})
+    return buf.getvalue()
+
+
+def _decode_sample(payload: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def write_samples(path: str, samples: Iterable[Dict[str, Any]]) -> int:
+    """Write dict-of-array samples; returns the record count."""
+    n = 0
+    with RecordWriter(path) as w:
+        for s in samples:
+            w.write(_encode_sample(s))
+            n += 1
+    return n
+
+
+def read_samples(path: str, num_shards: int = 1, shard_id: int = 0):
+    """Reader-combinator-style callable yielding dict samples (drop straight
+    into ``data.batched``/``data.map_readers``)."""
+    def reader():
+        it = (read_records(path) if num_shards == 1
+              else sharded_records(path, num_shards, shard_id))
+        for payload in it:
+            yield _decode_sample(payload)
+    reader.num_samples = (num_records(path) + num_shards - 1 - shard_id) \
+        // num_shards if num_shards > 1 else num_records(path)
+    return reader

@@ -1,0 +1,118 @@
+"""Config-driven training CLI — the ``paddle_trainer --config=...`` analog
+(reference: ``trainer/TrainerMain.cpp:17`` + ``utils/Flags.cpp``: the v1
+workflow where a run is fully described by config files, no user code).
+
+    python -m paddle_tpu.train.cli --model_config model.json \
+        --dataset mnist --optimizer adam --num_passes 3 --batch_size 64
+
+The model config is the serialized model IR (``core/config.py`` — produce it
+with ``paddle_tpu.inference.dump_config`` or an exported model directory);
+datasets resolve from ``paddle_tpu.data.datasets`` by name; everything else
+is :class:`~paddle_tpu.utils.flags.TrainerFlags`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import jax
+
+from paddle_tpu import data
+from paddle_tpu.core.config import build_module, config_from_json
+from paddle_tpu.data import datasets as dataset_lib
+from paddle_tpu.nn import costs
+from paddle_tpu.train import Trainer
+from paddle_tpu.train.evaluators import ClassificationError
+from paddle_tpu.utils.flags import TrainerFlags, parse_flags
+
+__all__ = ["TrainCliFlags", "run", "main"]
+
+
+@dataclasses.dataclass
+class TrainCliFlags(TrainerFlags):
+    model_config: str = ""           # IR json file, or an export()ed dir
+    dataset: str = "mnist"           # name in paddle_tpu.data.datasets
+    optimizer: str = "adam"          # name in paddle_tpu.optim
+    loss: str = "softmax_ce"         # softmax_ce | mse
+    trusted_config: bool = False     # allow non-registry classes in the IR
+
+
+def _load_model(path: str, trusted: bool):
+    if os.path.isdir(path):          # an export()/merge_model() directory
+        path = os.path.join(path, "model.json")
+    with open(path) as f:
+        return build_module(config_from_json(f.read()), trusted=trusted)
+
+
+def _make_reader(name: str, batch_size: int, split: str = "train"):
+    maker = getattr(dataset_lib, name)
+    raw = maker(split)
+    sample = next(iter(raw()))
+    if isinstance(sample, tuple) and len(sample) == 2:
+        r = data.map_readers(lambda s: {"x": s[0], "label": s[1]}, raw)
+    else:
+        raise SystemExit(
+            f"dataset {name!r} yields {type(sample)}; the CLI drives "
+            f"(input, label) datasets — write a custom loop for others")
+    return data.batched(r, batch_size)
+
+
+def _make_optimizer(name: str, lr: float):
+    from paddle_tpu import optim
+    maker = getattr(optim, name, None)
+    if maker is None:
+        raise SystemExit(f"unknown optimizer {name!r}")
+    return maker(lr)
+
+
+def _make_loss(name: str):
+    if name == "softmax_ce":
+        return lambda out, b: costs.softmax_cross_entropy(out, b["label"])
+    if name == "mse":
+        return lambda out, b: costs.mse(out, b["label"])
+    raise SystemExit(f"unknown loss {name!r}")
+
+
+def run(flags: TrainCliFlags) -> dict:
+    """Build everything from config and train; returns final pass metrics."""
+    if not flags.model_config:
+        raise SystemExit("--model_config is required")
+    model = _load_model(flags.model_config, flags.trusted_config)
+    reader = _make_reader(flags.dataset, flags.batch_size)
+    trainer = Trainer(
+        model=model,
+        loss_fn=_make_loss(flags.loss),
+        optimizer=_make_optimizer(flags.optimizer, flags.learning_rate),
+        evaluator=ClassificationError() if flags.loss == "softmax_ce"
+        else None,
+        nan_check=flags.nan_check,
+        param_stats_period=flags.param_stats_period or None)
+    trainer.init(jax.random.PRNGKey(flags.seed), next(iter(reader())))
+    last = {}
+
+    def handler(e):
+        from paddle_tpu.train import events as ev
+        if isinstance(e, ev.EndPass):
+            last.update(e.metrics)
+
+    trainer.train(
+        reader, num_passes=flags.num_passes, event_handler=handler,
+        checkpoint_dir=flags.checkpoint_dir or None,
+        checkpoint_keep=flags.checkpoint_keep,
+        saving_period=flags.saving_period or None,
+        log_period=flags.log_period, resume=flags.resume)
+    return last
+
+
+def main(argv: Optional[list] = None) -> None:
+    flags = parse_flags(TrainCliFlags, argv)
+    metrics = run(flags)
+    print(json.dumps({k: (round(v, 6) if isinstance(v, float) else v)
+                      for k, v in metrics.items()}))
+
+
+if __name__ == "__main__":
+    main()

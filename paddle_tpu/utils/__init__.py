@@ -3,4 +3,5 @@ hardening (debug) — the paddle/utils tier."""
 
 from . import debug, flags, stats
 from .flags import TrainerFlags, parse_flags
-from .stats import StatSet, global_stats, profile_trace, timer
+from .stats import (BarrierStat, StatSet, global_stats,
+                    profile_trace, timer)

@@ -17,7 +17,8 @@ from typing import Dict, Optional
 
 import jax
 
-__all__ = ["StatSet", "global_stats", "timer", "profile_trace"]
+__all__ = ["StatSet", "BarrierStat", "global_stats", "timer",
+           "profile_trace"]
 
 
 class _Stat:
@@ -97,3 +98,54 @@ def profile_trace(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+class BarrierStat:
+    """Distributed-imbalance telemetry — the ``BarrierStatSet`` analog
+    (``utils/Stat.h:230``; the reference timed how unevenly trainers arrived
+    at pserver barriers).
+
+    On TPU the "barrier" is every collective: imbalance shows up as the
+    spread of per-host step durations. Each host feeds its local step time
+    into :meth:`update`; :meth:`gather` all-gathers the latest sample across
+    hosts (one tiny psum-style collective, OUTSIDE the hot loop) and returns
+    the spread statistics. Single-process runs report a spread of zero.
+    """
+
+    def __init__(self, name: str = "step_time"):
+        self.name = name
+        self._last: Optional[float] = None
+        self._spreads = _Stat()
+
+    def update(self, seconds: float) -> None:
+        self._last = float(seconds)
+
+    def gather(self) -> Dict[str, float]:
+        """All-gather the latest sample across hosts; returns min/max/mean
+        and relative spread ((max-min)/mean).
+
+        Every host MUST call this the same number of times (SPMD contract);
+        a host with no sample yet contributes a NaN sentinel rather than
+        skipping the collective — an early return here would deadlock the
+        other hosts inside the allgather."""
+        import numpy as np
+        local = np.float32(self._last if self._last is not None else np.nan)
+        if jax.process_count() == 1:
+            times = np.array([local])
+        else:
+            from jax.experimental import multihost_utils
+            times = np.asarray(multihost_utils.process_allgather(local))
+        times = times[np.isfinite(times)]
+        if times.size == 0:
+            return {}
+        mn, mx, mean = float(times.min()), float(times.max()), \
+            float(times.mean())
+        spread = (mx - mn) / mean if mean else 0.0
+        self._spreads.add(spread)
+        return {f"{self.name}_min_s": mn, f"{self.name}_max_s": mx,
+                f"{self.name}_mean_s": mean, f"{self.name}_spread": spread}
+
+    def summary(self) -> Dict[str, float]:
+        s = self._spreads
+        return {"mean_spread": s.total / max(1, s.count),
+                "max_spread": s.max, "samples": s.count}

@@ -179,3 +179,66 @@ def test_image_transforms():
     assert tf(img).shape == (4, 4, 3)
     ev = im.eval_transform((4, 4), (6, 6), mean=[0, 0, 0])
     assert ev(img).shape == (4, 4, 3)
+
+
+# ----------------------------------------------------------------- recordio
+
+def test_recordio_roundtrip_and_crc(tmp_path):
+    from paddle_tpu.data import recordio as rio
+    path = str(tmp_path / "data.rec")
+    samples = [{"x": np.arange(4, dtype=np.float32) * i,
+                "label": np.int32(i % 3)} for i in range(10)]
+    n = rio.write_samples(path, samples)
+    assert n == 10 and rio.num_records(path) == 10
+    got = list(rio.read_samples(path)())
+    assert len(got) == 10
+    np.testing.assert_allclose(got[3]["x"], samples[3]["x"])
+    assert int(got[7]["label"]) == 1
+
+    # corrupt one payload byte -> CRC failure on read
+    offs = rio._offsets(path)
+    with open(path, "r+b") as f:
+        f.seek(offs[5] + 8 + 1)   # past header into payload
+        b = f.read(1)
+        f.seek(offs[5] + 8 + 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    import pytest
+    with pytest.raises(IOError, match="crc"):
+        list(rio.read_records(path))
+
+
+def test_recordio_sharding_disjoint_and_complete(tmp_path):
+    from paddle_tpu.data import recordio as rio
+    path = str(tmp_path / "data.rec")
+    rio.write_samples(path, ({"i": np.int32(i)} for i in range(23)))
+    seen = []
+    for sid in range(4):
+        shard = [int(s["i"]) for s in rio.read_samples(path, 4, sid)()]
+        assert shard == list(range(sid, 23, 4))
+        seen += shard
+    assert sorted(seen) == list(range(23))
+
+
+def test_recordio_feeds_batched_reader(tmp_path):
+    from paddle_tpu import data as d
+    from paddle_tpu.data import recordio as rio
+    path = str(tmp_path / "data.rec")
+    rio.write_samples(path, ({"x": np.full(3, i, np.float32),
+                              "label": np.int32(i)} for i in range(8)))
+    batches = list(d.batched(rio.read_samples(path), 4)())
+    assert len(batches) == 2 and batches[0]["x"].shape == (4, 3)
+
+
+def test_recordio_failed_write_publishes_no_index(tmp_path):
+    from paddle_tpu.data import recordio as rio
+    path = str(tmp_path / "bad.rec")
+
+    def exploding():
+        yield {"x": np.ones(2, np.float32)}
+        raise RuntimeError("source died")
+
+    import pytest
+    with pytest.raises(RuntimeError):
+        rio.write_samples(path, exploding())
+    import os
+    assert not os.path.exists(path + ".idx")   # incomplete file stays index-less

@@ -128,3 +128,34 @@ def test_flags_null_for_required_field_fails_fast(tmp_path):
     cfg.write_text(_json.dumps({"batch_size": None}))
     with pytest.raises(ValueError, match="non-Optional"):
         parse_flags(TrainerFlags, ["--flags_json", str(cfg)])
+
+
+def test_cli_trains_from_config_alone(tmp_path):
+    """The paddle_trainer-style workflow: model IR json + flags, no user
+    code (reference: trainer/TrainerMain.cpp)."""
+    from paddle_tpu.inference import dump_config
+    from paddle_tpu.models import MnistMLP
+    from paddle_tpu.train.cli import TrainCliFlags, run
+
+    cfg = tmp_path / "model.json"
+    cfg.write_text(dump_config(MnistMLP()))
+    flags = parse_flags(TrainCliFlags, [
+        "--model_config", str(cfg), "--dataset", "mnist",
+        "--num_passes", "2", "--batch_size", "64",
+        "--learning_rate", "0.001", "--log_period", "0",
+        "--checkpoint_dir", str(tmp_path / "ckpt")])
+    metrics = run(flags)
+    assert metrics["accuracy"] > 0.9
+    import os
+    assert any(d.startswith("pass-") for d in os.listdir(tmp_path / "ckpt"))
+
+
+def test_barrier_stat_single_process():
+    from paddle_tpu.utils.stats import BarrierStat
+    bs = BarrierStat("step")
+    assert bs.gather() == {}          # no sample yet
+    bs.update(0.25)
+    out = bs.gather()
+    assert out["step_min_s"] == out["step_max_s"] == 0.25
+    assert out["step_spread"] == 0.0
+    assert bs.summary()["samples"] == 1
