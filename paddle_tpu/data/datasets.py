@@ -21,7 +21,8 @@ import numpy as np
 
 __all__ = ["data_home", "mnist", "cifar10", "uci_housing", "imdb", "synthetic_nmt",
            "synthetic_tagging", "synthetic_ctr", "movielens", "conll05",
-           "imikolov", "wmt14", "voc2012", "mq2007", "sentiment", "flowers"]
+           "imikolov", "wmt14", "voc2012", "mq2007", "sentiment", "flowers",
+           "traffic"]
 
 
 def data_home() -> str:
@@ -416,6 +417,40 @@ def flowers(split: str = "train", hw: Tuple[int, int] = (64, 64),
     def reader():
         for i in range(n):
             yield images[i], labels[i]
+    reader.is_synthetic = True
+    reader.num_samples = n
+    return reader
+
+
+def traffic(split: str = "train", term_num: int = 24,
+            forecasting_num: int = 24, n: Optional[int] = None):
+    """Traffic speed-category prediction (reference:
+    ``v1_api_demo/traffic_prediction/`` — encode the last ``term_num``
+    5-minute readings of a road link, predict a 4-class speed category for
+    each of the next ``forecasting_num`` intervals; multi-task heads share
+    the link embedding).
+
+    Synthetic fallback: speeds follow a smooth daily sinusoid + link offset
+    + noise, so the future is genuinely predictable from the recent past.
+    Yields ``(encode [term_num], labels [forecasting_num] in 0..3)``.
+    """
+    n = n or (8192 if split == "train" else 1024)
+
+    def speed_at(phase, t):
+        return 2.0 + 1.5 * np.sin(2 * np.pi * (t + phase) / 288.0)
+
+    def reader():
+        rng = np.random.RandomState(26 if split == "train" else 27)
+        for i in range(n):
+            phase = rng.uniform(0, 288)
+            t0 = rng.uniform(0, 288)
+            ts = t0 + np.arange(term_num + forecasting_num)
+            speeds = speed_at(phase, ts) + rng.normal(0, 0.15,
+                                                      ts.shape)
+            encode = speeds[:term_num].astype(np.float32)
+            future = speeds[term_num:]
+            labels = np.clip(future, 0, 3.999).astype(np.int32)
+            yield encode, labels
     reader.is_synthetic = True
     reader.num_samples = n
     return reader

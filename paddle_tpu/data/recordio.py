@@ -34,6 +34,12 @@ class RecordWriter:
 
     def __init__(self, path: str):
         self.path = path
+        # opening a writer invalidates the file NOW: a stale index from an
+        # earlier write must not outlive the data it described
+        try:
+            os.remove(path + ".idx")
+        except FileNotFoundError:
+            pass
         self._f = open(path, "wb")
         self._offsets: List[int] = []
 

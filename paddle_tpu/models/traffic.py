@@ -1,0 +1,36 @@
+"""Traffic speed-category forecasting (reference:
+``v1_api_demo/traffic_prediction/trainer_config.py`` — a shared link
+embedding feeding FORECASTING_NUM 4-way softmax heads, trained multi-task).
+
+TPU-native: the per-horizon heads are one Linear producing
+``[B, horizons, 4]`` (identical math to separate heads; one MXU matmul
+instead of 24 small ones), with the shared embedding exactly as the
+reference's shared ``_link_vec.w``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn.layers import Linear
+
+__all__ = ["TrafficPredictor"]
+
+
+class TrafficPredictor(Module):
+    def __init__(self, term_num: int = 24, forecasting_num: int = 24,
+                 emb_size: int = 16, num_classes: int = 4,
+                 name="traffic"):
+        super().__init__(name=name)
+        self.forecasting_num = forecasting_num
+        self.num_classes = num_classes
+        # the shared _link_vec.w; tanh is the v1 fc_layer default activation
+        self.link_vec = Linear(emb_size, act="tanh")
+        self.heads = Linear(forecasting_num * num_classes)
+
+    def forward(self, encode, train: bool = False):
+        h = self.link_vec(encode)
+        logits = self.heads(h)
+        return logits.reshape(encode.shape[0], self.forecasting_num,
+                              self.num_classes)

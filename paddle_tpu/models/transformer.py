@@ -1,0 +1,96 @@
+"""Decoder-only transformer LM — the flagship long-context showcase tying
+the modern additions together: multi-head attention with the optional Pallas
+flash path, pre-LN residual blocks, and optional mixture-of-experts FFNs.
+
+The 2017 reference predates transformers entirely (SURVEY §5 records the
+absence of any attention-era machinery) — this model family is a deliberate
+"exceeds" item, built from the same Module/IR system as everything else, so
+it exports, shards (ring/Ulysses for the seq axis, expert axis for MoE), and
+trains under the standard Trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers as I
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn.attention import MultiHeadAttention
+from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm, Linear
+from paddle_tpu.nn.moe import MoEFFN
+
+__all__ = ["TransformerBlock", "TransformerLM"]
+
+
+class TransformerBlock(Module):
+    """Pre-LN block: ``x + MHA(LN(x))`` then ``x + FFN(LN(x))``; the FFN is
+    a dense two-layer gelu MLP or an :class:`MoEFFN` when
+    ``moe_experts > 0``."""
+
+    def __init__(self, dim: int, num_heads: int, ffn_hidden: int,
+                 use_flash: bool = False, moe_experts: int = 0,
+                 dropout: float = 0.0, name=None):
+        super().__init__(name=name)
+        self.ln1 = LayerNorm()
+        self.attn = MultiHeadAttention(num_heads, use_flash=use_flash)
+        self.ln2 = LayerNorm()
+        self.moe_experts = moe_experts
+        if moe_experts > 0:
+            self.ffn = MoEFFN(moe_experts, ffn_hidden)
+        else:
+            self.ffn1 = Linear(ffn_hidden, act="gelu")
+            self.ffn2 = Linear(dim)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x, train: bool = False):
+        h = x + self._maybe_drop(self.attn(self.ln1(x), causal=True), train)
+        z = self.ln2(h)
+        if self.moe_experts > 0:
+            y, aux = self.ffn(z, return_aux=True)
+        else:
+            y = self.ffn2(self.ffn1(z))
+            aux = jnp.zeros((), jnp.float32)
+        return h + self._maybe_drop(y, train), aux
+
+    def _maybe_drop(self, x, train):
+        if self.dropout is not None and train:
+            return self.dropout(x, train=True)
+        return x
+
+
+class TransformerLM(Module):
+    """``ids [B, T] -> logits [B, T, vocab]`` with tied input/output
+    embeddings. ``forward(ids, train, return_aux=True)`` also returns the
+    summed MoE load-balance loss (zero for dense FFNs)."""
+
+    def __init__(self, vocab: int, dim: int = 128, num_layers: int = 2,
+                 num_heads: int = 4, ffn_hidden: int = 256,
+                 max_len: int = 512, use_flash: bool = False,
+                 moe_experts: int = 0, dropout: float = 0.0,
+                 name="transformer_lm"):
+        super().__init__(name=name)
+        self.max_len = max_len
+        self.emb = Embedding(vocab, dim)
+        self.pos = Embedding(max_len, dim,
+                             w_init=I.normal(0.02), name="pos")
+        self.blocks = [TransformerBlock(dim, num_heads, ffn_hidden,
+                                        use_flash, moe_experts, dropout,
+                                        name=f"block{i}")
+                       for i in range(num_layers)]
+        self.ln_f = LayerNorm()
+
+    def forward(self, ids, train: bool = False, return_aux: bool = False):
+        T = ids.shape[1]
+        assert T <= self.max_len, f"T={T} exceeds max_len={self.max_len}"
+        x = self.emb(ids) + self.pos(jnp.arange(T))[None]
+        aux_total = jnp.zeros((), jnp.float32)
+        for blk in self.blocks:
+            x, aux = blk(x, train=train)
+            aux_total = aux_total + aux
+        x = self.ln_f(x)
+        logits = self.emb.attend(x)          # tied softmax weights
+        if return_aux:
+            return logits, aux_total
+        return logits

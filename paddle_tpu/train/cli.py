@@ -78,6 +78,10 @@ def _make_loss(name: str):
 
 def run(flags: TrainCliFlags) -> dict:
     """Build everything from config and train; returns final pass metrics."""
+    import contextlib
+
+    from paddle_tpu.core import dtypes
+
     if not flags.model_config:
         raise SystemExit("--model_config is required")
     model = _load_model(flags.model_config, flags.trusted_config)
@@ -90,7 +94,6 @@ def run(flags: TrainCliFlags) -> dict:
         else None,
         nan_check=flags.nan_check,
         param_stats_period=flags.param_stats_period or None)
-    trainer.init(jax.random.PRNGKey(flags.seed), next(iter(reader())))
     last = {}
 
     def handler(e):
@@ -98,12 +101,16 @@ def run(flags: TrainCliFlags) -> dict:
         if isinstance(e, ev.EndPass):
             last.update(e.metrics)
 
-    trainer.train(
-        reader, num_passes=flags.num_passes, event_handler=handler,
-        checkpoint_dir=flags.checkpoint_dir or None,
-        checkpoint_keep=flags.checkpoint_keep,
-        saving_period=flags.saving_period or None,
-        log_period=flags.log_period, resume=flags.resume)
+    policy = (dtypes.use_policy(dtypes.bfloat16_compute)
+              if flags.use_bf16 else contextlib.nullcontext())
+    with policy:
+        trainer.init(jax.random.PRNGKey(flags.seed), next(iter(reader())))
+        trainer.train(
+            reader, num_passes=flags.num_passes, event_handler=handler,
+            checkpoint_dir=flags.checkpoint_dir or None,
+            checkpoint_keep=flags.checkpoint_keep,
+            saving_period=flags.saving_period or None,
+            log_period=flags.log_period, resume=flags.resume)
     return last
 
 

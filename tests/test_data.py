@@ -242,3 +242,21 @@ def test_recordio_failed_write_publishes_no_index(tmp_path):
         rio.write_samples(path, exploding())
     import os
     assert not os.path.exists(path + ".idx")   # incomplete file stays index-less
+
+
+def test_recordio_rewrite_invalidates_stale_index(tmp_path):
+    from paddle_tpu.data import recordio as rio
+    path = str(tmp_path / "data.rec")
+    rio.write_samples(path, ({"i": np.int32(i)} for i in range(5)))
+    assert rio.num_records(path) == 5
+
+    def exploding():
+        yield {"i": np.int32(0)}
+        raise RuntimeError("die")
+
+    import pytest
+    with pytest.raises(RuntimeError):
+        rio.write_samples(path, exploding())
+    import os
+    # the old index must NOT survive to describe the truncated file
+    assert not os.path.exists(path + ".idx")

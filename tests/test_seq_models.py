@@ -133,3 +133,41 @@ def test_crf_taggers_learn(cls):
     mask = np.arange(12)[None, :] < np.asarray(batch["length"])[:, None]
     acc = (np.asarray(pred) == np.asarray(batch["label"]))[mask].mean()
     assert acc > 0.9, acc
+
+
+def test_traffic_prediction_learns():
+    """The traffic_prediction acceptance demo: multi-horizon speed-category
+    accuracy must clearly beat the majority-class baseline."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import data, optim
+    from paddle_tpu.data import datasets
+    from paddle_tpu.models import TrafficPredictor
+    from paddle_tpu.nn import costs
+    from paddle_tpu.train import Trainer
+
+    reader = data.batched(
+        data.map_readers(lambda s: {"x": s[0], "label": s[1]},
+                         datasets.traffic("train", n=2048)), 64)
+    model = TrafficPredictor()
+
+    def loss_fn(out, b):
+        # multi-task CE: average over the 24 horizons (flatten task dim)
+        B, H, C = out.shape
+        return costs.softmax_cross_entropy(
+            out.reshape(B * H, C), b["label"].reshape(B * H)).reshape(
+            B, H).mean(-1)
+
+    tr = Trainer(model, loss_fn, optim.rmsprop(1e-3))
+    tr.init(jax.random.PRNGKey(0), next(iter(reader())))
+    tr.train(reader, num_passes=6, log_period=0)
+
+    test = list(datasets.traffic("test", n=512)())
+    x = jnp.asarray(np.stack([s[0] for s in test]))
+    y = np.stack([s[1] for s in test])
+    pred = np.argmax(np.asarray(model.apply(
+        {"params": jax.device_get(tr.train_state.params)}, x)), -1)
+    acc = (pred == y).mean()
+    majority = max((y == c).mean() for c in range(4))
+    assert acc > majority + 0.15, (acc, majority)

@@ -1,0 +1,99 @@
+"""TransformerLM showcase: learns a synthetic LM task; flash and MoE
+variants agree with / train like the dense-XLA baseline; exports via the IR."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import optim
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.nn import costs
+
+
+def _lm_batches(vocab=64, B=16, T=32, n_batches=30, seed=0):
+    """First-order Markov stream: each token has 3 likely successors."""
+    g = np.random.RandomState(42)
+    succ = g.randint(0, vocab, size=(vocab, 3))
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_batches):
+        ids = np.zeros((B, T + 1), np.int32)
+        ids[:, 0] = rng.randint(0, vocab, B)
+        for t in range(T):
+            nxt = succ[ids[:, t], rng.randint(0, 3, B)]
+            rand = rng.randint(0, vocab, B)
+            ids[:, t + 1] = np.where(rng.rand(B) < 0.9, nxt, rand)
+        out.append(ids)
+    return out
+
+
+def _train(model, batches, steps=60, lr=3e-3):
+    ids0 = jnp.asarray(batches[0][:, :-1])
+    variables = model.init(jax.random.PRNGKey(0), ids0)
+    opt = optim.adam(lr)
+    opt_state = opt.init(variables["params"])
+
+    @jax.jit
+    def step(p, opt_state, sno, inp, tgt):
+        def loss_fn(p):
+            logits, aux = model.apply({"params": p}, inp, return_aux=True)
+            ce = costs.softmax_cross_entropy(
+                logits.reshape(-1, logits.shape[-1]), tgt.reshape(-1))
+            return jnp.mean(ce) + 0.01 * aux
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        p, opt_state = opt.apply(g, opt_state, p, sno)
+        return loss, p, opt_state
+
+    p = variables["params"]
+    first = last = None
+    for i in range(steps):
+        b = batches[i % len(batches)]
+        inp, tgt = jnp.asarray(b[:, :-1]), jnp.asarray(b[:, 1:])
+        loss, p, opt_state = step(p, opt_state, jnp.asarray(i), inp, tgt)
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    return first, last, p
+
+
+def test_transformer_lm_learns():
+    model = TransformerLM(vocab=64, dim=64, num_layers=2, num_heads=4,
+                          ffn_hidden=128, max_len=64)
+    first, last, _ = _train(model, _lm_batches())
+    # Markov structure: a learning LM must get well below the ~log(64)=4.16
+    # uniform floor and clearly below its starting loss
+    assert last < 0.6 * first, (first, last)
+    assert last < 3.0
+
+
+def test_transformer_flash_path_matches_dense():
+    batches = _lm_batches(T=64)
+    dense = TransformerLM(vocab=64, dim=64, num_layers=1, num_heads=2,
+                          ffn_hidden=64, max_len=64, use_flash=False)
+    flash = TransformerLM(vocab=64, dim=64, num_layers=1, num_heads=2,
+                          ffn_hidden=64, max_len=64, use_flash=True)
+    ids = jnp.asarray(batches[0][:, :-1])
+    variables = dense.init(jax.random.PRNGKey(0), ids)
+    y1 = dense.apply(variables, ids)
+    y2 = flash.apply(variables, ids)      # same params, pallas kernel
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_transformer_moe_variant_trains():
+    model = TransformerLM(vocab=64, dim=64, num_layers=2, num_heads=4,
+                          ffn_hidden=64, max_len=64, moe_experts=4)
+    first, last, _ = _train(model, _lm_batches(), steps=60)
+    assert last < 0.7 * first, (first, last)
+
+
+def test_transformer_ir_roundtrip():
+    from paddle_tpu.core.config import (build_module, config_from_json,
+                                        config_to_json, module_config)
+    m = TransformerLM(vocab=32, dim=32, num_layers=1, num_heads=2,
+                      ffn_hidden=32, max_len=16)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 32, (2, 16)))
+    v = m.init(jax.random.PRNGKey(0), ids)
+    m2 = build_module(config_from_json(config_to_json(module_config(m))))
+    np.testing.assert_allclose(np.asarray(m.apply(v, ids)),
+                               np.asarray(m2.apply(v, ids)), rtol=1e-5)
