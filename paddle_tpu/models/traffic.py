@@ -10,8 +10,6 @@ reference's shared ``_link_vec.w``.
 
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from paddle_tpu.core.module import Module
 from paddle_tpu.nn.layers import Linear
 
@@ -23,6 +21,7 @@ class TrafficPredictor(Module):
                  emb_size: int = 16, num_classes: int = 4,
                  name="traffic"):
         super().__init__(name=name)
+        self.term_num = term_num
         self.forecasting_num = forecasting_num
         self.num_classes = num_classes
         # the shared _link_vec.w; tanh is the v1 fc_layer default activation
@@ -30,6 +29,8 @@ class TrafficPredictor(Module):
         self.heads = Linear(forecasting_num * num_classes)
 
     def forward(self, encode, train: bool = False):
+        assert encode.shape[1] == self.term_num, \
+            f"expected {self.term_num} readings, got {encode.shape[1]}"
         h = self.link_vec(encode)
         logits = self.heads(h)
         return logits.reshape(encode.shape[0], self.forecasting_num,

@@ -11,8 +11,6 @@ trains under the standard Trainer.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax.numpy as jnp
 
 from paddle_tpu.core import initializers as I

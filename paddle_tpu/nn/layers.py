@@ -37,7 +37,10 @@ __all__ = [
     "MixedLayer", "FullMatrixProjection", "TableProjection", "IdentityProjection",
     "DotMulProjection", "ContextProjection", "CrossMapNormal", "RowConv",
     "Conv3D", "Conv3DTranspose", "Pool3D", "SelectiveFC", "SamplingId",
-    "ScaleSubRegion",
+    "ScaleSubRegion", "Power", "Scaling", "DotProd", "ConvexCombination",
+    "CosSimVecMat", "BilinearInterp", "EosIdCheck", "PRelu",
+    "ScalingProjection", "SliceProjection", "TransposedFullMatrixProjection",
+    "SwitchOrder", "MaxPoolWithMask",
 ]
 
 Pair = Union[int, Tuple[int, int]]
@@ -267,9 +270,9 @@ class BatchNorm(Module):
         axes = tuple(range(x.ndim - 1))
         mean_s = self.state("mean", I.zeros, (c,))
         var_s = self.state("var", I.ones, (c,))
-        # Statistics and normalization in float32 regardless of the compute
-        # policy (bf16 batch moments are too coarse); output returns to the
-        # activation dtype so the surrounding convs stay on the bf16 MXU path.
+        # Moment statistics in float32 regardless of the compute policy
+        # (bf16 batch moments are too coarse); the normalization itself runs
+        # in the activation dtype — see below.
         xf = x.astype(jnp.float32)
         if train:
             mean = jnp.mean(xf, axis=axes)
@@ -982,3 +985,192 @@ class ScaleSubRegion(Module):
         wm = (ww >= idx[:, 4:5] - 1) & (ww <= idx[:, 5:6] - 1)   # [B, W]
         mask = hm[:, :, None, None] & wm[:, None, :, None] & cm[:, None, None, :]
         return jnp.where(mask, x * self.value, x)
+
+
+class Power(Module):
+    """Per-sample power: ``y[b] = x[b] ** w[b]`` with the exponent coming
+    from another layer (reference: ``PowerLayer.cpp`` — two inputs, scalar
+    exponent per sample)."""
+
+    def forward(self, exponent, x):
+        e = exponent.reshape(exponent.shape[0], *([1] * (x.ndim - 1)))
+        return jnp.power(x, e)
+
+
+class Scaling(Module):
+    """Per-sample scaling: ``y[b] = w[b] * x[b]`` with the scale from
+    another layer (reference: ``ScalingLayer.cpp``)."""
+
+    def forward(self, weight, x):
+        w = weight.reshape(weight.shape[0], *([1] * (x.ndim - 1)))
+        return w * x
+
+
+class DotProd(Module):
+    """Row-wise dot product of two inputs -> [B, 1] (reference:
+    ``DotProdLayer.cpp``)."""
+
+    def forward(self, a, b):
+        return jnp.sum(a * b, axis=-1, keepdims=True)
+
+
+class ConvexCombination(Module):
+    """Weighted sum of K stacked rows: weights [B, K], data [B, K, D] (or
+    flat [B, K*D]) -> [B, D] (reference: ``ConvexCombinationLayer`` in
+    ``LinearChainCRF``-era naming, a.k.a. ``linear_comb_layer``)."""
+
+    def __init__(self, size: Optional[int] = None, name=None):
+        super().__init__(name=name)
+        self.size = size
+
+    def forward(self, weights, data):
+        B, K = weights.shape
+        if data.ndim == 2:
+            data = data.reshape(B, K, -1)
+        return jnp.einsum("bk,bkd->bd", weights, data)
+
+
+class CosSimVecMat(Module):
+    """Cosine similarity of a vector against each of K stacked rows:
+    vec [B, D], mat [B, K, D] (or flat [B, K*D]) -> [B, K] (reference:
+    ``CosSimVecMatLayer.cpp``)."""
+
+    def __init__(self, scale: float = 1.0, name=None):
+        super().__init__(name=name)
+        self.scale = scale
+
+    def forward(self, vec, mat):
+        B = vec.shape[0]
+        if mat.ndim == 2:
+            mat = mat.reshape(B, -1, vec.shape[-1])
+        num = jnp.einsum("bd,bkd->bk", vec, mat)
+        den = (jnp.linalg.norm(vec, axis=-1, keepdims=True)
+               * jnp.linalg.norm(mat, axis=-1) + 1e-12)
+        return self.scale * num / den
+
+
+class BilinearInterp(Module):
+    """Bilinear up/down-sampling of NHWC feature maps (reference:
+    ``BilinearInterpLayer.cpp``). Deviation: uses half-pixel sampling
+    (``jax.image.resize``) rather than the reference's align-corners
+    ratios — border pixels differ slightly from the legacy layer."""
+
+    def __init__(self, out_h: int, out_w: int, name=None):
+        super().__init__(name=name)
+        self.out_h = out_h
+        self.out_w = out_w
+
+    def forward(self, x):
+        B, H, W, C = x.shape
+        return jax.image.resize(x, (B, self.out_h, self.out_w, C),
+                                method="bilinear")
+
+
+class EosIdCheck(Module):
+    """1 where the id equals ``eos_id`` (reference: ``EosIdCheckLayer.cpp``
+    — the stop signal inside generation groups)."""
+
+    def __init__(self, eos_id: int, name=None):
+        super().__init__(name=name)
+        self.eos_id = eos_id
+
+    def forward(self, ids):
+        return (ids == self.eos_id).astype(jnp.float32)
+
+
+class PRelu(Module):
+    """Parametric ReLU with learned negative slope (reference:
+    ``ParameterReluLayer.cpp``; ``partial_sum`` groups channels sharing one
+    slope — ``channels`` slopes here, 1 = fully shared)."""
+
+    def __init__(self, channels: int = 1, init_slope: float = 0.25,
+                 name=None):
+        super().__init__(name=name)
+        self.channels = channels
+        self.init_slope = init_slope
+
+    def forward(self, x):
+        a = self.param("a", I.constant(self.init_slope), (self.channels,))
+        if self.channels > 1:
+            assert x.shape[-1] % self.channels == 0
+            a = jnp.repeat(a, x.shape[-1] // self.channels)
+        return jnp.where(x >= 0, x, a * x)
+
+
+class ScalingProjection(Module):
+    """One learned scalar times the input (reference:
+    ``ScalingProjection.cpp``)."""
+
+    def forward(self, x):
+        w = self.param("w", I.ones, (1,))
+        return w * x
+
+
+class SliceProjection(Module):
+    """Column slice [start, end) of the input (reference:
+    ``SliceProjection.cpp``)."""
+
+    def __init__(self, start: int, end: int, name=None):
+        super().__init__(name=name)
+        self.start = start
+        self.end = end
+
+    def forward(self, x):
+        return x[..., self.start:self.end]
+
+
+class TransposedFullMatrixProjection(Module):
+    """``y = x @ W.T`` (reference: ``TransposedFullMatrixProjection.cpp`` —
+    weight shared transposed with another projection)."""
+
+    def __init__(self, features: int, w_init=I.fan_in_uniform, name=None):
+        super().__init__(name=name)
+        self.features = features
+        self.w_init = w_init
+
+    def forward(self, x):
+        w = self.param("w", self.w_init, (self.features, x.shape[-1]))
+        return x @ w.T
+
+
+class SwitchOrder(Module):
+    """NCHW <-> NHWC layout switch (reference: function-layer ``SwitchOp``
+    / ``SwitchOrderLayer.cpp``). The package is NHWC-native; this exists
+    for interop at data boundaries."""
+
+    def __init__(self, to: str = "NHWC", name=None):
+        super().__init__(name=name)
+        assert to in ("NHWC", "NCHW")
+        self.to = to
+
+    def forward(self, x):
+        if self.to == "NHWC":
+            return jnp.transpose(x, (0, 2, 3, 1))
+        return jnp.transpose(x, (0, 3, 1, 2))
+
+
+class MaxPoolWithMask(Module):
+    """Max pooling that also returns the argmax mask (reference:
+    ``MaxPoolWithMaskLayer.cpp`` — the mask holds each output's flat input
+    index, consumed by unpooling). Non-overlapping windows
+    (stride == window), NHWC; mask indices are flat over (H, W) per channel,
+    matching the reference's row-major convention."""
+
+    def __init__(self, window: int, name=None):
+        super().__init__(name=name)
+        self.window = window
+
+    def forward(self, x):
+        B, H, W, C = x.shape
+        w = self.window
+        assert H % w == 0 and W % w == 0, "window must tile the input"
+        Ho, Wo = H // w, W // w
+        t = x.reshape(B, Ho, w, Wo, w, C)
+        t = jnp.moveaxis(t, 2, 3).reshape(B, Ho, Wo, w * w, C)
+        pooled = jnp.max(t, axis=3)
+        local = jnp.argmax(t, axis=3).astype(jnp.int32)   # [B,Ho,Wo,C]
+        # local window index -> flat (H, W) input index
+        ly, lx = local // w, local % w
+        gy = jnp.arange(Ho)[None, :, None, None] * w + ly
+        gx = jnp.arange(Wo)[None, None, :, None] * w + lx
+        return pooled, gy * W + gx

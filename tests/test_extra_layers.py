@@ -251,3 +251,101 @@ def test_merge_model_and_dump_config(tmp_path):
                                rtol=1e-6)
     cfg = json.loads(dump_config(m))
     assert cfg["modules"] and "root" in cfg
+
+
+# ----------------------------------------------------- final small layers
+
+def test_small_elementwise_layers_vs_oracle():
+    from paddle_tpu.nn.layers import (BilinearInterp, ConvexCombination,
+                                      CosSimVecMat, DotProd, EosIdCheck,
+                                      Power, PRelu, Scaling,
+                                      ScalingProjection, SliceProjection,
+                                      SwitchOrder,
+                                      TransposedFullMatrixProjection)
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(3, 5)).astype(np.float32)
+    w = rng.uniform(0.5, 2.0, size=(3,)).astype(np.float32)
+
+    got = Power().apply({}, jnp.asarray(w), jnp.asarray(np.abs(x)))
+    np.testing.assert_allclose(np.asarray(got), np.abs(x) ** w[:, None],
+                               rtol=1e-5)
+    got = Scaling().apply({}, jnp.asarray(w), jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), w[:, None] * x, rtol=1e-6)
+    y = rng.normal(size=(3, 5)).astype(np.float32)
+    got = DotProd().apply({}, jnp.asarray(x), jnp.asarray(y))
+    np.testing.assert_allclose(np.asarray(got), (x * y).sum(-1, keepdims=True),
+                               rtol=1e-5)
+
+    wk = rng.uniform(size=(3, 4)).astype(np.float32)
+    mat = rng.normal(size=(3, 4, 5)).astype(np.float32)
+    got = ConvexCombination().apply({}, jnp.asarray(wk), jnp.asarray(mat))
+    np.testing.assert_allclose(np.asarray(got),
+                               np.einsum("bk,bkd->bd", wk, mat), rtol=1e-5)
+    # flat input form
+    got2 = ConvexCombination().apply({}, jnp.asarray(wk),
+                                     jnp.asarray(mat.reshape(3, 20)))
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(got), rtol=1e-6)
+
+    got = CosSimVecMat().apply({}, jnp.asarray(x), jnp.asarray(mat))
+    want = np.einsum("bd,bkd->bk", x, mat) / (
+        np.linalg.norm(x, axis=-1, keepdims=True)
+        * np.linalg.norm(mat, axis=-1) + 1e-12)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4)
+
+    ids = jnp.asarray([[1, 2, 3], [3, 0, 3]])
+    got = EosIdCheck(eos_id=3).apply({}, ids)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  [[0, 0, 1], [1, 0, 1]])
+
+    m = PRelu(channels=1, init_slope=0.1)
+    p = m.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    got = np.asarray(m.apply(p, jnp.asarray(x)))
+    np.testing.assert_allclose(got, np.where(x >= 0, x, 0.1 * x), rtol=1e-5)
+
+    sp = ScalingProjection()
+    p = sp.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(sp.apply(p, jnp.asarray(x))), x,
+                               rtol=1e-6)        # init scale = 1
+
+    np.testing.assert_allclose(
+        np.asarray(SliceProjection(1, 4).apply({}, jnp.asarray(x))),
+        x[:, 1:4], rtol=1e-6)
+
+    tp = TransposedFullMatrixProjection(7)
+    p = tp.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    wmat = np.asarray(jax.tree_util.tree_leaves(p["params"])[0])
+    np.testing.assert_allclose(np.asarray(tp.apply(p, jnp.asarray(x))),
+                               x @ wmat.T, rtol=1e-5)
+
+    nchw = rng.normal(size=(2, 3, 4, 5)).astype(np.float32)
+    nhwc = np.asarray(SwitchOrder("NHWC").apply({}, jnp.asarray(nchw)))
+    np.testing.assert_allclose(nhwc, nchw.transpose(0, 2, 3, 1))
+    back = np.asarray(SwitchOrder("NCHW").apply({}, jnp.asarray(nhwc)))
+    np.testing.assert_allclose(back, nchw)
+
+
+def test_bilinear_interp_shapes_and_identity():
+    from paddle_tpu.nn.layers import BilinearInterp
+    x = jnp.asarray(np.random.RandomState(0).normal(
+        size=(2, 4, 6, 3)).astype(np.float32))
+    up = BilinearInterp(8, 12).apply({}, x)
+    assert up.shape == (2, 8, 12, 3)
+    same = BilinearInterp(4, 6).apply({}, x)
+    np.testing.assert_allclose(np.asarray(same), np.asarray(x), atol=1e-6)
+
+
+def test_max_pool_with_mask():
+    from paddle_tpu.nn.layers import MaxPoolWithMask
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(2, 4, 4, 3)).astype(np.float32)
+    pooled, mask = MaxPoolWithMask(window=2).apply({}, jnp.asarray(x))
+    assert pooled.shape == (2, 2, 2, 3) and mask.shape == (2, 2, 2, 3)
+    p, m = np.asarray(pooled), np.asarray(mask)
+    for b in range(2):
+        for i in range(2):
+            for j in range(2):
+                for c in range(3):
+                    win = x[b, 2*i:2*i+2, 2*j:2*j+2, c]
+                    assert p[b, i, j, c] == win.max()
+                    fy, fx = divmod(int(m[b, i, j, c]), 4)
+                    assert x[b, fy, fx, c] == win.max()
