@@ -1121,15 +1121,23 @@ class SliceProjection(Module):
 
 class TransposedFullMatrixProjection(Module):
     """``y = x @ W.T`` (reference: ``TransposedFullMatrixProjection.cpp`` —
-    weight shared transposed with another projection)."""
+    weight shared transposed with another projection). The weight is stored
+    ``(features, in)`` so it can be shared with a forward projection; the
+    init scales by the true fan-in (``in``, shape[1]) — the generic
+    fan-in initializer would read shape[0]."""
 
-    def __init__(self, features: int, w_init=I.fan_in_uniform, name=None):
+    def __init__(self, features: int, name=None):
         super().__init__(name=name)
         self.features = features
-        self.w_init = w_init
 
     def forward(self, x):
-        w = self.param("w", self.w_init, (self.features, x.shape[-1]))
+        fan_in = x.shape[-1]
+
+        def init_t(rng, shape, dtype=jnp.float32):
+            bound = 1.0 / np.sqrt(fan_in)
+            return jax.random.uniform(rng, shape, dtype, -bound, bound)
+
+        w = self.param("w", init_t, (self.features, fan_in))
         return x @ w.T
 
 

@@ -6,4 +6,4 @@ from .optimizers import (EMA, Optimizer, adadelta, adagrad, adam, adamax,
                          apply_updates, chain, clip_by_global_norm,
                          clip_by_value, decayed_adagrad, ftrl, global_norm,
                          l1_decay, lamb, momentum, polyak_average, rmsprop,
-                         sgd, weight_decay)
+                         sgd, static_pruning, weight_decay)

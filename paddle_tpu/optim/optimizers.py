@@ -26,7 +26,8 @@ from . import schedules as S
 __all__ = [
     "Optimizer", "sgd", "momentum", "adagrad", "decayed_adagrad", "adadelta",
     "rmsprop", "adam", "adamax", "ftrl", "lamb", "chain", "clip_by_global_norm",
-    "clip_by_value", "weight_decay", "l1_decay", "polyak_average", "apply_updates",
+    "clip_by_value", "weight_decay", "l1_decay", "polyak_average",
+    "static_pruning", "apply_updates",
     "global_norm",
 ]
 
@@ -408,3 +409,49 @@ class EMA:
         d = self.decay
         return tmap(lambda a, p: d * a + (1 - d) * p.astype(jnp.float32),
                     avg, params)
+
+
+def static_pruning(inner: Optimizer, sparsity: float) -> Optimizer:
+    """Static magnitude pruning around an optimizer (reference:
+    ``StaticPruningHook``, ``parameter/ParameterUpdaterHook.cpp:39-61`` —
+    a fixed sparsity mask chosen by |weight| magnitude, applied so pruned
+    weights stay exactly zero through training).
+
+    The mask is computed once from the params seen at ``init`` (per-tensor
+    magnitude threshold at the given sparsity) and stored in the optimizer
+    state; every update is masked and the first update also zeroes the
+    pruned weights themselves (mask*param - param correction).
+    """
+    assert 0.0 <= sparsity < 1.0
+
+    class St(NamedTuple):
+        inner: PyTree
+        mask: PyTree
+        first: jax.Array
+
+    def make_mask(p):
+        flat = jnp.abs(p.astype(jnp.float32)).ravel()
+        k = int(sparsity * flat.size)
+        if k == 0:
+            return jnp.ones_like(p, jnp.float32)
+        # rank-based (argsort of argsort): exactly k entries pruned even
+        # under ties — a magnitude threshold would wipe a whole
+        # zero-initialized tensor (every bias) instead of the k requested
+        rank = jnp.argsort(jnp.argsort(flat))
+        return (rank >= k).astype(jnp.float32).reshape(p.shape)
+
+    def init(params):
+        return St(inner.init(params), tmap(make_mask, params),
+                  jnp.asarray(True))
+
+    def update(grads, st, params, step):
+        grads = tmap(lambda g, m: g * m.astype(g.dtype), grads, st.mask)
+        upd, new_inner = inner.update(grads, st.inner, params, step)
+        upd = tmap(lambda u, m: u * m.astype(u.dtype), upd, st.mask)
+        # on the first step also snap pruned weights to exactly zero
+        upd = tmap(lambda u, p, m: jnp.where(
+            st.first & (m < 0.5), -p.astype(u.dtype), u),
+            upd, params, st.mask)
+        return upd, St(new_inner, st.mask, jnp.asarray(False))
+
+    return Optimizer(init, update)

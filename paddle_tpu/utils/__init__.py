@@ -1,7 +1,8 @@
 """Utilities: timers/profiling (stats), flag/config system (flags), numeric
 hardening (debug) — the paddle/utils tier."""
 
-from . import debug, flags, stats
+from . import debug, flags, gradcheck, stats
 from .flags import TrainerFlags, parse_flags
+from .gradcheck import check_gradients
 from .stats import (BarrierStat, StatSet, global_stats,
                     profile_trace, timer)
