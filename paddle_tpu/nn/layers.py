@@ -1126,18 +1126,20 @@ class TransposedFullMatrixProjection(Module):
     init scales by the true fan-in (``in``, shape[1]) — the generic
     fan-in initializer would read shape[0]."""
 
-    def __init__(self, features: int, name=None):
+    def __init__(self, features: int, w_init=None, name=None):
         super().__init__(name=name)
         self.features = features
+        self.w_init = w_init
 
     def forward(self, x):
         fan_in = x.shape[-1]
 
-        def init_t(rng, shape, dtype=jnp.float32):
+        def default_init(rng, shape, dtype=jnp.float32):
             bound = 1.0 / np.sqrt(fan_in)
             return jax.random.uniform(rng, shape, dtype, -bound, bound)
 
-        w = self.param("w", init_t, (self.features, fan_in))
+        w = self.param("w", self.w_init or default_init,
+                       (self.features, fan_in))
         return x @ w.T
 
 
