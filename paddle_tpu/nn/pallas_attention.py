@@ -7,9 +7,14 @@ Reference-lineage note: the 2017 reference has no attention kernel at all
 (SURVEY §5 long-context row — this is one of the deliberate "exceeds" items);
 its closest machinery is the RNN-era ``ContextProjection``. The algorithm is
 the public flash-attention recipe; the kernels follow the Pallas TPU playbook
-(`/opt/skills/guides/pallas_guide.md`): 2-D grid over (batch*heads, row
-blocks), the streamed operand resident in VMEM, ``fori_loop`` over the other
-axis' blocks.
+(`/opt/skills/guides/pallas_guide.md`).
+
+Structure: 3-D grids ``(batch*heads, row blocks, streamed blocks)`` with the
+online-softmax state carried in VMEM scratch across the innermost grid axis
+(sequential on TPU) — so VMEM holds only one q/k/v BLOCK at a time and the
+kernels scale to arbitrary T (a full-K/V-resident design caps out around
+T=8k on a 16 MB-VMEM chip). Causal upper-triangle blocks are skipped with
+``pl.when`` (no FLOPs; the grid step still retires).
 
 Training is fully blockwise: the forward saves only O and the per-row
 log-sum-exp L; the backward runs two Pallas kernels (dq over query blocks;
@@ -29,6 +34,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention", "reference_attention"]
 
@@ -55,150 +61,190 @@ def _causal_mask(qi, bq, kb, bk):
     return k_idx <= q_idx
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                 block_k):
-    # q_ref: [BQ, D]; k_ref/v_ref: [T, D]; o_ref: [BQ, D]; lse_ref: [BQ]
-    bq, d = q_ref.shape
-    t = k_ref.shape[0]
-    qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
+def _block_needed(qi, bq, ki, bk, causal):
+    """Whether key block ki intersects the causal cone of query block qi."""
+    if not causal:
+        return True
+    return ki * bk <= (qi + 1) * bq - 1
 
-    def body(kb, carry):
-        m, l, acc = carry
-        ks = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        vs = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                 scale, causal):
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nkb = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_s[:] = jnp.full((bq, 1), _NEG, jnp.float32)
+        l_s[:] = jnp.zeros((bq, 1), jnp.float32)
+        acc_s[:] = jnp.zeros((bq, d), jnp.float32)
+
+    @pl.when(_block_needed(qi, bq, ki, bk, causal))
+    def _():
+        q = q_ref[:].astype(jnp.float32) * scale
+        ks = k_ref[:].astype(jnp.float32)
+        vs = v_ref[:].astype(jnp.float32)
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            s = jnp.where(_causal_mask(qi, bq, kb, block_k), s, _NEG)
+            s = jnp.where(_causal_mask(qi, bq, ki, bk), s, _NEG)
+        m = m_s[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + jax.lax.dot_general(
+        l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
             p, vs, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_s[:] = m_new
 
-    m0 = jnp.full((bq, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    num_kb = t // block_k
-    if causal:
-        # key blocks strictly after this query block never contribute:
-        # highest visible key is (qi+1)*bq - 1 -> ceil((qi+1)*bq / block_k)
-        num_kb = jnp.minimum(num_kb,
-                             ((qi + 1) * bq + block_k - 1) // block_k)
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[:] = (acc / l).astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l)
+    @pl.when(ki == nkb - 1)
+    def _():
+        l = jnp.maximum(l_s[:], 1e-30)
+        o_ref[:] = (acc_s[:] / l).astype(o_ref.dtype)
+        lse_ref[:] = m_s[:] + jnp.log(l)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, block_k):
-    # per-query-block dq: loop over key blocks, rebuilding P = exp(s - lse)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_s, *, scale, causal):
     bq, d = q_ref.shape
-    t = k_ref.shape[0]
+    bk = k_ref.shape[0]
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
-    do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]                                 # [BQ, 1]
-    delta = delta_ref[:]                             # [BQ, 1]
+    ki = pl.program_id(2)
+    nkb = pl.num_programs(2)
 
-    def body(kb, dq):
-        ks = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        vs = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _():
+        dq_s[:] = jnp.zeros((bq, d), jnp.float32)
+
+    @pl.when(_block_needed(qi, bq, ki, bk, causal))
+    def _():
+        q = q_ref[:].astype(jnp.float32) * scale
+        ks = k_ref[:].astype(jnp.float32)
+        vs = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:]
+        delta = delta_ref[:]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            s = jnp.where(_causal_mask(qi, bq, kb, block_k), s, _NEG)
-        p = jnp.exp(s - lse)                         # [BQ, BK]
+            s = jnp.where(_causal_mask(qi, bq, ki, bk), s, _NEG)
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(ds, ks, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        dq_s[:] = dq_s[:] + jax.lax.dot_general(
+            ds, ks, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    num_kb = t // block_k
-    if causal:
-        num_kb = jnp.minimum(num_kb,
-                             ((qi + 1) * bq + block_k - 1) // block_k)
-    dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(ki == nkb - 1)
+    def _():
+        dq_ref[:] = (dq_s[:] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, block_q):
-    # per-key-block dk/dv: loop over query blocks
+                dk_ref, dv_ref, dk_s, dv_s, *, scale, causal):
     bk, d = k_ref.shape
-    t = q_ref.shape[0]
+    bq = q_ref.shape[0]
     ki = pl.program_id(1)
-    ks = k_ref[:].astype(jnp.float32)
-    vs = v_ref[:].astype(jnp.float32)
+    qi = pl.program_id(2)
+    nqb = pl.num_programs(2)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(qb * block_q, block_q), :]   # [BQ, 1]
-        delta = delta_ref[pl.ds(qb * block_q, block_q), :]
+    @pl.when(qi == 0)
+    def _():
+        dk_s[:] = jnp.zeros((bk, d), jnp.float32)
+        dv_s[:] = jnp.zeros((bk, d), jnp.float32)
+
+    @pl.when(_block_needed(qi, bq, ki, bk, causal))
+    def _():
+        ks = k_ref[:].astype(jnp.float32)
+        vs = v_ref[:].astype(jnp.float32)
+        q = q_ref[:].astype(jnp.float32) * scale
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:]
+        delta = delta_ref[:]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            s = jnp.where(_causal_mask(qb, block_q, ki, bk), s, _NEG)
-        p = jnp.exp(s - lse)                          # [BQ, BK]
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+            s = jnp.where(_causal_mask(qi, bq, ki, bk), s, _NEG)
+        p = jnp.exp(s - lse)
+        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)                         # [BQ, BK]
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+        ds = p * (dp - delta)
+        # accumulated against q*scale, so the scale is already applied
+        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    num_qb = t // block_q
-    start = jnp.int32(0)
-    if causal:
-        # query blocks strictly before this key block never see it:
-        # first visible query is ki*bk -> floor(ki*bk / block_q)
-        start = (ki * bk) // block_q
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, num_qb, body, (dk0, dv0))
-    # dk accumulated against q*scale, so the scale is already applied
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nqb - 1)
+    def _():
+        dk_ref[:] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
-    B, H, T, D = q.shape
+def _blocks(block_q, block_k, T):
     bq = min(block_q, T)
     bk = min(block_k, T)
     assert T % bq == 0 and T % bk == 0, \
         f"seq len {T} must be a multiple of block sizes ({bq}, {bk})"
+    return bq, bk
+
+
+def _kv_index_map(causal, bq, bk):
+    """K/V block index map for q-major kernels. Under causal masking the
+    skipped upper-triangle steps clamp to the row's last needed key block,
+    so the pipeline re-references the resident block instead of fetching
+    one that pl.when will discard (skipping FLOPs alone still paid the
+    DMA)."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+    return lambda b, i, j: (b, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0)
+
+
+def _q_index_map(causal, bq, bk):
+    """Q-side map for the key-major dk/dv kernel: clamp the skipped
+    before-the-diagonal steps up to the first query block that sees this
+    key block."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+    return lambda b, i, j: (b, jnp.maximum(j, (i * bk) // bq), 0)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, H, T, D = q.shape
+    bq, bk = _blocks(block_q, block_k, T)
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, T, D)
     vf = v.reshape(B * H, T, D)
-    kern = functools.partial(_attn_kernel, scale=scale, causal=causal,
-                             block_k=bk)
+    kvmap = _kv_index_map(causal, bq, bk)
     out, lse = pl.pallas_call(
-        kern,
-        grid=(B * H, T // bq),
+        functools.partial(_attn_kernel, scale=scale, causal=causal),
+        grid=(B * H, T // bq, T // bk),
         in_specs=[
-            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), kvmap),
+            pl.BlockSpec((None, bk, D), kvmap),
         ],
         out_specs=[
-            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
             # trailing unit dim keeps the block 2-D (TPU tiling rejects
             # rank-1 blocks)
-            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -208,8 +254,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
                     interpret):
     B, H, T, D = q.shape
-    bq = min(block_q, T)
-    bk = min(block_k, T)
+    bq, bk = _blocks(block_q, block_k, T)
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, T, D)
     vf = v.reshape(B * H, T, D)
@@ -220,48 +265,51 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
                     * out.reshape(B * H, T, D).astype(jnp.float32),
                     axis=-1, keepdims=True)
 
+    kvmap = _kv_index_map(causal, bq, bk)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, block_k=bk),
-        grid=(B * H, T // bq),
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        grid=(B * H, T // bq, T // bk),
         in_specs=[
-            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), kvmap),
+            pl.BlockSpec((None, bk, D), kvmap),
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, gf, lsef, delta)
 
+    qmap = _q_index_map(causal, bq, bk)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq),
-        grid=(B * H, T // bk),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        grid=(B * H, T // bk, T // bq),
         in_specs=[
-            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, T, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, T, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, D), qmap),
+            pl.BlockSpec((None, bq, D), qmap),
+            pl.BlockSpec((None, bq, 1), qmap),
+            pl.BlockSpec((None, bq, 1), qmap),
         ],
         out_specs=[
-            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
         ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
         interpret=interpret,
     )(kf, vf, qf, gf, lsef, delta)
 
     return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D),
             dv.reshape(B, H, T, D))
-
 
 
 def _resolve_defaults(q, scale, interpret):
