@@ -30,7 +30,7 @@ from .train.checkpoint import (_flatten, _unflatten, atomic_dir,
                                verify_manifest, write_manifest)
 
 __all__ = ["export", "load_inference_model", "InferenceModel", "infer",
-           "merge_model", "dump_config"]
+           "merge_model", "dump_config", "model_diagram"]
 
 _MODEL_FILE = "model.json"
 _VARS_FILE = "variables.npz"
@@ -120,3 +120,40 @@ def dump_config(model, indent: int = 2) -> str:
     import json
     from paddle_tpu.core.config import module_config
     return json.dumps(module_config(model), indent=indent, sort_keys=True)
+
+
+def model_diagram(model) -> str:
+    """Graphviz dot text of the module containment tree (reference:
+    ``python/paddle/utils/make_model_diagram.py`` — rendered the layer graph
+    from a model config). Render with ``dot -Tpng``."""
+    from paddle_tpu.core.config import _is_module
+
+    lines = ["digraph model {", "  rankdir=TB;",
+             '  node [shape=box, fontname="monospace"];']
+    counter = [0]
+    seen = {}         # id(module) -> node idx: shared instances render once
+
+    def visit(mod, attr_name):
+        if id(mod) in seen:
+            return seen[id(mod)]
+        idx = counter[0]
+        counter[0] += 1
+        seen[id(mod)] = idx
+        cls = type(mod).__name__
+        label = f"{attr_name}: {cls}" if attr_name else cls
+        lines.append(f'  n{idx} [label="{label}"];')
+        for name, val in sorted(vars(mod).items()):
+            children = []
+            if _is_module(val):
+                children = [(name, val)]
+            elif isinstance(val, (list, tuple)):
+                children = [(f"{name}[{i}]", v) for i, v in enumerate(val)
+                            if _is_module(v)]
+            for cname, child in children:
+                cidx = visit(child, cname)
+                lines.append(f"  n{idx} -> n{cidx};")
+        return idx
+
+    visit(model, "")
+    lines.append("}")
+    return "\n".join(lines)

@@ -1,0 +1,78 @@
+"""Framework interop — the ``torch2paddle.py`` converter analog
+(reference: ``python/paddle/utils/torch2paddle.py``, which imported Lua
+torch checkpoints into v1 parameter files).
+
+Here the migration source is PyTorch: :func:`from_torch_state_dict` maps a
+``torch.nn`` state dict onto a paddle_tpu params pytree, handling the layout
+conventions that differ:
+
+  - ``nn.Linear``: torch stores ``weight [out, in]``; our Linear is
+    ``w [in, out]`` → transpose.
+  - ``nn.Conv2d``: torch is OIHW; our Conv2D kernels are HWIO → permute.
+  - ``nn.BatchNorm2d``: weight/bias -> scale/shift params; running stats ->
+    the ``state`` collection.
+
+The mapping is name-based: pass ``rules`` as ``(torch_prefix,
+paddle_tpu_path)`` pairs; each rule moves one module's tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["from_torch_state_dict", "torch_tensor_to_numpy"]
+
+
+def torch_tensor_to_numpy(t) -> np.ndarray:
+    """Detach/ cpu / numpy, without importing torch at module scope."""
+    return np.asarray(t.detach().cpu().numpy())
+
+
+def _set_path(tree: Dict[str, Any], path: str, value: np.ndarray):
+    parts = path.split("/")
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def from_torch_state_dict(state_dict,
+                          rules: Sequence[Tuple[str, str]],
+                          kinds: Dict[str, str]) -> Dict[str, Any]:
+    """Convert a torch state dict to ``{"params": ..., "state": ...}``.
+
+    ``rules`` maps torch module prefixes to paddle_tpu module paths (e.g.
+    ``("fc1", "MnistMLP_0/Linear_0")``); ``kinds[torch_prefix]`` names the
+    layer type: ``"linear"`` | ``"conv2d"`` | ``"batchnorm"``. Unknown
+    prefixes in the state dict are ignored (convert what you map — the
+    reference converter worked the same way).
+    """
+    params: Dict[str, Any] = {}
+    state: Dict[str, Any] = {}
+    for torch_prefix, pt_path in rules:
+        kind = kinds[torch_prefix]
+        get = lambda suffix: torch_tensor_to_numpy(
+            state_dict[f"{torch_prefix}.{suffix}"])
+        if kind == "linear":
+            _set_path(params, f"{pt_path}/w", get("weight").T)
+            if f"{torch_prefix}.bias" in state_dict:
+                _set_path(params, f"{pt_path}/b", get("bias"))
+        elif kind == "conv2d":
+            # OIHW -> HWIO
+            _set_path(params, f"{pt_path}/w",
+                      get("weight").transpose(2, 3, 1, 0))
+            if f"{torch_prefix}.bias" in state_dict:
+                _set_path(params, f"{pt_path}/b", get("bias"))
+        elif kind == "batchnorm":
+            # affine=False BatchNorms have no weight/bias (mirror our
+            # BatchNorm(use_scale_shift=False))
+            if f"{torch_prefix}.weight" in state_dict:
+                _set_path(params, f"{pt_path}/scale", get("weight"))
+                _set_path(params, f"{pt_path}/shift", get("bias"))
+            _set_path(state, f"{pt_path}/mean", get("running_mean"))
+            _set_path(state, f"{pt_path}/var", get("running_var"))
+        else:
+            raise ValueError(f"unknown layer kind {kind!r}")
+    return {"params": params, "state": state}

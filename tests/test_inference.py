@@ -137,3 +137,92 @@ def test_infer_convenience(tmp_path, rng):
     export(path, model, variables)
     out = infer(path, x)
     assert out.shape == (2, 4)
+
+
+def test_model_diagram_dot_output():
+    from paddle_tpu.inference import model_diagram
+    from paddle_tpu.models import MnistMLP
+    dot = model_diagram(MnistMLP())
+    assert dot.startswith("digraph model {") and dot.endswith("}")
+    assert "Linear" in dot and "->" in dot
+
+
+def test_from_torch_state_dict_roundtrip():
+    """torch2paddle analog: a torch MLP's weights produce identical outputs
+    through the converted paddle_tpu model."""
+    import numpy as np
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    import jax.numpy as jnp
+    from paddle_tpu.core.module import Module
+    from paddle_tpu.nn.layers import Linear
+    from paddle_tpu.utils.interop import from_torch_state_dict
+
+    tmodel = tnn.Sequential(tnn.Linear(8, 16), tnn.ReLU(), tnn.Linear(16, 4))
+    tmodel.eval()
+
+    class Mlp(Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = Linear(16, act="relu")
+            self.fc2 = Linear(4)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    m = Mlp()
+    import jax
+    v = m.init(jax.random.PRNGKey(0), jnp.ones((1, 8)))
+    root = next(iter(v["params"]))
+    conv = from_torch_state_dict(
+        tmodel.state_dict(),
+        rules=[("0", f"{root}/fc1"), ("2", f"{root}/fc2")],
+        kinds={"0": "linear", "2": "linear"})
+
+    x = np.random.RandomState(0).normal(size=(3, 8)).astype(np.float32)
+    with torch.no_grad():
+        want = tmodel(torch.from_numpy(x)).numpy()
+    got = np.asarray(m.apply({"params": conv["params"]}, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_from_torch_conv_and_bn():
+    import numpy as np
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.module import Module
+    from paddle_tpu.nn.layers import BatchNorm, Conv2D
+    from paddle_tpu.utils.interop import from_torch_state_dict
+
+    tconv = tnn.Conv2d(3, 5, 3, padding=1)
+    tbn = tnn.BatchNorm2d(5)
+    tbn.running_mean.normal_(); tbn.running_var.uniform_(0.5, 2.0)
+    tmodel = tnn.Sequential(tconv, tbn).eval()
+
+    class Net(Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = Conv2D(5, kernel=3, padding="SAME")
+            self.bn = BatchNorm()
+
+        def forward(self, x, train=False):
+            return self.bn(self.conv(x), train=train)
+
+    m = Net()
+    v = m.init(jax.random.PRNGKey(0), jnp.ones((1, 6, 6, 3)))
+    root = next(iter(v["params"]))
+    conv = from_torch_state_dict(
+        tmodel.state_dict(),
+        rules=[("0", f"{root}/conv"), ("1", f"{root}/bn")],
+        kinds={"0": "conv2d", "1": "batchnorm"})
+
+    x = np.random.RandomState(1).normal(size=(2, 6, 6, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(m.apply(
+        {"params": conv["params"], "state": conv["state"]},
+        jnp.asarray(x)))
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want,
+                               rtol=1e-4, atol=1e-5)
