@@ -1,0 +1,81 @@
+"""Standalone conv fwd/bwd efficiency at ResNet-50 shapes (v5e, bf16).
+
+Separates "XLA convs are slow at these shapes" from "our fusion structure
+hurts" — each conv is timed alone (fwd, and grad wrt both operands).
+"""
+
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PEAK = 197e12
+
+SHAPES = [
+    # (N, H, W, Cin, KH, KW, Cout, stride)
+    (128, 224, 224, 3, 7, 7, 64, 2),      # stem
+    (128, 56, 56, 64, 1, 1, 256, 1),      # bottleneck expand
+    (128, 56, 56, 256, 1, 1, 64, 1),      # bottleneck reduce
+    (128, 56, 56, 64, 3, 3, 64, 1),       # bottleneck 3x3
+    (128, 56, 56, 256, 1, 1, 512, 2),     # stage2 shortcut
+    (128, 28, 28, 128, 3, 3, 128, 1),
+    (128, 28, 28, 512, 1, 1, 128, 1),
+    (128, 14, 14, 256, 3, 3, 256, 1),
+    (128, 14, 14, 1024, 1, 1, 256, 1),
+    (128, 7, 7, 512, 3, 3, 512, 1),
+    (128, 7, 7, 2048, 1, 1, 512, 1),
+]
+
+
+def bench_one(n, h, w, cin, kh, kw, cout, stride, iters=30):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(size=(n, h, w, cin)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(kh, kw, cin, cout)), jnp.bfloat16)
+
+    def conv(x, k):
+        return lax.conv_general_dilated(
+            x, k, window_strides=(stride, stride), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    fwd = jax.jit(conv)
+
+    @jax.jit
+    def bwd(x, k):
+        def f(x, k):
+            return jnp.sum(conv(x, k).astype(jnp.float32))
+        return jax.grad(f, argnums=(0, 1))(x, k)
+
+    out = fwd(x, k)
+    ho, wo = out.shape[1], out.shape[2]
+    flops = 2 * n * ho * wo * kh * kw * cin * cout
+
+    def timeit(fn, fence):
+        o = fn()
+        fence(o)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o = fn()
+        fence(o)
+        return (time.perf_counter() - t0) / iters
+
+    # Fence via host transfer: on the remote-TPU plugin block_until_ready can
+    # report buffers ready before execution completes (see bench.py).
+    tf = timeit(lambda: fwd(x, k), lambda o: float(o[0, 0, 0, 0]))
+    tb = timeit(lambda: bwd(x, k), lambda o: float(o[0][0, 0, 0, 0]))
+    return flops, tf, tb
+
+
+def main():
+    print(f"{'shape':44s} {'fwd ms':>8s} {'fwd%':>6s} {'bwd ms':>8s} {'bwd%':>6s}")
+    for s in SHAPES:
+        flops, tf, tb = bench_one(*s)
+        name = f"{s[0]}x{s[1]}x{s[2]}x{s[3]} k{s[4]}x{s[5]} -> {s[6]} s{s[7]}"
+        print(f"{name:44s} {tf*1e3:8.3f} {flops/tf/PEAK*100:6.1f} "
+              f"{tb*1e3:8.3f} {2*flops/tb/PEAK*100:6.1f}")
+
+
+if __name__ == "__main__":
+    main()

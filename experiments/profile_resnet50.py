@@ -1,0 +1,153 @@
+"""ResNet-50 step profiling + ablations (round-3 MFU work).
+
+Measures where the non-MXU time goes in the flagship bench step:
+  - full train step (fwd+bwd+momentum, donation, bf16 policy) [the bench path]
+  - value_and_grad only (no optimizer)
+  - forward only (train=True, BN batch stats)
+  - forward only (train=False, running stats)
+and captures a jax.profiler trace of the full step, plus XLA's own
+cost analysis (FLOPs / bytes) of the compiled executable.
+
+NOTE: the dtype policy is consulted at *trace* time, so every first call of a
+jitted function must happen inside ``use_policy(bfloat16_compute)``.
+
+Usage: PYTHONPATH=.:$PYTHONPATH python experiments/profile_resnet50.py --trace
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, fence, warmup=3, iters=20):
+    for _ in range(warmup):
+        out = fn()
+    fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    fence(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", action="store_true")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--ablate", action="store_true",
+                    help="also time grad-only / fwd-only variants")
+    args = ap.parse_args()
+
+    from paddle_tpu import optim
+    from paddle_tpu.core.dtypes import bfloat16_compute, use_policy
+    from paddle_tpu.models import resnet50
+    from paddle_tpu.nn import costs
+    from paddle_tpu.train import Trainer
+
+    trainer = Trainer(
+        model=resnet50(num_classes=1000),
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
+        optimizer=optim.momentum(0.1, 0.9))
+    rng = np.random.RandomState(0)
+    host_batch = {
+        "x": rng.normal(size=(args.batch, 224, 224, 3)).astype(np.float32),
+        "label": rng.randint(0, 1000, size=args.batch).astype(np.int32),
+    }
+    results = {"batch": args.batch, "device": jax.devices()[0].device_kind}
+
+    with use_policy(bfloat16_compute):
+        trainer.init(jax.random.PRNGKey(0), host_batch)
+        trainer._build_train_step()
+        model, loss_fn = trainer.model, trainer.loss_fn
+        ts = trainer.train_state
+        batch = trainer._shard(host_batch)
+        key = jax.random.PRNGKey(1)
+
+        # --- full step (bench path, donation) --------------------------------
+        def run_steps(n, p, st, os_, step):
+            for _ in range(n):
+                p, st, os_, step, loss, stats = trainer._train_step(
+                    p, st, os_, step, batch, key)
+            return p, st, os_, step, loss
+
+        p, st, os_, step, loss = run_steps(
+            3, ts.params, ts.state, ts.opt_state, ts.step)
+        float(loss)
+        t0 = time.perf_counter()
+        p, st, os_, step, loss = run_steps(args.iters, p, st, os_, step)
+        float(loss)
+        results["full_step_ms"] = round(
+            (time.perf_counter() - t0) / args.iters * 1e3, 2)
+
+        if args.ablate:
+            p0, st0 = ts.params, ts.state  # donated away? donate invalidates
+            # Re-init small trees for the ablations (params were donated).
+            trainer2 = Trainer(
+                model=model,
+                loss_fn=loss_fn,
+                optimizer=optim.momentum(0.1, 0.9), donate=False)
+            trainer2.init(jax.random.PRNGKey(0), host_batch)
+            p2, st2 = trainer2.train_state.params, trainer2.train_state.state
+
+            @jax.jit
+            def grad_only(p, st, batch, rng):
+                def compute_loss(pp):
+                    out, new = model.apply(
+                        {"params": pp, "state": st}, batch["x"], train=True,
+                        mutable=("state",), rngs={"dropout": rng})
+                    return jnp.mean(loss_fn(out, batch))
+                loss, g = jax.value_and_grad(compute_loss)(p)
+                return loss, g
+
+            results["grad_only_ms"] = round(timeit(
+                lambda: grad_only(p2, st2, batch, key),
+                lambda o: float(o[0]), iters=args.iters), 2)
+
+            @jax.jit
+            def fwd_train(p, st, batch, rng):
+                out, new = model.apply({"params": p, "state": st}, batch["x"],
+                                       train=True, mutable=("state",),
+                                       rngs={"dropout": rng})
+                return jnp.mean(loss_fn(out, batch))
+            results["fwd_train_ms"] = round(timeit(
+                lambda: fwd_train(p2, st2, batch, key), lambda o: float(o),
+                iters=args.iters), 2)
+
+            @jax.jit
+            def fwd_infer(p, st, batch):
+                out = model.apply({"params": p, "state": st}, batch["x"])
+                return jnp.mean(loss_fn(out, batch))
+            results["fwd_infer_ms"] = round(timeit(
+                lambda: fwd_infer(p2, st2, batch), lambda o: float(o),
+                iters=args.iters), 2)
+
+        # --- XLA cost analysis ------------------------------------------------
+        try:
+            lowered = trainer._train_step.lower(p, st, os_, step, batch, key)
+            compiled = lowered.compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0]
+            results["xla_flops"] = float(ca.get("flops", -1))
+            results["xla_bytes_accessed"] = float(ca.get("bytes accessed", -1))
+        except Exception as e:  # noqa
+            results["cost_analysis_error"] = repr(e)
+
+        # --- trace ------------------------------------------------------------
+        if args.trace:
+            tracedir = "experiments/trace_resnet50"
+            with jax.profiler.trace(tracedir):
+                p, st, os_, step, loss = run_steps(5, p, st, os_, step)
+                float(loss)
+            results["trace_dir"] = tracedir
+
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Summarize a jax.profiler trace: per-op-category and top-op device time.
+
+Usage: python experiments/trace_summary.py <tracedir> [n_steps]
+"""
+
+import collections
+import glob
+import gzip
+import json
+import re
+import sys
+
+
+def main():
+    tracedir = sys.argv[1]
+    nsteps = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    paths = sorted(glob.glob(f"{tracedir}/plugins/profile/*/*.trace.json.gz"))
+    path = paths[-1]
+    with gzip.open(path) as f:
+        data = json.load(f)
+    pids = {e["pid"]: e["args"].get("name")
+            for e in data["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    dev_pid = next(p for p, n in pids.items() if "TPU" in (n or ""))
+    events = [e for e in data["traceEvents"]
+              if e.get("ph") == "X" and e.get("pid") == dev_pid
+              and not e["name"].startswith("jit_")
+              and not re.fullmatch(r"\d+", e["name"])]
+    cat = collections.Counter()
+    flops = collections.Counter()
+    total = 0.0
+    for e in events:
+        a = e.get("args") or {}
+        c = a.get("hlo_category", "?")
+        cat[c] += e["dur"]
+        total += e["dur"]
+        flops[c] += int(a.get("model_flops", 0) or 0)
+    print(f"[{path}]")
+    print(f"per-step device total: {total/nsteps/1e3:.2f} ms")
+    for n, d in cat.most_common(12):
+        print(f"{d/nsteps/1e3:9.2f} ms/step  {100*d/total:5.1f}%  "
+              f"flops={flops[n]/nsteps/1e9:8.1f}G  {n}")
+    print()
+    agg = collections.defaultdict(float)
+    names = {}
+    for e in events:
+        a = e.get("args") or {}
+        agg[e["name"]] += e["dur"]
+        names[e["name"]] = (a.get("long_name") or "")[:150]
+    for n, d in sorted(agg.items(), key=lambda kv: -kv[1])[:30]:
+        print(f"{d/nsteps/1e3:8.2f} ms/step {n[:36]:36s} {names[n][:110]}")
+
+
+if __name__ == "__main__":
+    main()

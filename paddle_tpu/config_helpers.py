@@ -20,6 +20,7 @@ Example::
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
@@ -35,7 +36,7 @@ __all__ = [
     "addto_layer", "cos_sim", "pooling_layer", "last_seq", "first_seq",
     "simple_rnn", "lstmemory", "grumemory", "bidirectional_lstm",
     "simple_img_conv_pool", "build_network", "NetworkModule", "LayerOut",
-    "reset_graph",
+    "reset_graph", "graph_scope",
 ]
 
 
@@ -70,19 +71,51 @@ def _graph_of(inputs: Sequence[LayerOut]) -> _Graph:
     return inputs[0].graph
 
 
-_current: List[_Graph] = []
+_tls = __import__("threading").local()
+
+
+def _stack() -> List[_Graph]:
+    # Thread-local: concurrent config builders (e.g. tests) don't share the
+    # implicit graph.
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
 
 
 def _ensure_graph() -> _Graph:
-    if not _current:
-        _current.append(_Graph())
-    return _current[-1]
+    stack = _stack()
+    if not stack:
+        stack.append(_Graph())
+    return stack[-1]
 
 
 def reset_graph() -> None:
     """Drop any in-progress config graph (for abandoned scripts / REPLs;
     ``build_network`` resets automatically)."""
-    _current.clear()
+    _stack().clear()
+
+
+@contextlib.contextmanager
+def graph_scope():
+    """Isolated config-graph scope: pushes a fresh implicit graph and always
+    pops it, so a script that raises mid-build cannot leak half-built nodes
+    into the next ``data_layer()`` call (the failure mode of the module-level
+    implicit graph). Use around any config script whose exceptions you
+    catch::
+
+        with config_helpers.graph_scope():
+            net = build_my_network()
+    """
+    stack = _stack()
+    g = _Graph()
+    stack.append(g)
+    try:
+        yield g
+    finally:
+        # Remove this scope's graph wherever it is (build_network may have
+        # already consumed it).
+        if g in stack:
+            stack.remove(g)
 
 
 def data_layer(name: str) -> LayerOut:
@@ -285,9 +318,14 @@ def build_network(*outputs: LayerOut, name: str = "network") -> NetworkModule:
     for o in outputs:
         if o.graph is not g:
             raise ValueError("outputs from different graphs")
-    # reset unconditionally so an earlier abandoned/failed script can't leak
-    # its graph into the next one
-    _current.clear()
+    # Remove the consumed graph; under graph_scope outer scopes survive, and
+    # an abandoned implicit graph below this one is dropped too so it can't
+    # leak into the next script.
+    stack = _stack()
+    if g in stack:
+        del stack[stack.index(g):]   # g and any abandoned graphs above it
+    else:
+        stack.clear()
     mods = [n[0] for n in g.nodes]
     edges = [n[1] for n in g.nodes]
     names = [n[2] for n in g.nodes]

@@ -65,11 +65,22 @@ def verify_manifest(d: str, verify_crc: bool = True) -> Dict[str, Any]:
         manifest = json.load(f)
     # Early manifests keyed entries by collection name ('params') rather than
     # filename ('params.npz'); normalise so both generations load.
-    manifest["files"] = {
-        (f if os.path.exists(os.path.join(d, f))
-         or not os.path.exists(os.path.join(d, f + ".npz"))
-         else f + ".npz"): info
-        for f, info in manifest["files"].items()}
+    normalized: Dict[str, Any] = {}
+    for f, info in manifest["files"].items():
+        if os.path.exists(os.path.join(d, f)):
+            resolved = f
+        elif os.path.exists(os.path.join(d, f + ".npz")):
+            resolved = f + ".npz"
+        else:
+            raise IOError(
+                f"checkpoint {d} is missing file for manifest entry {f!r} "
+                f"(neither {f!r} nor {f + '.npz'!r} exists)")
+        if resolved in normalized:
+            raise IOError(
+                f"checkpoint {d}: manifest entries collide on {resolved!r} "
+                f"after legacy-name normalisation")
+        normalized[resolved] = info
+    manifest["files"] = normalized
     if verify_crc:
         for fname, info in manifest["files"].items():
             if _file_crc(os.path.join(d, fname)) != info["crc32"]:

@@ -377,14 +377,19 @@ def _edit_distance_matrix(gold: np.ndarray, gold_len: np.ndarray,
     return D
 
 
-def _backtrace_counts(D: np.ndarray, n: int, m: int):
+def _backtrace_counts(D: np.ndarray, n: int, m: int,
+                      gold: np.ndarray, hyp: np.ndarray):
     """(substitutions, deletions, insertions) following the reference's
     tie-break order: match > substitution > deletion > insertion
-    (``CTCErrorEvaluator.cpp`` ``stringAlignment`` backtrace)."""
+    (``CTCErrorEvaluator.cpp`` ``stringAlignment`` backtrace). The match
+    branch additionally requires the characters to be equal: a zero-cost
+    diagonal tie with ``gold[i-1] != hyp[j-1]`` is NOT a match (it is
+    reachable via a different path) and must fall through, or the
+    sub/del/ins breakdown shifts relative to the reference."""
     i, j = n, m
     sub = dele = ins = 0
     while i and j:
-        if D[i, j] == D[i - 1, j - 1]:
+        if D[i, j] == D[i - 1, j - 1] and gold[i - 1] == hyp[j - 1]:
             i -= 1
             j -= 1
         elif D[i, j] == D[i - 1, j - 1] + 1:
@@ -463,7 +468,8 @@ class CtcErrorEvaluator(Evaluator):
             elif m == 0:
                 sub, dele, ins = 0, n, 0
             else:
-                sub, dele, ins = _backtrace_counts(D[b], n, m)
+                sub, dele, ins = _backtrace_counts(D[b], n, m,
+                                                   labels[b], hyp[b])
             dist = sub + dele + ins
             max_len = max(1, n, m)
             self._score += dist / max_len

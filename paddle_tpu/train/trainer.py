@@ -38,6 +38,20 @@ from . import events as ev
 __all__ = ["Trainer", "TrainState"]
 
 
+def _batch_fingerprint(host_batch) -> int:
+    """CRC32 of a host batch's raw bytes — recorded in mid-pass checkpoints
+    so a resume can detect a nondeterministic reader (a shuffled/buffered
+    reader replayed from scratch yields a different batch at the same
+    index, silently training on a different remainder otherwise)."""
+    import zlib
+    crc = 0
+    leaves = jax.tree_util.tree_leaves(host_batch)
+    for leaf in leaves:
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        crc = zlib.crc32(arr.tobytes(), crc)
+    return crc
+
+
 class TrainState:
     """The complete training pytree: params, module state, optimizer state, step."""
 
@@ -272,7 +286,23 @@ class Trainer:
             costs = []
             for batch_id, host_batch in enumerate(reader()):
                 if pass_id == start_pass and batch_id < skip_batches:
-                    continue          # deterministic replay skip on resume
+                    # Deterministic replay skip on resume. On the last
+                    # skipped batch, compare against the fingerprint the
+                    # checkpoint recorded for it — a mismatch means the
+                    # reader is not deterministic and the resumed pass
+                    # would train on a different batch remainder.
+                    if batch_id == skip_batches - 1:
+                        want = (self._last_iter_state or {}).get("batch_crc")
+                        if want is not None and \
+                                _batch_fingerprint(host_batch) != int(want):
+                            _log.warning(
+                                "resume: reader replay diverged from the "
+                                "checkpointed batch fingerprint at batch %d "
+                                "— the reader is nondeterministic (shuffle/"
+                                "buffered?); the resumed pass trains on a "
+                                "different batch remainder than the "
+                                "interrupted run", batch_id)
+                    continue
                 handler(ev.BeginIteration(pass_id, batch_id))
                 with self.stats.time("shard_batch"):
                     batch = self._shard(host_batch)
@@ -311,7 +341,8 @@ class Trainer:
                         checkpoint_dir, pass_id,
                         {**self.train_state.as_dict(),
                          "iter": {"pass": pass_id, "next_batch": batch_id + 1,
-                                  "completed": 0}},
+                                  "completed": 0,
+                                  "batch_crc": _batch_fingerprint(host_batch)}},
                         keep_last=checkpoint_keep)
                 handler(ev.EndIteration(pass_id, batch_id, int(step), cost,
                                         metrics))

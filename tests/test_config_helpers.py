@@ -139,3 +139,26 @@ def test_surplus_inputs_rejected():
     import pytest
     with pytest.raises(ValueError, match="surplus"):
         net.apply(p, x, x)
+
+
+def test_graph_scope_isolates_failures():
+    """An exception inside graph_scope must not leak half-built nodes into
+    the next config script (ADVICE r2)."""
+    import pytest
+    with pytest.raises(RuntimeError):
+        with H.graph_scope():
+            H.data_layer("junk")
+            raise RuntimeError("config script blew up")
+    a = H.data_layer("x")
+    net = H.build_network(H.fc_layer(a, size=2))
+    assert sum(m is None for m in net.modules) == 1
+
+
+def test_graph_scope_nested_outer_survives():
+    outer = H.data_layer("x")
+    with H.graph_scope():
+        b = H.data_layer("inner")
+        inner_net = H.build_network(H.fc_layer(b, size=2))
+    net = H.build_network(H.fc_layer(outer, size=3))
+    assert sum(m is None for m in inner_net.modules) == 1
+    assert sum(m is None for m in net.modules) == 1

@@ -140,7 +140,7 @@ def _lev_oracle(a, b):
                           D[i - 1][j - 1] + c)
     i, j, sub, dele, ins = n, m, 0, 0, 0
     while i and j:
-        if D[i][j] == D[i - 1][j - 1]:
+        if D[i][j] == D[i - 1][j - 1] and a[i - 1] == b[j - 1]:
             i, j = i - 1, j - 1
         elif D[i][j] == D[i - 1][j - 1] + 1:
             sub, i, j = sub + 1, i - 1, j - 1
@@ -198,6 +198,29 @@ def test_ctc_error_vs_oracle(seed):
     assert abs(res["deletion_error"] - del_t / B) < 1e-9
     assert abs(res["insertion_error"] - ins_t / B) < 1e-9
     assert abs(res["sequence_error"] - seq_err / B) < 1e-9
+
+
+def test_ctc_backtrace_tie_break_checks_chars():
+    """A zero-cost diagonal tie with unequal chars must NOT count as a
+    match (ADVICE r2): gold [0,2,1,0,2] vs hyp [2,1,0,1,1] is distance 3 =
+    1 sub + 1 del + 1 ins; the unchecked-diagonal backtrace reports 3 subs."""
+    from paddle_tpu.train.evaluators import _backtrace_counts
+    gold = np.array([0, 2, 1, 0, 2])
+    hyp = np.array([2, 1, 0, 1, 1])
+    D = _edit_matrix_oracle(gold, hyp)
+    assert _backtrace_counts(D, 5, 5, gold, hyp) == (1, 1, 1)
+
+
+def _edit_matrix_oracle(a, b):
+    n, m = len(a), len(b)
+    D = np.zeros((n + 1, m + 1), np.int32)
+    D[:, 0] = np.arange(n + 1)
+    D[0, :] = np.arange(m + 1)
+    for i in range(1, n + 1):
+        for j in range(1, m + 1):
+            c = 0 if a[i - 1] == b[j - 1] else 1
+            D[i, j] = min(D[i - 1, j] + 1, D[i, j - 1] + 1, D[i - 1, j - 1] + c)
+    return D
 
 
 def test_ctc_perfect_prediction_zero_error():

@@ -161,6 +161,70 @@ def test_checkpoint_loads_collection_keyed_manifest(tmp_path):
     np.testing.assert_allclose(out["params"]["w"], np.arange(4.0))
 
 
+def test_manifest_missing_entry_and_collision_errors(tmp_path):
+    """A manifest entry whose file is gone must raise a clear integrity
+    error naming the entry, and legacy-name normalisation must not silently
+    merge colliding keys (ADVICE r2)."""
+    import json
+    import os
+    from paddle_tpu.train import checkpoint as ckpt
+    tree = {"params": {"w": np.arange(4.0)}, "step": np.asarray(1)}
+    ckpt.save_checkpoint(str(tmp_path), 0, tree)
+    d = ckpt.pass_dir(str(tmp_path), 0)
+    with open(os.path.join(d, "manifest.json")) as f:
+        man = json.load(f)
+    # missing file
+    man2 = dict(man)
+    man2["files"] = {**man["files"], "ghost": {"crc32": 0}}
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump(man2, f)
+    with pytest.raises(IOError, match="ghost"):
+        ckpt.verify_manifest(d)
+    # collision: 'params' and 'params.npz' both normalise to params.npz
+    man3 = dict(man)
+    man3["files"] = {**man["files"], "params": {"crc32": 0}}
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump(man3, f)
+    with pytest.raises(IOError, match="collide"):
+        ckpt.verify_manifest(d)
+
+
+def test_resume_warns_on_nondeterministic_reader(tmp_path, caplog):
+    """Mid-pass resume replays the reader; if the replayed batch at the
+    checkpointed position differs from the recorded fingerprint the trainer
+    must warn instead of silently training on a different remainder."""
+    import logging
+    rng_batches = []
+
+    def shuffled_reader(seed=[0]):
+        def r():
+            rng = np.random.RandomState(seed[0])
+            seed[0] += 1          # different every replay => nondeterministic
+            for _ in range(4):
+                x = rng.normal(size=(32, 784)).astype(np.float32)
+                y = rng.randint(0, 10, 32).astype(np.int32)
+                yield {"x": x, "label": y}
+        return r
+
+    reader = shuffled_reader()
+    tr = make_trainer()
+    tr.init(jax.random.PRNGKey(0), next(iter(reader())))
+    tr.train(reader, num_passes=1, checkpoint_dir=str(tmp_path),
+             saving_period=2, log_period=0)
+    # wipe the completed-pass marker so resume enters mid-pass replay
+    ckpt.save_checkpoint(
+        str(tmp_path), 0,
+        {**tr.train_state.as_dict(),
+         "iter": {"pass": 0, "next_batch": 2, "completed": 0,
+                  "batch_crc": 12345}})   # fingerprint that cannot match
+    tr2 = make_trainer()
+    tr2.init(jax.random.PRNGKey(1), next(iter(reader())))
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.trainer"):
+        tr2.train(reader, num_passes=1, checkpoint_dir=str(tmp_path),
+                  resume=True, log_period=0)
+    assert any("nondeterministic" in r.message for r in caplog.records)
+
+
 def test_kill_resume_reproduces_uninterrupted_run(tmp_path):
     """Mid-pass checkpoint + resume: interrupting training and resuming from
     the saved iterator position must reproduce the uninterrupted run's final
