@@ -20,7 +20,7 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
 
 
-def build(batch_size):
+def build(batch_size, stem="conv7", barrier=False):
     from paddle_tpu import optim
     from paddle_tpu.core.dtypes import bfloat16_compute, use_policy
     from paddle_tpu.core import mesh as mesh_lib
@@ -29,8 +29,17 @@ def build(batch_size):
     from paddle_tpu.optim.optimizers import apply_updates
     from paddle_tpu.train import Trainer
 
+    if barrier:
+        # experiment: stop XLA from fusing BN stat reductions into convs
+        from paddle_tpu.models import resnet as resnet_mod
+
+        def barrier_forward(self, x, train=False):
+            y = jax.lax.optimization_barrier(self.conv(x))
+            return self.act(self.bn(y, train=train))
+        resnet_mod.ConvBN.forward = barrier_forward
+
     trainer = Trainer(
-        model=resnet50(num_classes=1000),
+        model=resnet50(num_classes=1000, stem=stem),
         loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
         optimizer=optim.momentum(0.1, 0.9))
     rng = np.random.RandomState(0)
@@ -77,11 +86,19 @@ def build(batch_size):
 
 
 def main():
+    import argparse
     from paddle_tpu.core.dtypes import bfloat16_compute, use_policy
 
-    out = {}
-    for bs in (128, 256):
-        trainer, host_batch, multi_jit = build(bs)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stem", default="conv7")
+    ap.add_argument("--barrier", action="store_true")
+    ap.add_argument("--batches", default="128")
+    args = ap.parse_args()
+
+    out = {"stem": args.stem, "barrier": args.barrier}
+    for bs in [int(b) for b in args.batches.split(",")]:
+        trainer, host_batch, multi_jit = build(bs, stem=args.stem,
+                                               barrier=args.barrier)
         ts = trainer.train_state
         batch = trainer._shard(host_batch)
         key = jax.random.PRNGKey(1)

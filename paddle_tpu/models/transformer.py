@@ -29,10 +29,14 @@ class TransformerBlock(Module):
 
     def __init__(self, dim: int, num_heads: int, ffn_hidden: int,
                  use_flash: bool = False, moe_experts: int = 0,
-                 dropout: float = 0.0, name=None):
+                 dropout: float = 0.0, attention_impl=None, seq_mesh=None,
+                 seq_axis: str = "seq", batch_axis=None, name=None):
         super().__init__(name=name)
         self.ln1 = LayerNorm()
-        self.attn = MultiHeadAttention(num_heads, use_flash=use_flash)
+        self.attn = MultiHeadAttention(num_heads, use_flash=use_flash,
+                                       attention_impl=attention_impl,
+                                       seq_mesh=seq_mesh, seq_axis=seq_axis,
+                                       batch_axis=batch_axis)
         self.ln2 = LayerNorm()
         self.moe_experts = moe_experts
         if moe_experts > 0:
@@ -42,8 +46,9 @@ class TransformerBlock(Module):
             self.ffn2 = Linear(dim)
         self.dropout = Dropout(dropout) if dropout else None
 
-    def forward(self, x, train: bool = False):
-        h = x + self._maybe_drop(self.attn(self.ln1(x), causal=True), train)
+    def forward(self, x, train: bool = False, segments=None):
+        h = x + self._maybe_drop(
+            self.attn(self.ln1(x), causal=True, segments=segments), train)
         z = self.ln2(h)
         if self.moe_experts > 0:
             y, aux = self.ffn(z, return_aux=True)
@@ -67,7 +72,8 @@ class TransformerLM(Module):
                  num_heads: int = 4, ffn_hidden: int = 256,
                  max_len: int = 512, use_flash: bool = False,
                  moe_experts: int = 0, dropout: float = 0.0,
-                 name="transformer_lm"):
+                 attention_impl=None, seq_mesh=None, seq_axis: str = "seq",
+                 batch_axis=None, name="transformer_lm"):
         super().__init__(name=name)
         self.max_len = max_len
         self.emb = Embedding(vocab, dim)
@@ -75,17 +81,26 @@ class TransformerLM(Module):
                              w_init=I.normal(0.02), name="pos")
         self.blocks = [TransformerBlock(dim, num_heads, ffn_hidden,
                                         use_flash, moe_experts, dropout,
+                                        attention_impl=attention_impl,
+                                        seq_mesh=seq_mesh, seq_axis=seq_axis,
+                                        batch_axis=batch_axis,
                                         name=f"block{i}")
                        for i in range(num_layers)]
         self.ln_f = LayerNorm()
 
-    def forward(self, ids, train: bool = False, return_aux: bool = False):
+    def forward(self, ids, train: bool = False, return_aux: bool = False,
+                segments=None, positions=None):
+        """``segments``/``positions``: packed-sequence metadata
+        (``core.sequence.pack_sequences``) — attention is confined within
+        each packed sub-sequence on every attention impl, and positional
+        embeddings restart per segment when ``positions`` is given."""
         T = ids.shape[1]
         assert T <= self.max_len, f"T={T} exceeds max_len={self.max_len}"
-        x = self.emb(ids) + self.pos(jnp.arange(T))[None]
+        pos = jnp.arange(T)[None] if positions is None else positions
+        x = self.emb(ids) + self.pos(pos)
         aux_total = jnp.zeros((), jnp.float32)
         for blk in self.blocks:
-            x, aux = blk(x, train=train)
+            x, aux = blk(x, train=train, segments=segments)
             aux_total = aux_total + aux
         x = self.ln_f(x)
         logits = self.emb.attend(x)          # tied softmax weights

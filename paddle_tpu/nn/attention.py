@@ -83,25 +83,65 @@ class MultiHeadAttention(Module):
     """Scaled-dot-product multi-head attention, bf16-friendly, with optional
     causal + segment masking (packed sequences). Self- or cross-attention.
 
-    ``use_flash=True`` routes self-attention through the fused Pallas kernel
-    (:mod:`paddle_tpu.nn.pallas_attention`) — linear HBM traffic in the
-    forward pass (the backward currently rematerialises full attention, see
-    the kernel module docstring). The flash path supports ``causal=`` but
-    not arbitrary ``mask=`` (flash + mask raises; use packing-aware masks on
-    the XLA path)."""
+    ``attention_impl`` selects the self-attention compute path:
+
+    - ``"xla"``: materialized-scores einsum path; supports arbitrary
+      ``mask=`` and cross-attention. The oracle path.
+    - ``"flash"``: fused Pallas blockwise kernel
+      (:mod:`paddle_tpu.nn.pallas_attention`) — linear HBM traffic forward
+      AND backward (both are fully blockwise; nothing [T, T]-shaped in
+      HBM). Supports ``causal=`` and packed-sequence ``segments=``.
+    - ``"ring"``: sequence-parallel ring attention over the mesh's ``seq``
+      axis (:mod:`paddle_tpu.parallel.ring`); needs ``seq_mesh=``.
+    - ``"seq"``/``"ulysses"``: all-to-all sequence parallelism
+      (:mod:`paddle_tpu.parallel.ulysses`); needs ``seq_mesh=``.
+
+    All fast paths consume the framework's variable-length contract
+    (``core.sequence`` packing: ``segments`` [B, T], 1-based, 0 = pad) —
+    the successor of the reference's never-padded
+    ``Argument::sequenceStartPositions`` ragged batches
+    (``paddle/parameter/Argument.h:84-93``). Arbitrary dense ``mask=`` is
+    XLA-path only. ``use_flash=True`` is an alias for
+    ``attention_impl="flash"``."""
 
     def __init__(self, num_heads: int, head_dim: Optional[int] = None,
                  out_dim: Optional[int] = None, use_flash: bool = False,
+                 attention_impl: Optional[str] = None, seq_mesh=None,
+                 seq_axis: str = "seq", batch_axis: Optional[str] = None,
                  name=None):
         super().__init__(name=name)
         self.num_heads = num_heads
         self.head_dim = head_dim
         self.out_dim = out_dim
-        self.use_flash = use_flash
+        impl = attention_impl or ("flash" if use_flash else "xla")
+        if impl == "ulysses":
+            impl = "seq"
+        if impl not in ("xla", "flash", "ring", "seq"):
+            raise ValueError(f"unknown attention_impl {impl!r}")
+        if impl in ("ring", "seq") and seq_mesh is None:
+            raise ValueError(f"attention_impl={impl!r} needs seq_mesh=")
+        self.attention_impl = impl
+        self.use_flash = impl == "flash"
+        self.seq_mesh = seq_mesh
+        self.seq_axis = seq_axis
+        self.batch_axis = batch_axis
 
-    def forward(self, q_in, kv_in=None, mask=None, causal: bool = False):
+    def _fast_path_checks(self, q_in, kv_in, mask):
+        if mask is not None:
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r} supports causal= "
+                "and segments= (packed sequences), not arbitrary mask=; "
+                "use the default XLA path for dense masks")
+        if kv_in is not q_in:
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r} is self-attention "
+                "only; pass kv_in=None or use the XLA path")
+
+    def forward(self, q_in, kv_in=None, mask=None, causal: bool = False,
+                segments=None):
         """q_in [B, Tq, D]; kv_in defaults to q_in (self-attention);
-        mask [B, Tq, Tk] (1 = attend)."""
+        mask [B, Tq, Tk] (1 = attend); segments [B, T] packed-sequence ids
+        (1-based, 0 = padding — ``core.sequence.pack_sequences``)."""
         kv_in = q_in if kv_in is None else kv_in
         pol = current_policy()
         d_model = q_in.shape[-1]
@@ -117,13 +157,9 @@ class MultiHeadAttention(Module):
         q = proj("wq", q_in, h * hd).reshape(*q_in.shape[:2], h, hd)
         k = proj("wk", kv_in, h * hd).reshape(*kv_in.shape[:2], h, hd)
         v = proj("wv", kv_in, h * hd).reshape(*kv_in.shape[:2], h, hd)
-        if self.use_flash:
-            if mask is not None:
-                raise ValueError(
-                    "flash path supports causal=, not arbitrary mask=")
-            if kv_in is not q_in:
-                raise ValueError("flash path is self-attention only; pass "
-                                 "kv_in=None or use use_flash=False")
+        impl = self.attention_impl
+        if impl == "flash":
+            self._fast_path_checks(q_in, kv_in, mask)
             from .pallas_attention import flash_attention
             T = q.shape[1]
             # largest divisor of T up to 128 keeps VMEM blocks bounded; a T
@@ -135,8 +171,19 @@ class MultiHeadAttention(Module):
             ctx = flash_attention(jnp.moveaxis(q, 2, 1),
                                   jnp.moveaxis(k, 2, 1),
                                   jnp.moveaxis(v, 2, 1),
-                                  causal, None, bq, bq)
+                                  segments, causal, None, bq, bq)
             ctx = jnp.moveaxis(ctx, 1, 2).astype(pol.compute_dtype)
+        elif impl in ("ring", "seq"):
+            self._fast_path_checks(q_in, kv_in, mask)
+            if impl == "ring":
+                from ..parallel.ring import make_ring_attention as make
+            else:
+                from ..parallel.ulysses import make_ulysses_attention as make
+            attn = make(self.seq_mesh, seq_axis=self.seq_axis,
+                        batch_axis=self.batch_axis, causal=causal,
+                        with_segments=segments is not None)
+            ctx = (attn(q, k, v, segments) if segments is not None
+                   else attn(q, k, v)).astype(pol.compute_dtype)
         else:
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
             logits = logits.astype(jnp.float32)
@@ -144,6 +191,10 @@ class MultiHeadAttention(Module):
                 Tq, Tk = logits.shape[-2:]
                 cm = jnp.tril(jnp.ones((Tq, Tk), bool))
                 logits = jnp.where(cm[None, None], logits, -1e9)
+            if segments is not None:
+                sm = (segments[:, :, None] == segments[:, None, :]) \
+                    & (segments[:, :, None] > 0)
+                logits = jnp.where(sm[:, None], logits, -1e9)
             if mask is not None:
                 logits = jnp.where(mask[:, None, :, :] > 0, logits, -1e9)
             w = jax.nn.softmax(logits, axis=-1).astype(pol.compute_dtype)

@@ -5,9 +5,18 @@ with an online softmax, keeping HBM traffic linear in T.
 
 Reference-lineage note: the 2017 reference has no attention kernel at all
 (SURVEY §5 long-context row — this is one of the deliberate "exceeds" items);
-its closest machinery is the RNN-era ``ContextProjection``. The algorithm is
-the public flash-attention recipe; the kernels follow the Pallas TPU playbook
-(`/opt/skills/guides/pallas_guide.md`).
+its closest machinery is the RNN-era ``ContextProjection``, and its
+variable-length contract is ``Argument::sequenceStartPositions``
+(``paddle/parameter/Argument.h:84-93``) — never-padded ragged batches. The
+TPU-native successor of that contract is packing + segment ids
+(``core/sequence.py``), and these kernels consume it natively: pass
+``segments`` ([B, T] int32, 1-based, 0 = padding, the ``pack_sequences``
+layout) and attention is confined within each packed sub-sequence. Blocks
+whose segment-id ranges cannot intersect are skipped with ``pl.when``
+(FLOPs and VPU work skipped; the DMA still runs since index maps cannot
+depend on data), and intersecting blocks mask per-element. The algorithm is
+the public flash-attention recipe; the kernels follow the Pallas TPU
+playbook (`/opt/skills/guides/pallas_guide.md`).
 
 Structure: 3-D grids ``(batch*heads, row blocks, streamed blocks)`` with the
 online-softmax state carried in VMEM scratch across the innermost grid axis
@@ -21,6 +30,10 @@ log-sum-exp L; the backward runs two Pallas kernels (dq over query blocks;
 dk/dv over key blocks) that rebuild each probability tile as
 ``exp(s - L)`` — nothing [T, T]-shaped ever exists in HBM, forward or
 backward.
+
+Rows with no visible key (segment id 0 = padding) produce an unspecified
+finite output (uniform average of the streamed v blocks) — identical to the
+convention of other public TPU flash kernels; mask padding rows downstream.
 
 ``interpret=None`` auto-selects the Pallas interpreter off-TPU, so the same
 tests run on the CPU harness and the kernels compile on real chips.
@@ -42,16 +55,23 @@ _NEG = -1e30
 
 
 def reference_attention(q, k, v, causal: bool = False,
-                        scale: Optional[float] = None):
-    """Plain softmax attention — the numeric oracle. [B, H, T, D] inputs."""
+                        scale: Optional[float] = None, segments=None):
+    """Plain softmax attention — the numeric oracle. [B, H, T, D] inputs;
+    ``segments`` [B, T] confines attention within equal non-zero ids."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    T = q.shape[2]
     if causal:
-        T = q.shape[2]
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask[None, None], s, -jnp.inf)
+    if segments is not None:
+        seg = (segments[:, :, None] == segments[:, None, :]) \
+            & (segments[:, :, None] > 0) & (segments[:, None, :] > 0)
+        s = jnp.where(seg[:, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
+    if segments is not None:
+        p = jnp.where(jnp.isnan(p), 0.0, p)     # fully-masked padding rows
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
@@ -68,8 +88,24 @@ def _block_needed(qi, bq, ki, bk, causal):
     return ki * bk <= (qi + 1) * bq - 1
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-                 scale, causal):
+def _seg_block_mask(sq, sk):
+    """[bq,1], [bk,1] id blocks -> [bq, bk] visibility mask (0 = padding)."""
+    return (sq == sk.reshape(1, -1)) & (sq > 0) & (sk.reshape(1, -1) > 0)
+
+
+def _seg_block_relevant(sq, sk):
+    """Sound skip test: packed ids in the two blocks can only match if
+    their value ranges intersect (exact for any id layout) and neither
+    block is all-padding."""
+    return ((jnp.min(sq) <= jnp.max(sk)) & (jnp.max(sq) >= jnp.min(sk))
+            & (jnp.max(sq) > 0) & (jnp.max(sk) > 0))
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segs):
+    if segs:
+        sq_ref, sk_ref, o_ref, lse_ref, m_s, l_s, acc_s = rest
+    else:
+        o_ref, lse_ref, m_s, l_s, acc_s = rest
     bq, d = q_ref.shape
     bk = k_ref.shape[0]
     qi = pl.program_id(1)
@@ -82,7 +118,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         l_s[:] = jnp.zeros((bq, 1), jnp.float32)
         acc_s[:] = jnp.zeros((bq, d), jnp.float32)
 
-    @pl.when(_block_needed(qi, bq, ki, bk, causal))
+    needed = _block_needed(qi, bq, ki, bk, causal)
+    if segs:
+        needed = needed & _seg_block_relevant(sq_ref[:], sk_ref[:])
+
+    @pl.when(needed)
     def _():
         q = q_ref[:].astype(jnp.float32) * scale
         ks = k_ref[:].astype(jnp.float32)
@@ -91,6 +131,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = jnp.where(_causal_mask(qi, bq, ki, bk), s, _NEG)
+        if segs:
+            s = jnp.where(_seg_block_mask(sq_ref[:], sk_ref[:]), s, _NEG)
         m = m_s[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -108,8 +150,12 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         lse_ref[:] = m_s[:] + jnp.log(l)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_s, *, scale, causal):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               scale, causal, segs):
+    if segs:
+        sq_ref, sk_ref, dq_ref, dq_s = rest
+    else:
+        dq_ref, dq_s = rest
     bq, d = q_ref.shape
     bk = k_ref.shape[0]
     qi = pl.program_id(1)
@@ -120,7 +166,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _():
         dq_s[:] = jnp.zeros((bq, d), jnp.float32)
 
-    @pl.when(_block_needed(qi, bq, ki, bk, causal))
+    needed = _block_needed(qi, bq, ki, bk, causal)
+    if segs:
+        needed = needed & _seg_block_relevant(sq_ref[:], sk_ref[:])
+
+    @pl.when(needed)
     def _():
         q = q_ref[:].astype(jnp.float32) * scale
         ks = k_ref[:].astype(jnp.float32)
@@ -132,6 +182,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = jnp.where(_causal_mask(qi, bq, ki, bk), s, _NEG)
+        if segs:
+            s = jnp.where(_seg_block_mask(sq_ref[:], sk_ref[:]), s, _NEG)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -145,8 +197,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[:] = (dq_s[:] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_s, dv_s, *, scale, causal):
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
+                scale, causal, segs):
+    if segs:
+        sk_ref, sq_ref, dk_ref, dv_ref, dk_s, dv_s = rest
+    else:
+        dk_ref, dv_ref, dk_s, dv_s = rest
     bk, d = k_ref.shape
     bq = q_ref.shape[0]
     ki = pl.program_id(1)
@@ -158,7 +214,11 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dk_s[:] = jnp.zeros((bk, d), jnp.float32)
         dv_s[:] = jnp.zeros((bk, d), jnp.float32)
 
-    @pl.when(_block_needed(qi, bq, ki, bk, causal))
+    needed = _block_needed(qi, bq, ki, bk, causal)
+    if segs:
+        needed = needed & _seg_block_relevant(sq_ref[:], sk_ref[:])
+
+    @pl.when(needed)
     def _():
         ks = k_ref[:].astype(jnp.float32)
         vs = v_ref[:].astype(jnp.float32)
@@ -170,6 +230,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = jnp.where(_causal_mask(qi, bq, ki, bk), s, _NEG)
+        if segs:
+            s = jnp.where(_seg_block_mask(sq_ref[:], sk_ref[:]), s, _NEG)
         p = jnp.exp(s - lse)
         dv_s[:] = dv_s[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -196,46 +258,67 @@ def _blocks(block_q, block_k, T):
     return bq, bk
 
 
-def _kv_index_map(causal, bq, bk):
+def _kv_index_map(causal, bq, bk, H=1):
     """K/V block index map for q-major kernels. Under causal masking the
     skipped upper-triangle steps clamp to the row's last needed key block,
     so the pipeline re-references the resident block instead of fetching
     one that pl.when will discard (skipping FLOPs alone still paid the
-    DMA)."""
+    DMA). ``H``: grid axis 0 is batch*heads; head-invariant operands
+    (segment ids) use ``H > 1`` to index by batch row."""
     if not causal:
-        return lambda b, i, j: (b, j, 0)
-    return lambda b, i, j: (b, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0)
+        return lambda b, i, j: (b // H, j, 0)
+    return lambda b, i, j: (b // H, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0)
 
 
-def _q_index_map(causal, bq, bk):
+def _q_index_map(causal, bq, bk, H=1):
     """Q-side map for the key-major dk/dv kernel: clamp the skipped
     before-the-diagonal steps up to the first query block that sees this
     key block."""
     if not causal:
-        return lambda b, i, j: (b, j, 0)
-    return lambda b, i, j: (b, jnp.maximum(j, (i * bk) // bq), 0)
+        return lambda b, i, j: (b // H, j, 0)
+    return lambda b, i, j: (b // H, jnp.maximum(j, (i * bk) // bq), 0)
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _row_map(H=1):
+    return lambda b, i, j: (b // H, i, 0)
+
+
+def _key_row_map(H=1):
+    return lambda b, i, j: (b // H, i, 0)
+
+
+def _flash_forward(q, k, v, segments, causal, scale, block_q, block_k,
+                   interpret):
     B, H, T, D = q.shape
     bq, bk = _blocks(block_q, block_k, T)
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, T, D)
     vf = v.reshape(B * H, T, D)
     kvmap = _kv_index_map(causal, bq, bk)
+    segs = segments is not None
+    in_specs = [
+        pl.BlockSpec((None, bq, D), _row_map()),
+        pl.BlockSpec((None, bk, D), kvmap),
+        pl.BlockSpec((None, bk, D), kvmap),
+    ]
+    operands = [qf, kf, vf]
+    if segs:
+        segf = segments.reshape(B, T, 1).astype(jnp.int32)
+        in_specs += [
+            pl.BlockSpec((None, bq, 1), _row_map(H)),
+            pl.BlockSpec((None, bk, 1), _kv_index_map(causal, bq, bk, H)),
+        ]
+        operands += [segf, segf]
     out, lse = pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale, causal=causal),
+        functools.partial(_attn_kernel, scale=scale, causal=causal,
+                          segs=segs),
         grid=(B * H, T // bq, T // bk),
-        in_specs=[
-            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, bk, D), kvmap),
-            pl.BlockSpec((None, bk, D), kvmap),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, D), _row_map()),
             # trailing unit dim keeps the block 2-D (TPU tiling rejects
             # rank-1 blocks)
-            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), _row_map()),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
@@ -247,12 +330,12 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((bq, D), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*operands)
     return out.reshape(B, H, T, D), lse.reshape(B, H, T)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                    interpret):
+def _flash_backward(q, k, v, segments, out, lse, g, causal, scale, block_q,
+                    block_k, interpret):
     B, H, T, D = q.shape
     bq, bk = _blocks(block_q, block_k, T)
     qf = q.reshape(B * H, T, D)
@@ -260,44 +343,63 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     vf = v.reshape(B * H, T, D)
     gf = g.reshape(B * H, T, D)
     lsef = lse.reshape(B * H, T, 1)
+    segs = segments is not None
+    segf = (segments.reshape(B, T, 1).astype(jnp.int32) if segs else None)
     # delta = rowsum(dO * O) — O(T*D) elementwise, fine outside the kernel
     delta = jnp.sum(gf.astype(jnp.float32)
                     * out.reshape(B * H, T, D).astype(jnp.float32),
                     axis=-1, keepdims=True)
 
     kvmap = _kv_index_map(causal, bq, bk)
+    in_specs = [
+        pl.BlockSpec((None, bq, D), _row_map()),
+        pl.BlockSpec((None, bk, D), kvmap),
+        pl.BlockSpec((None, bk, D), kvmap),
+        pl.BlockSpec((None, bq, D), _row_map()),
+        pl.BlockSpec((None, bq, 1), _row_map()),
+        pl.BlockSpec((None, bq, 1), _row_map()),
+    ]
+    operands = [qf, kf, vf, gf, lsef, delta]
+    if segs:
+        in_specs += [
+            pl.BlockSpec((None, bq, 1), _row_map(H)),
+            pl.BlockSpec((None, bk, 1), _kv_index_map(causal, bq, bk, H)),
+        ]
+        operands += [segf, segf]
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        functools.partial(_dq_kernel, scale=scale, causal=causal, segs=segs),
         grid=(B * H, T // bq, T // bk),
-        in_specs=[
-            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, bk, D), kvmap),
-            pl.BlockSpec((None, bk, D), kvmap),
-            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, bq, D), _row_map()),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, gf, lsef, delta)
+    )(*operands)
 
     qmap = _q_index_map(causal, bq, bk)
+    in_specs = [
+        pl.BlockSpec((None, bk, D), _key_row_map()),
+        pl.BlockSpec((None, bk, D), _key_row_map()),
+        pl.BlockSpec((None, bq, D), qmap),
+        pl.BlockSpec((None, bq, D), qmap),
+        pl.BlockSpec((None, bq, 1), qmap),
+        pl.BlockSpec((None, bq, 1), qmap),
+    ]
+    operands = [kf, vf, qf, gf, lsef, delta]
+    if segs:
+        in_specs += [
+            pl.BlockSpec((None, bk, 1), _key_row_map(H)),
+            pl.BlockSpec((None, bq, 1), _q_index_map(causal, bq, bk, H)),
+        ]
+        operands += [segf, segf]
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          segs=segs),
         grid=(B * H, T // bk, T // bq),
-        in_specs=[
-            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, bq, D), qmap),
-            pl.BlockSpec((None, bq, D), qmap),
-            pl.BlockSpec((None, bq, 1), qmap),
-            pl.BlockSpec((None, bq, 1), qmap),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), _key_row_map()),
+            pl.BlockSpec((None, bk, D), _key_row_map()),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
@@ -306,7 +408,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
         interpret=interpret,
-    )(kf, vf, qf, gf, lsef, delta)
+    )(*operands)
 
     return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D),
             dv.reshape(B, H, T, D))
@@ -322,32 +424,36 @@ def _resolve_defaults(q, scale, interpret):
     return scale, interpret
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal: bool = False,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention(q, k, v, segments=None, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 128,
                     block_k: int = 128, interpret: Optional[bool] = None):
     """Fused attention over [B, H, T, D]. ``T`` must divide by the block
     sizes (pack/pad upstream — static shapes are the framework contract).
-    ``interpret`` defaults to True off-TPU so the CPU test harness runs the
-    same kernels through the Pallas interpreter."""
+    ``segments``: optional [B, T] packed-sequence ids (``core.sequence``
+    convention: 1-based, 0 = padding) confining attention within each
+    sub-sequence — shared across heads. ``interpret`` defaults to True
+    off-TPU so the CPU test harness runs the same kernels through the
+    Pallas interpreter."""
     scale, interpret = _resolve_defaults(q, scale, interpret)
-    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
+    out, _ = _flash_forward(q, k, v, segments, causal, scale, block_q,
+                            block_k, interpret)
     return out
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _fwd(q, k, v, segments, causal, scale, block_q, block_k, interpret):
     scale, interpret = _resolve_defaults(q, scale, interpret)
-    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                              interpret)
-    return out, (q, k, v, out, lse)
+    out, lse = _flash_forward(q, k, v, segments, causal, scale, block_q,
+                              block_k, interpret)
+    return out, (q, k, v, segments, out, lse)
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
+    q, k, v, segments, out, lse = res
     scale, interpret = _resolve_defaults(q, scale, interpret)
-    return _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
-                           block_k, interpret)
+    dq, dk, dv = _flash_backward(q, k, v, segments, out, lse, g, causal,
+                                 scale, block_q, block_k, interpret)
+    return dq, dk, dv, None
 
 
 flash_attention.defvjp(_fwd, _bwd)

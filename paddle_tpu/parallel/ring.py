@@ -29,13 +29,18 @@ __all__ = ["ring_attention", "make_ring_attention"]
 _NEG = -1e30  # finite "-inf": keeps fully-masked rows NaN-free
 
 
-def ring_attention(q, k, v, axis_name: str, axis_size: int,
+def ring_attention(q, k, v, segments=None, *, axis_name: str, axis_size: int,
                    causal: bool = False, scale: Optional[float] = None):
     """Blockwise ring attention — call INSIDE shard_map.
 
     q, k, v: local shards [B, Tlocal, H, D], time sharded over ``axis_name``
-    (axis static size ``axis_size``). Returns the local output shard
-    [B, Tlocal, H, D]. Softmax statistics accumulate in float32.
+    (axis static size ``axis_size``). ``segments``: optional local [B,
+    Tlocal] packed-sequence ids (``core.sequence`` convention: 1-based,
+    0 = padding); the k-side ids rotate around the ring with their k/v
+    shard, confining attention within each packed sub-sequence. Returns the
+    local output shard [B, Tlocal, H, D]. Softmax statistics accumulate in
+    float32. Rows with no visible key (padding) return an unspecified
+    finite value — mask downstream.
     """
     n = axis_size
     idx = lax.axis_index(axis_name)
@@ -53,14 +58,24 @@ def ring_attention(q, k, v, axis_name: str, axis_size: int,
     m0 = zero_rows + _NEG                                       # running max
     l0 = zero_rows                                              # running sum
     perm = [(j, (j + 1) % n) for j in range(n)]
+    carry0 = (k, v, acc0, m0, l0)
+    if segments is not None:
+        carry0 = carry0 + (segments,)
 
     def step(carry, i):
-        kb, vb, acc, m, l = carry
+        if segments is not None:
+            kb, vb, acc, m, l, seg_kb = carry
+        else:
+            kb, vb, acc, m, l = carry
         src = (idx - i) % n                 # ring owner of the block we hold
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
         if causal:
             k_pos = src * tl + jnp.arange(tl)
             s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG)
+        if segments is not None:
+            sm = (segments[:, :, None] == seg_kb[:, None, :]) \
+                & (segments[:, :, None] > 0) & (seg_kb[:, None, :] > 0)
+            s = jnp.where(sm[:, None], s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
         corr = jnp.exp(m - m_new)
@@ -70,20 +85,26 @@ def ring_attention(q, k, v, axis_name: str, axis_size: int,
                                 vb.astype(jnp.float32)))
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
-        return (kb, vb, acc_new, m_new, l_new), None
+        out = (kb, vb, acc_new, m_new, l_new)
+        if segments is not None:
+            out = out + (lax.ppermute(seg_kb, axis_name, perm),)
+        return out, None
 
-    (_, _, acc, m, l), _ = lax.scan(step, (k, v, acc0, m0, l0),
-                                    jnp.arange(n))
-    out = acc / jnp.swapaxes(l, 1, 2)[..., None]
+    carry, _ = lax.scan(step, carry0, jnp.arange(n))
+    acc, m, l = carry[2], carry[3], carry[4]
+    out = acc / jnp.maximum(jnp.swapaxes(l, 1, 2)[..., None], 1e-30)
     return out.astype(q.dtype)
 
 
 def wrap_seq_parallel(attn_fn, mesh: Mesh, seq_axis: str,
-                      batch_axis: Optional[str], causal: bool):
+                      batch_axis: Optional[str], causal: bool,
+                      with_segments: bool = False):
     """Shared shard_map wrapper for sequence-parallel attention kernels
     (ring and Ulysses expose the same surface): takes GLOBAL [B, T, H, D]
     arrays (time sharded over ``seq_axis``, optionally batch over
-    ``batch_axis``) and returns the global output."""
+    ``batch_axis``) and returns the global output. With
+    ``with_segments=True`` the wrapped fn takes a fourth global [B, T]
+    packed-sequence id argument (sharded over time like q/k/v)."""
     try:
         from jax import shard_map
     except ImportError:            # older jax
@@ -91,16 +112,17 @@ def wrap_seq_parallel(attn_fn, mesh: Mesh, seq_axis: str,
 
     n = dict(zip(mesh.axis_names, mesh.devices.shape))[seq_axis]
     spec = P(batch_axis, seq_axis, None, None)
+    seg_spec = P(batch_axis, seq_axis)
     fn = functools.partial(attn_fn, axis_name=seq_axis, axis_size=n,
                            causal=causal)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)
+    in_specs = (spec, spec, spec) + ((seg_spec,) if with_segments else ())
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=spec)
 
 
 def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
                         batch_axis: Optional[str] = None,
-                        causal: bool = False):
+                        causal: bool = False, with_segments: bool = False):
     """:func:`ring_attention` over global arrays (see
     :func:`wrap_seq_parallel`)."""
     return wrap_seq_parallel(ring_attention, mesh, seq_axis, batch_axis,
-                             causal)
+                             causal, with_segments)

@@ -26,12 +26,17 @@ from .ring import _NEG, wrap_seq_parallel
 __all__ = ["ulysses_attention", "make_ulysses_attention"]
 
 
-def ulysses_attention(q, k, v, axis_name: str, axis_size: int,
-                      causal: bool = False, scale: Optional[float] = None):
+def ulysses_attention(q, k, v, segments=None, *, axis_name: str,
+                      axis_size: int, causal: bool = False,
+                      scale: Optional[float] = None):
     """All-to-all sequence-parallel attention — call INSIDE shard_map.
 
     q, k, v: local shards [B, T/n, H, D], time sharded over ``axis_name``.
-    Requires ``H % n == 0``. Returns the local [B, T/n, H, D] output shard.
+    Requires ``H % n == 0``. ``segments``: optional local [B, T/n]
+    packed-sequence ids (1-based, 0 = padding); all-gathered over the seq
+    axis (ids are O(T) ints — negligible next to the k/v all-to-alls) so
+    each device's full-sequence attention masks across packed-sequence
+    boundaries. Returns the local [B, T/n, H, D] output shard.
     """
     n = axis_size
     h = q.shape[2]
@@ -51,6 +56,11 @@ def ulysses_attention(q, k, v, axis_name: str, axis_size: int,
         t = s.shape[-1]
         mask = jnp.tril(jnp.ones((t, t), bool))
         s = jnp.where(mask[None, None], s, _NEG)
+    if segments is not None:
+        seg_g = lax.all_gather(segments, axis_name, axis=1, tiled=True)
+        sm = (seg_g[:, :, None] == seg_g[:, None, :]) \
+            & (seg_g[:, :, None] > 0) & (seg_g[:, None, :] > 0)
+        s = jnp.where(sm[:, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, vg).astype(q.dtype)
     # [B, T, H/n, D] -> [B, T/n, H, D]: split time n ways, gather heads
@@ -60,9 +70,9 @@ def ulysses_attention(q, k, v, axis_name: str, axis_size: int,
 
 def make_ulysses_attention(mesh: Mesh, seq_axis: str = "seq",
                            batch_axis: Optional[str] = None,
-                           causal: bool = False):
+                           causal: bool = False, with_segments: bool = False):
     """:func:`ulysses_attention` over global arrays — same surface as
     :func:`.ring.make_ring_attention` so models can switch strategies by
     config (shared wrapper: :func:`.ring.wrap_seq_parallel`)."""
     return wrap_seq_parallel(ulysses_attention, mesh, seq_axis, batch_axis,
-                             causal)
+                             causal, with_segments)

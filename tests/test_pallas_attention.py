@@ -20,7 +20,7 @@ def _rand(shape, seed):
 def test_flash_matches_reference(shape, causal):
     B, H, T, D = shape
     q, k, v = (_rand(shape, s) for s in range(3))
-    got = flash_attention(q, k, v, causal, None, 64, 64, True)
+    got = flash_attention(q, k, v, None, causal, None, 64, 64, True)
     want = reference_attention(q, k, v, causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
@@ -29,7 +29,7 @@ def test_flash_matches_reference(shape, causal):
 def test_flash_uneven_blocks():
     # block sizes clamp to T when T is smaller
     q, k, v = (_rand((1, 1, 64, 8), s) for s in range(3))
-    got = flash_attention(q, k, v, False, None, 128, 128, True)
+    got = flash_attention(q, k, v, None, False, None, 128, 128, True)
     want = reference_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
@@ -39,7 +39,7 @@ def test_flash_gradients_match_reference():
     q, k, v = (_rand((1, 2, 128, 16), s) for s in range(3))
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, True, None, 64, 64, True) ** 2)
+        return jnp.sum(flash_attention(q, k, v, None, True, None, 64, 64, True) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(reference_attention(q, k, v, True) ** 2)
@@ -54,10 +54,10 @@ def test_flash_gradients_match_reference():
 def test_flash_causality_enforced():
     # output at position t must not depend on keys/values after t
     q, k, v = (_rand((1, 1, 128, 8), s) for s in range(3))
-    out1 = flash_attention(q, k, v, True, None, 64, 64, True)
+    out1 = flash_attention(q, k, v, None, True, None, 64, 64, True)
     v2 = v.at[:, :, 100:].set(99.0)
     k2 = k.at[:, :, 100:].set(-7.0)
-    out2 = flash_attention(q, k2, v2, True, None, 64, 64, True)
+    out2 = flash_attention(q, k2, v2, None, True, None, 64, 64, True)
     np.testing.assert_allclose(np.asarray(out1[:, :, :100]),
                                np.asarray(out2[:, :, :100]), rtol=1e-5)
     assert not np.allclose(np.asarray(out1[:, :, 100:]),
@@ -98,7 +98,7 @@ def test_flash_gradients_noncausal_and_vmapped():
     q, k, v = (_rand((2, 2, 128, 16), s) for s in range(3))
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, False, None, 64, 64, True)
+        return jnp.sum(flash_attention(q, k, v, None, False, None, 64, 64, True)
                        ** 2)
 
     def loss_ref(q, k, v):
@@ -120,10 +120,10 @@ def test_flash_gradients_noncausal_and_vmapped():
 def test_flash_bf16_forward_backward():
     q, k, v = (_rand((1, 1, 128, 16), s).astype(jnp.bfloat16)
                for s in range(3))
-    out = flash_attention(q, k, v, True, None, 64, 64, True)
+    out = flash_attention(q, k, v, None, True, None, 64, 64, True)
     assert out.dtype == jnp.bfloat16
     g = jax.grad(lambda q: jnp.sum(
-        flash_attention(q, k, v, True, None, 64, 64, True)
+        flash_attention(q, k, v, None, True, None, 64, 64, True)
         .astype(jnp.float32)))(q)
     assert g.dtype == jnp.bfloat16
     assert np.isfinite(np.asarray(g, np.float32)).all()
@@ -135,7 +135,7 @@ def test_flash_vjp_passes_whole_model_gradcheck():
     q, k, v = (_rand((1, 1, 64, 8), s) for s in range(3))
 
     def loss_fn(p):
-        return jnp.sum(flash_attention(p["q"], p["k"], p["v"], True, None,
+        return jnp.sum(flash_attention(p["q"], p["k"], p["v"], None, True, None,
                                        32, 32, True) ** 2)
 
     check_gradients(loss_fn, {"q": q, "k": k, "v": v}, num_directions=2)
