@@ -25,7 +25,8 @@ from ..core.module import Module, Sequential
 from .. import nn
 from ..parallel import ShardingRules
 
-__all__ = ["WideDeepCTR", "SparseLR", "CTR_SHARDING_RULES"]
+__all__ = ["WideDeepCTR", "SparseLR", "CTR_SHARDING_RULES",
+           "SparseRowsWideDeepCTR", "make_sparse_ctr_step"]
 
 # Row-shard every embedding table over the `model` axis — the pserver
 # row-sharding analog. First match wins; everything else replicated.
@@ -92,3 +93,92 @@ class WideDeepCTR(Module):
         flat = e.reshape(e.shape[0], self.num_fields * self.emb_dim)
         deep_logit = self.mlp(flat)[:, 0]                           # [B]
         return wide_logit + deep_logit
+
+
+class SparseRowsWideDeepCTR(Module):
+    """Wide&deep CTR over *prefetched rows* — the sparse-update twin of
+    :class:`WideDeepCTR` for tables that must never see a dense gradient
+    (reference: the sparse remote tier, ``RemoteParameterUpdater.h:265``).
+
+    The embedding tables are NOT parameters of this module: they live in
+    :class:`paddle_tpu.optim.sparse.SparseTable` buffers outside autodiff;
+    the step (see :func:`make_sparse_ctr_step`) gathers each batch's unique
+    rows and differentiates w.r.t. the gathered [U, D] slices only. The
+    dense MLP trains normally.
+    """
+
+    def __init__(self, num_fields: int, vocab_per_field: int,
+                 emb_dim: int = 16, hidden: Sequence[int] = (64, 32),
+                 name=None):
+        super().__init__(name=name)
+        self.num_fields = num_fields
+        self.vocab = vocab_per_field
+        self.emb_dim = emb_dim
+        self.mlp = Sequential(
+            *[nn.Linear(h, act="relu", name=f"fc{i}")
+              for i, h in enumerate(hidden)],
+            nn.Linear(1, name="out"), name="mlp")
+
+    def global_ids(self, ids):
+        return _global_field_ids(ids, self.num_fields, self.vocab)
+
+    def forward(self, ids, wide_rows, wide_gather, deep_rows, deep_gather,
+                train=False):
+        """``*_rows`` [U, D] gathered table rows; ``*_gather`` [B, F] index
+        of each field's row within them (padding already zeroed in rows)."""
+        valid = (ids >= 0)[..., None]
+        wide_e = jnp.where(valid, wide_rows[wide_gather], 0.0)     # [B,F,1]
+        deep_e = jnp.where(valid, deep_rows[deep_gather], 0.0)     # [B,F,D]
+        wide_logit = wide_e[..., 0].sum(-1)
+        flat = deep_e.reshape(deep_e.shape[0], self.num_fields * self.emb_dim)
+        return wide_logit + self.mlp(flat)[:, 0]
+
+
+def make_sparse_ctr_step(model: "SparseRowsWideDeepCTR", dense_optimizer,
+                         row_optimizer, loss_fn, catchup=None):
+    """Build the jitted sparse train step.
+
+    Signature: ``step(dense_params, dense_opt_state, wide_table, deep_table,
+    step_no, batch) -> (dense_params, dense_opt_state, wide_table,
+    deep_table, loss)`` with the tables donated — commits lower to in-place
+    scatters and **nothing [vocab, D]-shaped enters the autodiff graph**
+    (asserted structurally by ``tests/test_sparse_rows.py``).
+    """
+    import jax
+
+    from ..optim import sparse as sp
+    from ..optim.optimizers import apply_updates
+
+    def step_fn(params, opt_state, wide_tbl, deep_tbl, step_no, batch):
+        ids = batch["ids"]
+        g = model.global_ids(ids)
+        wide_pre = sp.sparse_prefetch(wide_tbl, g, step_no, catchup=catchup)
+        deep_pre = sp.sparse_prefetch(deep_tbl, g, step_no, catchup=catchup)
+
+        def compute_loss(p, wide_rows, deep_rows):
+            out = model.apply(
+                {"params": p}, ids, wide_rows, wide_pre.gather_idx,
+                deep_rows, deep_pre.gather_idx, train=True)
+            return loss_fn(out, batch)
+
+        (loss), grads = jax.value_and_grad(compute_loss, argnums=(0, 1, 2))(
+            params, wide_pre.rows, deep_pre.rows)
+        gdense, gwide, gdeep = grads
+
+        upd, new_opt = dense_optimizer.update(gdense, opt_state, params,
+                                              step_no)
+        new_params = apply_updates(params, upd)
+
+        new_tables = []
+        for tbl, pre, grows in ((wide_tbl, wide_pre, gwide),
+                                (deep_tbl, deep_pre, gdeep)):
+            rupd, rslots = row_optimizer.update(grows, pre.slots, pre.rows,
+                                                step_no)
+            new_tables.append(sp.sparse_commit(
+                tbl, pre, pre.rows + rupd, rslots, step_no))
+        return (new_params, new_opt, new_tables[0], new_tables[1],
+                loss)
+
+    jitted = jax.jit(step_fn, donate_argnums=(2, 3))
+    jitted._raw = step_fn          # for structural jaxpr inspection in tests
+    return jitted

@@ -1,0 +1,150 @@
+"""Sparse-row parameter updates — the SelectedRows / SparseRowMatrix analog.
+
+The reference trains huge embedding tables by never touching the full table
+in a step: the trainer prefetches only the rows present in the batch
+(``GradientMachine::prefetch``; ``SparseRemoteParameterUpdater``,
+``trainer/RemoteParameterUpdater.h:265``), gradients are per-row
+(``SelectedRows``, ``framework/selected_rows.h``; ``SparseRowCpuMatrix``,
+``math/SparseRowMatrix.h:31``), the optimizer updates only touched rows, and
+L1/L2 regularisation catches up lazily when a row is next touched
+(``parameter/Regularizer.cpp``).
+
+TPU-native translation — the same contract without a parameter server:
+
+1. **Prefetch = fixed-size unique + gather.** ``jnp.unique(size=...)`` keeps
+   shapes static under jit; out-of-vocab padding collapses onto a sentinel
+   row that is masked on gather and dropped on scatter.
+2. **The gradient is taken w.r.t. the GATHERED rows [U, D]**, not the table:
+   the model consumes ``rows[gather_idx]``, so ``jax.grad`` produces a
+   naturally row-sparse gradient and nothing [vocab, D]-shaped ever enters
+   the autodiff graph (the dense ``jnp.take`` VJP would materialise a full
+   table-shaped buffer every step — the exact failure SURVEY §2.3's sparse
+   row demanded we avoid).
+3. **Row-wise optimizers for free**: every elementwise rule in
+   :mod:`.optimizers` (sgd/adagrad/adam/ftrl/...) operates on the [U, D]
+   row slice of its [vocab, D] slot buffers. FTRL and Adagrad are
+   lazy-correct by construction (zero grad => zero delta); momentum-style
+   rules intentionally differ from their dense counterparts for untouched
+   rows, exactly as the reference's dedicated ``SparseMomentumParameter
+   Optimizer`` did.
+4. **Commit = scatter into donated buffers**: inside one jit with the table
+   donated, ``table.at[rows].set`` lowers to an in-place scatter.
+5. **Lazy decay catch-up**: per-row last-touched step; on prefetch, the
+   multiplicative L2 (and shrinkage L1) the dense run would have applied on
+   idle steps is applied in closed form (``Regularizer.cpp`` lazy path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .optimizers import Optimizer
+
+__all__ = ["SparseTable", "sparse_table", "sparse_prefetch", "sparse_commit",
+           "l2_catchup", "l1_catchup", "Prefetched"]
+
+tmap = jax.tree_util.tree_map
+
+
+class SparseTable(NamedTuple):
+    """A large embedding table + its row-sharded optimizer slots (pytree).
+
+    ``rows``: [vocab, dim]; ``slots``: the row optimizer's state over the
+    table (leaves [vocab, dim]); ``last_step``: [vocab] int32, the step each
+    row was last updated (-1 = never), driving lazy decay catch-up."""
+    rows: jax.Array
+    slots: Any
+    last_step: jax.Array
+
+
+class Prefetched(NamedTuple):
+    """One batch's working set of a :class:`SparseTable`.
+
+    ``uniq``: [U] unique row ids (sentinel ``vocab`` = padding slot);
+    ``gather_idx``: for each flat input id, its position in ``uniq``;
+    ``rows``/``slots``: the gathered [U, D] row values / optimizer state;
+    ``idle_steps``: [U] steps since each row was last updated (for lazy
+    decay catch-up)."""
+    uniq: jax.Array
+    gather_idx: jax.Array
+    rows: jax.Array
+    slots: Any
+    idle_steps: jax.Array
+
+
+def sparse_table(init_fn: Callable, rng, vocab: int, dim: int,
+                 optimizer: Optimizer,
+                 dtype=jnp.float32) -> SparseTable:
+    """Materialise a table and its optimizer slots (the only [vocab, D]
+    allocations the sparse path ever makes — the same buffers the reference
+    held on its pservers)."""
+    rows = init_fn(rng, (vocab, dim), dtype)
+    return SparseTable(rows=rows, slots=optimizer.init(rows),
+                       last_step=jnp.full((vocab,), -1, jnp.int32))
+
+
+def sparse_prefetch(table: SparseTable, ids: jax.Array, step,
+                    catchup: Optional[Callable] = None) -> Prefetched:
+    """Gather the batch's unique rows ([U, D], U = ids.size — static shape).
+
+    ``ids``: any-shape int array; negatives (padding) map to the sentinel.
+    ``catchup(rows, idle_steps)`` optionally applies lazy decay
+    (:func:`l2_catchup` / :func:`l1_catchup`) to the gathered values."""
+    vocab = table.rows.shape[0]
+    flat = ids.reshape(-1)
+    flat = jnp.where((flat >= 0) & (flat < vocab), flat, vocab)
+    uniq, gather_idx = jnp.unique(flat, return_inverse=True, size=flat.size,
+                                  fill_value=vocab)
+    safe = jnp.minimum(uniq, vocab - 1)
+    valid = (uniq < vocab)[:, None]
+    rows = jnp.take(table.rows, safe, axis=0) * valid.astype(table.rows.dtype)
+    slots = tmap(lambda s: jnp.take(s, safe, axis=0), table.slots)
+    # Idle steps = steps where a dense run would have applied decay-only
+    # updates: every step since the last touch (exclusive) — or since step 0
+    # for never-touched rows, matching the dense path which decays all rows
+    # from the start.
+    last = table.last_step[safe]
+    idle = jnp.where(last < 0, step,
+                     jnp.maximum(step - last - 1, 0)).astype(jnp.int32)
+    if catchup is not None:
+        rows = catchup(rows, idle)
+    return Prefetched(uniq, gather_idx.reshape(ids.shape), rows, slots, idle)
+
+
+def sparse_commit(table: SparseTable, pre: Prefetched, new_rows,
+                  new_slots, step) -> SparseTable:
+    """Scatter updated rows/slots back (out-of-bounds sentinel dropped).
+    Donate ``table`` in the enclosing jit and XLA updates in place."""
+    rows = table.rows.at[pre.uniq].set(
+        new_rows.astype(table.rows.dtype), mode="drop")
+    slots = tmap(lambda tbl, new: tbl.at[pre.uniq].set(
+        new.astype(tbl.dtype), mode="drop"), table.slots, new_slots)
+    last = table.last_step.at[pre.uniq].set(jnp.int32(step), mode="drop")
+    return SparseTable(rows, slots, last)
+
+
+def l2_catchup(lr: float, decay: float) -> Callable:
+    """Closed-form catch-up for the idle steps' L2 decay: dense SGD+L2
+    multiplies by ``(1 - lr*decay)`` every step a row is not in the batch
+    (``Regularizer.cpp`` L2, lazy path)."""
+
+    def apply(rows, idle_steps):
+        f = (1.0 - lr * decay) ** idle_steps.astype(jnp.float32)
+        return rows * f[:, None].astype(rows.dtype)
+    return apply
+
+
+def l1_catchup(lr: float, decay: float) -> Callable:
+    """Closed-form catch-up for idle L1 shrinkage: each idle step moves the
+    weight ``lr*decay`` toward zero, stopping at zero
+    (``Regularizer.cpp`` L1, lazy path)."""
+
+    def apply(rows, idle_steps):
+        shrink = (lr * decay) * idle_steps.astype(jnp.float32)[:, None]
+        mag = jnp.maximum(jnp.abs(rows) - shrink.astype(rows.dtype), 0.0)
+        return jnp.sign(rows) * mag
+    return apply

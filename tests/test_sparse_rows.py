@@ -1,0 +1,240 @@
+"""Sparse-row update path (SelectedRows analog) — VERDICT r2 item 6.
+
+Pins the three claims of :mod:`paddle_tpu.optim.sparse`:
+1. the sparse path reproduces the dense path exactly for the lazy-correct
+   optimizers (sgd / adagrad / ftrl) on a small table;
+2. lazy L2 catch-up reproduces dense SGD+L2;
+3. nothing [vocab, D]-shaped enters the autodiff graph — every table-shaped
+   value produced inside the step is a commit scatter (the structural
+   guarantee that tables ≫ the dense-grad memory budget stay trainable,
+   reference: ``SparseRowMatrix.h:31``, ``RemoteParameterUpdater.h:265``).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import optim
+from paddle_tpu.models.ctr import (SparseRowsWideDeepCTR, WideDeepCTR,
+                                   make_sparse_ctr_step)
+from paddle_tpu.nn import costs
+from paddle_tpu.optim import sparse as sp
+from paddle_tpu.optim.optimizers import apply_updates
+
+FIELDS, VOCAB = 4, 30
+
+
+@pytest.fixture
+def nprng():
+    return np.random.RandomState(0)
+
+
+def _batches(nprng, n_steps, batch=16):
+    out = []
+    for _ in range(n_steps):
+        ids = nprng.randint(0, VOCAB, size=(batch, FIELDS)).astype(np.int32)
+        ids[nprng.rand(*ids.shape) < 0.1] = -1          # padding
+        y = (nprng.rand(batch) < 0.4).astype(np.int32)
+        out.append({"ids": jnp.asarray(ids), "label": jnp.asarray(y)})
+    return out
+
+
+def _loss(out, batch):
+    return jnp.mean(costs.binary_logistic(out, batch["label"]))
+
+
+def _init_pair(nprng, emb_dim=8):
+    """Dense model + sparse twin with identical initial values."""
+    dense = WideDeepCTR(FIELDS, VOCAB, emb_dim=emb_dim, hidden=(16,),
+                        name="ctr")
+    sparse = SparseRowsWideDeepCTR(FIELDS, VOCAB, emb_dim=emb_dim,
+                                   hidden=(16,), name="ctr")
+    ids0 = jnp.zeros((2, FIELDS), jnp.int32)
+    dvars = dense.init(jax.random.PRNGKey(0), ids0)
+    dparams = dvars["params"]
+    wide_w = dparams["ctr"]["wide"]["w"]
+    deep_w = dparams["ctr"]["deep"]["w"]
+    sparams = {"ctr": {k: v for k, v in dparams["ctr"].items()
+                       if k not in ("wide", "deep")}}
+    return dense, sparse, dparams, sparams, wide_w, deep_w
+
+
+def _run_dense(dense, dparams, optimizer, batches):
+    opt_state = optimizer.init(dparams)
+    params = dparams
+    for i, b in enumerate(batches):
+        def loss_fn(p):
+            return _loss(dense.apply({"params": p}, b["ids"]), b)
+        _, g = jax.value_and_grad(loss_fn)(params)
+        upd, opt_state = optimizer.update(g, opt_state, params,
+                                          jnp.asarray(i))
+        params = apply_updates(params, upd)
+    return params
+
+
+def _run_sparse(sparse, sparams, wide_w, deep_w, dense_opt, row_opt,
+                batches, catchup=None):
+    step = make_sparse_ctr_step(sparse, dense_opt, row_opt, _loss,
+                                catchup=catchup)
+    wide_tbl = sp.SparseTable(wide_w, row_opt.init(wide_w),
+                              jnp.full((wide_w.shape[0],), -1, jnp.int32))
+    deep_tbl = sp.SparseTable(deep_w, row_opt.init(deep_w),
+                              jnp.full((deep_w.shape[0],), -1, jnp.int32))
+    params, opt_state = sparams, dense_opt.init(sparams)
+    for i, b in enumerate(batches):
+        params, opt_state, wide_tbl, deep_tbl, loss = step(
+            params, opt_state, wide_tbl, deep_tbl, jnp.asarray(i), b)
+    return params, wide_tbl, deep_tbl, float(loss)
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adagrad", "ftrl"])
+def test_sparse_path_matches_dense(nprng, opt_name):
+    """Sparse rows == dense table training for the lazy-correct rules (the
+    local-vs-remote oracle of test_CompareSparse.cpp applied to this tier)."""
+    make = {"sgd": lambda: optim.sgd(0.1),
+            "adagrad": lambda: optim.adagrad(0.1),
+            "ftrl": lambda: optim.ftrl(0.1, lambda1=0.01, lambda2=0.01)}
+    batches = _batches(nprng, 6)
+    dense, sparse, dparams, sparams, wide_w, deep_w = _init_pair(nprng)
+    if opt_name == "ftrl":
+        # FTRL's param is a pure function of (z, n): a dense run resets
+        # untouched rows to that fixed point on the very first step, while
+        # the lazy path leaves them untouched until hit (the reference's
+        # sparse semantics). Equivalence holds from the fixed point — the
+        # standard zero init for sparse LR tables.
+        wide_w = jnp.zeros_like(wide_w)
+        deep_w = jnp.zeros_like(deep_w)
+        dparams = jax.tree_util.tree_map(lambda x: x, dparams)
+        dparams["ctr"]["wide"]["w"] = wide_w
+        dparams["ctr"]["deep"]["w"] = deep_w
+    dfinal = _run_dense(dense, dparams, make[opt_name](), batches)
+    sfinal, wide_tbl, deep_tbl, _ = _run_sparse(
+        sparse, sparams, wide_w, deep_w, make[opt_name](), make[opt_name](),
+        batches)
+    np.testing.assert_allclose(np.asarray(wide_tbl.rows),
+                               np.asarray(dfinal["ctr"]["wide"]["w"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(deep_tbl.rows),
+                               np.asarray(dfinal["ctr"]["deep"]["w"]),
+                               rtol=1e-5, atol=1e-6)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(sfinal)[0],
+            jax.tree_util.tree_flatten_with_path(
+                {"ctr": {"mlp": dfinal["ctr"]["mlp"]}})[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=str(pa))
+
+
+def test_lazy_l2_catchup_matches_dense_decay(nprng):
+    """Sparse SGD+L2 with closed-form idle catch-up == dense SGD + L2 which
+    decays every row every step (Regularizer.cpp lazy path)."""
+    lr, decay = 0.1, 0.05
+    batches = _batches(nprng, 6, batch=4)    # small batches -> idle rows
+    dense, sparse, dparams, sparams, wide_w, deep_w = _init_pair(nprng)
+    dfinal = _run_dense(dense, dparams,
+                        optim.chain(optim.weight_decay(decay),
+                                    optim.sgd(lr)), batches)
+    sfinal, wide_tbl, deep_tbl, _ = _run_sparse(
+        sparse, sparams, wide_w, deep_w,
+        optim.chain(optim.weight_decay(decay), optim.sgd(lr)),
+        optim.chain(optim.weight_decay(decay), optim.sgd(lr)),
+        batches, catchup=sp.l2_catchup(lr, decay))
+
+    # Lazy semantics: rows idle since their last touch are STALE in storage
+    # (their decay is applied at next prefetch). Equivalence is therefore a
+    # read-time property — flush the pending catch-up before comparing.
+    n = len(batches)
+
+    def flush(tbl):
+        idle = np.where(np.asarray(tbl.last_step) < 0, n,
+                        n - 1 - np.asarray(tbl.last_step))
+        f = (1.0 - lr * decay) ** idle.astype(np.float64)
+        return np.asarray(tbl.rows) * f[:, None]
+
+    np.testing.assert_allclose(flush(deep_tbl),
+                               np.asarray(dfinal["ctr"]["deep"]["w"]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(flush(wide_tbl),
+                               np.asarray(dfinal["ctr"]["wide"]["w"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_no_table_shaped_values_outside_commit(nprng):
+    """Structural memory-budget guarantee: with a table far larger than the
+    batch working set, the ONLY table-shaped values produced inside the step
+    are the commit scatters (in-place under donation). A dense-gradient
+    implementation would materialise [vocab, D] adds/selects from autodiff —
+    exactly what made tables ≫ device memory untrainable."""
+    big_vocab = 100_000
+    emb_dim = 32
+    sparse = SparseRowsWideDeepCTR(4, big_vocab // 4, emb_dim=emb_dim,
+                                   hidden=(16,), name="ctr")
+    ids = jnp.zeros((8, 4), jnp.int32)
+    batch = {"ids": ids, "label": jnp.zeros((8,), jnp.int32)}
+    sparams = sparse.init(jax.random.PRNGKey(0), ids,
+                          jnp.zeros((32, 1)), jnp.zeros((8, 4), jnp.int32),
+                          jnp.zeros((32, emb_dim)),
+                          jnp.zeros((8, 4), jnp.int32))["params"]
+    row_opt = optim.adagrad(0.1)
+    dense_opt = optim.sgd(0.1)
+    wide_w = jnp.zeros((big_vocab, 1))
+    deep_w = jnp.zeros((big_vocab, emb_dim))
+    wide_tbl = sp.SparseTable(wide_w, row_opt.init(wide_w),
+                              jnp.full((big_vocab,), -1, jnp.int32))
+    deep_tbl = sp.SparseTable(deep_w, row_opt.init(deep_w),
+                              jnp.full((big_vocab,), -1, jnp.int32))
+    step = make_sparse_ctr_step(sparse, dense_opt, row_opt, _loss)
+    jaxpr = jax.make_jaxpr(step._raw)(
+        sparams, dense_opt.init(sparams), wide_tbl, deep_tbl,
+        jnp.asarray(0), batch)
+
+    offenders = []
+
+    def walk(jpr):
+        for eqn in jpr.eqns:
+            for sub in (p for p in eqn.params.values()
+                        if hasattr(p, "jaxpr")):
+                walk(sub.jaxpr)
+            for ov in eqn.outvars:
+                shape = getattr(ov.aval, "shape", ())
+                if shape and shape[0] == big_vocab \
+                        and eqn.primitive.name != "scatter":
+                    offenders.append((eqn.primitive.name, shape))
+
+    walk(jaxpr.jaxpr)
+    assert not offenders, offenders
+
+    # and the gradient wrt rows really is [U, D]-shaped, U = ids.size
+    out = step(sparams, dense_opt.init(sparams), wide_tbl, deep_tbl,
+               jnp.asarray(0), batch)
+    assert out[2].rows.shape == (big_vocab, 1)
+    assert np.isfinite(float(out[4]))
+
+
+def test_sparse_ctr_e2e_loss_decreases(nprng):
+    """End-to-end: the sparse path actually learns (loss decreases) with
+    FTRL rows + Adam dense — the quick_start sparse acceptance run."""
+    dense, sparse, dparams, sparams, wide_w, deep_w = _init_pair(nprng)
+    rng = np.random.RandomState(1)
+    # learnable synthetic rule: label depends on one field's id parity
+    batches = []
+    for _ in range(100):
+        ids = rng.randint(0, VOCAB, size=(32, FIELDS)).astype(np.int32)
+        y = (ids[:, 0] % 2).astype(np.int32)
+        batches.append({"ids": jnp.asarray(ids), "label": jnp.asarray(y)})
+    row_opt = optim.ftrl(0.5, lambda1=0.001, lambda2=0.001)
+    step = make_sparse_ctr_step(sparse, optim.adam(1e-2), row_opt, _loss)
+    wide_tbl = sp.SparseTable(wide_w, row_opt.init(wide_w),
+                              jnp.full((wide_w.shape[0],), -1, jnp.int32))
+    deep_tbl = sp.SparseTable(deep_w, row_opt.init(deep_w),
+                              jnp.full((deep_w.shape[0],), -1, jnp.int32))
+    params, opt_state = sparams, optim.adam(1e-2).init(sparams)
+    losses = []
+    for i, b in enumerate(batches):
+        params, opt_state, wide_tbl, deep_tbl, loss = step(
+            params, opt_state, wide_tbl, deep_tbl, jnp.asarray(i), b)
+        losses.append(float(loss))
+    assert np.mean(losses[-5:]) < 0.55 * np.mean(losses[:5]), losses
