@@ -252,6 +252,48 @@ class GlobalPool(Module):
         return (jnp.max if self.kind == "max" else jnp.mean)(x, axis=(1, 2))
 
 
+@jax.custom_vjp
+def _bn_train_norm(x, mean, inv, gamma, beta):
+    """Training-mode BN normalization with a hand-written VJP.
+
+    The autodiff backward of the mean/var formulation emits 3-4 reductions
+    over the activation per BN layer; the closed-form BN backward needs
+    exactly two (sum(dy), sum(dy*xhat)) plus one elementwise pass:
+
+        dx = gamma*inv * (dy - sum(dy)/n - xhat*sum(dy*xhat)/n)
+
+    This is the *total* derivative (the mean/inv dependence on x is folded
+    in), so the bwd returns zero cotangents for mean/inv and the upstream
+    stats-backward graph dead-code-eliminates. Measured ~2x fewer BN
+    reduction passes on the ResNet-50 step (experiments/, round 3). Do not
+    differentiate through mean/inv from elsewhere — they are treated as
+    x-derived here.
+    """
+    xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+    return xhat * gamma.astype(x.dtype) + beta.astype(x.dtype)
+
+
+def _bn_train_norm_fwd(x, mean, inv, gamma, beta):
+    return _bn_train_norm(x, mean, inv, gamma, beta), (x, mean, inv, gamma)
+
+
+def _bn_train_norm_bwd(res, dy):
+    x, mean, inv, gamma = res
+    axes = tuple(range(x.ndim - 1))
+    n = x.size // x.shape[-1]
+    xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+    dbeta = jnp.sum(dy, axis=axes, dtype=jnp.float32)
+    dgamma = jnp.sum(dy * xhat, axis=axes, dtype=jnp.float32)
+    scale = (gamma * inv).astype(x.dtype)
+    dx = scale * (dy - (dbeta / n).astype(x.dtype)
+                  - xhat * (dgamma / n).astype(x.dtype))
+    return (dx, jnp.zeros_like(mean), jnp.zeros_like(inv),
+            dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype))
+
+
+_bn_train_norm.defvjp(_bn_train_norm_fwd, _bn_train_norm_bwd)
+
+
 class BatchNorm(Module):
     """Batch normalization with running stats (reference:
     ``BatchNormalizationLayer``/``CudnnBatchNormLayer``,
@@ -293,6 +335,12 @@ class BatchNorm(Module):
         # traffic of the fused elementwise under bf16); only the moment
         # reductions above need f32.
         inv = lax.rsqrt(var + self.eps)
+        if train and self.use_scale_shift:
+            # custom-VJP path: closed-form BN backward (2 reductions
+            # instead of autodiff's 3-4 — see _bn_train_norm)
+            return _bn_train_norm(x, mean, inv,
+                                  self.param("scale", I.ones, (c,)),
+                                  self.param("shift", I.zeros, (c,)))
         y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
         if self.use_scale_shift:
             y = y * self.param("scale", I.ones, (c,)).astype(x.dtype) + \

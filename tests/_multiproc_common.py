@@ -1,0 +1,88 @@
+"""Shared training routine for the real two-process jax.distributed test.
+
+Imported both by the pytest parent (single-process oracle over its local
+8-device CPU mesh) and by ``_multiproc_worker.py`` (two cooperating
+processes, 4 forced CPU devices each, global 8-device mesh) — the same
+function must produce bitwise-comparable losses either way, the
+local-vs-remote oracle of the reference's ``test_CompareSparse.cpp:144``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def run_training(mesh, ckpt_dir=None, steps=4, batch=64, dim=16, classes=10):
+    """Deterministic tiny-MLP data-parallel training over ``mesh``.
+
+    Returns {"losses": [...], "final_loss": float, "ckpt_loaded_ok": bool}.
+    Uses jax.make_array_from_callback for batches so the identical code
+    works single-process and multi-controller.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu import optim
+    from paddle_tpu.core.module import Sequential
+    from paddle_tpu.nn import costs
+    from paddle_tpu.nn.layers import Linear
+    from paddle_tpu.optim.optimizers import apply_updates
+
+    model = Sequential(Linear(32, act="relu", name="fc1"),
+                       Linear(classes, name="fc2"), name="mlp")
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(steps, batch, dim)).astype(np.float32)
+    Y = rng.randint(0, classes, size=(steps, batch)).astype(np.int32)
+
+    repl = NamedSharding(mesh, P())
+    data_sh = NamedSharding(mesh, P("data"))
+
+    def put(host, sharding):
+        host = np.asarray(host)
+        return jax.make_array_from_callback(host.shape, sharding,
+                                            lambda idx: host[idx])
+
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, dim)))
+    params = jax.tree_util.tree_map(
+        lambda x: put(np.asarray(x), repl), variables["params"])
+    opt = optim.momentum(0.1, 0.9)
+    opt_state = jax.tree_util.tree_map(
+        lambda x: put(np.asarray(x), repl), opt.init(variables["params"]))
+
+    @jax.jit
+    def step_fn(params, opt_state, sno, x, y):
+        def loss_fn(p):
+            out = model.apply({"params": p}, x)
+            return jnp.mean(costs.softmax_cross_entropy(out, y))
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        upd, new_opt = opt.update(g, opt_state, params, sno)
+        return apply_updates(params, upd), new_opt, loss
+
+    losses = []
+    for i in range(steps):
+        x = put(X[i], data_sh)
+        y = put(Y[i], data_sh)
+        params, opt_state, loss = step_fn(params, opt_state,
+                                          jnp.asarray(i), x, y)
+        losses.append(float(loss))       # replicated scalar: safe everywhere
+
+    result = {"losses": losses, "final_loss": losses[-1],
+              "ckpt_loaded_ok": None}
+
+    if ckpt_dir is not None:
+        from jax.experimental import multihost_utils
+        from paddle_tpu.train import checkpoint as ckpt_lib
+
+        host_params = jax.tree_util.tree_map(np.asarray, params)
+        ckpt_lib.save_checkpoint(ckpt_dir, 0, {"params": host_params,
+                                               "step": np.asarray(steps)})
+        if jax.process_count() > 1:
+            multihost_utils.sync_global_devices("ckpt_written")
+        loaded = ckpt_lib.load_checkpoint(ckpt_dir, 0)
+        ok = True
+        for a, b in zip(jax.tree_util.tree_leaves(loaded["params"]),
+                        jax.tree_util.tree_leaves(host_params)):
+            ok = ok and np.allclose(a, b)
+        result["ckpt_loaded_ok"] = bool(ok)
+    return result

@@ -1,0 +1,60 @@
+"""Worker process for the real two-process jax.distributed test.
+
+Launched by ``test_multiprocess.py`` as::
+
+    python tests/_multiproc_worker.py --coordinator localhost:PORT \
+        --num-processes 2 --process-id I --ckpt-dir D --out OUT.json
+
+Forces the CPU backend with 4 virtual devices per process BEFORE importing
+jax, joins the distributed runtime through the framework's own
+``parallel.multihost.initialize``, builds the global 8-device mesh, trains,
+and writes its result JSON for the parent to compare against the
+single-process oracle.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.parallel import multihost
+
+    multihost.initialize(args.coordinator,
+                         num_processes=args.num_processes,
+                         process_id=args.process_id)
+    assert multihost.is_initialized()
+    assert jax.process_count() == args.num_processes, jax.process_count()
+    assert jax.device_count() == 4 * args.num_processes, jax.device_count()
+
+    mesh = multihost.multihost_mesh()
+    assert mesh.devices.size == 4 * args.num_processes
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _multiproc_common import run_training
+
+    result = run_training(mesh, ckpt_dir=args.ckpt_dir)
+    result["process_id"] = jax.process_index()
+    result["process_count"] = jax.process_count()
+    result["local_devices"] = len(jax.local_devices())
+    with open(args.out, "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""A REAL two-process ``jax.distributed`` bring-up test — VERDICT r2 item 5.
+
+The reference tests its distributed tier with real in-process servers
+(``gserver/tests/test_CompareSparse.cpp:64-72`` spins ParameterServer2 on
+localhost ports) and real etcd (``go/pserver/client_test.go``). The TPU-native
+analog: spawn two actual OS processes, each contributing 4 virtual CPU
+devices, joined through ``parallel.multihost.initialize`` (a localhost
+coordinator), train data-parallel over the global 8-device mesh, and require
+the losses to equal a single-process 8-device run of the same code —
+plus single-writer/all-readers checkpoint behavior across the processes.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_matches_single_process(tmp_path):
+    port = _free_port()
+    nproc = 2
+    outs = [str(tmp_path / f"out{i}.json") for i in range(nproc)]
+    ckpt_dir = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(_HERE)] + env.get("PYTHONPATH", "").split(os.pathsep))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(_HERE, "_multiproc_worker.py"),
+             "--coordinator", f"localhost:{port}",
+             "--num-processes", str(nproc), "--process-id", str(i),
+             "--ckpt-dir", ckpt_dir, "--out", outs[i]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(nproc)
+    ]
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(out.decode(errors="replace"))
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"worker failed:\n{log[-4000:]}"
+
+    results = []
+    for o in outs:
+        with open(o) as f:
+            results.append(json.load(f))
+
+    # both processes saw the global topology
+    for r in results:
+        assert r["process_count"] == nproc
+        assert r["local_devices"] == 4
+        assert r["ckpt_loaded_ok"] is True   # all-readers works
+    assert {r["process_id"] for r in results} == {0, 1}
+
+    # replicated loss is identical across processes
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=0, atol=0)
+
+    # the checkpoint was written exactly once (single writer, process 0)
+    from paddle_tpu.train import checkpoint as ckpt_lib
+    assert ckpt_lib.latest_pass(ckpt_dir) == 0
+
+    # and the two-process run equals this process's single-process 8-device
+    # oracle (the local-vs-remote comparison of test_CompareSparse.cpp:144)
+    sys.path.insert(0, _HERE)
+    from _multiproc_common import run_training
+    oracle = run_training(pt.make_mesh({"data": 8}))
+    np.testing.assert_allclose(results[0]["losses"], oracle["losses"],
+                               rtol=1e-6, atol=1e-7)
