@@ -1,0 +1,47 @@
+"""Attention seq2seq config script — the acceptance NMT config from
+``BASELINE.json`` (reference: the seqToseq demo over
+``trainer_config_helpers/networks.py:1320`` ``simple_attention``).
+
+Run:  python -m paddle_tpu.train.cli --config configs/seq2seq_attention.py
+"""
+
+import numpy as np
+
+from paddle_tpu.config_helpers import (data_layer, outputs, settings,
+                                       simple_attention_seq2seq)
+
+VOCAB = 120          # ids 0=pad, 1=bos, 2=eos, 3.. tokens
+SRC_LEN = 12
+TGT_LEN = 12
+
+settings(batch_size=32, learning_rate=1e-3, optimizer="adam", num_passes=2)
+
+src = data_layer("src")
+src_len = data_layer("src_len")
+tgt = data_layer("tgt")
+tgt_len = data_layer("tgt_len")
+cost = simple_attention_seq2seq(src, src_len, tgt, tgt_len,
+                                src_vocab=VOCAB, tgt_vocab=VOCAB,
+                                emb_dim=32, hidden=64)
+outputs(cost, name="seq2seq_attention")
+
+
+def train_reader(batch_size, n_batches=16, seed=0):
+    """Synthetic copy task (the wmt14 dataprovider analog): target = bos +
+    source — learnable by the attention decoder."""
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_batches):
+            lens = rng.randint(4, SRC_LEN + 1, size=batch_size)
+            src = rng.randint(3, VOCAB, size=(batch_size, SRC_LEN))
+            pos = np.arange(SRC_LEN)[None, :]
+            src = np.where(pos < lens[:, None], src, 0)
+            tgt = np.zeros((batch_size, TGT_LEN + 1), np.int64)
+            tgt[:, 0] = 1                                  # bos
+            tgt[:, 1:] = src[:, :TGT_LEN]
+            yield {"src": src.astype(np.int32),
+                   "src_len": lens.astype(np.int32),
+                   "tgt": tgt.astype(np.int32),
+                   "tgt_len": (lens + 1).astype(np.int32)}
+    return reader

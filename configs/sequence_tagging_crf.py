@@ -1,0 +1,45 @@
+"""Sequence-tagging linear-CRF config script — the acceptance config from
+``BASELINE.json`` (reference: ``v1_api_demo/sequence_tagging/linear_crf.py``:
+sparse feature projections -> crf_layer, trained by paddle_trainer from the
+config alone).
+
+Run:  python -m paddle_tpu.train.cli --config configs/sequence_tagging_crf.py
+"""
+
+import numpy as np
+
+from paddle_tpu.config_helpers import (crf_tagging_cost, data_layer,
+                                       outputs, settings)
+
+VOCAB = 200
+NUM_TAGS = 5
+SEQ_LEN = 16
+
+settings(batch_size=32, learning_rate=0.2, optimizer="adagrad",
+         num_passes=3)
+
+tokens = data_layer("tokens")
+length = data_layer("length")
+label = data_layer("label")
+cost = crf_tagging_cost(tokens, length, label, vocab=VOCAB,
+                        num_tags=NUM_TAGS, context=2)
+outputs(cost, name="sequence_tagging_crf")
+
+
+def train_reader(batch_size, n_batches=20, seed=0):
+    """Synthetic tagging stream (the dataprovider analog,
+    ``sequence_tagging/dataprovider.py``): tag is a deterministic function
+    of the token id — learnable by the linear CRF emissions."""
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_batches):
+            toks = rng.randint(0, VOCAB, size=(batch_size, SEQ_LEN))
+            lens = rng.randint(4, SEQ_LEN + 1, size=batch_size)
+            labs = toks % NUM_TAGS
+            pos = np.arange(SEQ_LEN)[None, :]
+            labs = np.where(pos < lens[:, None], labs, -1)
+            yield {"tokens": toks.astype(np.int32),
+                   "length": lens.astype(np.int32),
+                   "label": labs.astype(np.int32)}
+    return reader

@@ -1,0 +1,61 @@
+"""SSD detection config script — the acceptance detection config from
+``BASELINE.json`` (reference: the SSD config family over
+``MultiBoxLossLayer`` / ``PriorBoxLayer`` / ``DetectionOutputLayer``).
+
+A small conv backbone produces two feature scales; ``ssd_cost`` attaches
+the multi-scale loc/conf heads, static priors, and the multibox training
+loss (hard negative mining included).
+
+Run:  python -m paddle_tpu.train.cli --config configs/ssd_detection.py
+"""
+
+import numpy as np
+
+from paddle_tpu.config_helpers import (data_layer, img_conv_layer,
+                                       img_pool_layer, outputs, settings,
+                                       ssd_cost)
+
+IMAGE = 32
+NUM_CLASSES = 4      # background=0 + 3 object classes
+MAX_BOXES = 3
+
+settings(batch_size=16, learning_rate=1e-3, optimizer="adam", num_passes=2)
+
+image = data_layer("image")
+gt_box = data_layer("gt_box")
+gt_label = data_layer("gt_label")
+
+c1 = img_conv_layer(image, 3, 16, act="relu")
+p1 = img_pool_layer(c1, 2)                      # 16x16
+c2 = img_conv_layer(p1, 3, 32, act="relu")
+f1 = img_pool_layer(c2, 2)                      # 8x8   — first SSD scale
+c3 = img_conv_layer(f1, 3, 32, act="relu")
+f2 = img_pool_layer(c3, 2)                      # 4x4   — second SSD scale
+
+cost = ssd_cost([f1, f2], gt_box, gt_label, num_classes=NUM_CLASSES,
+                feature_shapes=[(8, 8), (4, 4)], image_shape=(IMAGE, IMAGE),
+                min_sizes=[8.0, 16.0], max_sizes=[16.0, 28.0])
+outputs(cost, name="ssd_detection")
+
+
+def train_reader(batch_size, n_batches=12, seed=0):
+    """Synthetic boxes (the pascal-voc provider analog): each image has 1-3
+    axis-aligned boxes with class = quadrant-derived label."""
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_batches):
+            img = rng.normal(size=(batch_size, IMAGE, IMAGE, 3))
+            boxes = np.zeros((batch_size, MAX_BOXES, 4), np.float32)
+            labels = np.full((batch_size, MAX_BOXES), -1, np.int64)
+            for b in range(batch_size):
+                k = rng.randint(1, MAX_BOXES + 1)
+                for i in range(k):
+                    x0, y0 = rng.uniform(0, 0.6, size=2)
+                    w, h = rng.uniform(0.2, 0.4, size=2)
+                    boxes[b, i] = [x0, y0, min(x0 + w, 1.0), min(y0 + h, 1.0)]
+                    labels[b, i] = 1 + rng.randint(0, NUM_CLASSES - 1)
+            yield {"image": img.astype(np.float32),
+                   "gt_box": boxes,
+                   "gt_label": labels.astype(np.int32)}
+    return reader

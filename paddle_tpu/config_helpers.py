@@ -37,6 +37,11 @@ __all__ = [
     "simple_rnn", "lstmemory", "grumemory", "bidirectional_lstm",
     "simple_img_conv_pool", "build_network", "NetworkModule", "LayerOut",
     "reset_graph", "graph_scope",
+    # run-config surface (v1 settings()/outputs(), Flags.cpp analog)
+    "settings", "outputs", "get_run_config", "RunConfig",
+    # acceptance-set cost/composite layers
+    "classification_cost", "mse_cost", "crf_tagging_cost",
+    "simple_attention_seq2seq", "ssd_cost",
 ]
 
 
@@ -284,6 +289,18 @@ class NetworkModule(Module):
             return kwargs
         return {k: v for k, v in kwargs.items() if k in sig.parameters}
 
+    def input_names(self) -> List[str]:
+        return [n for n in self.data_names if n is not None]
+
+    def init_variables(self, rng, batch):
+        """Initialize from a dict batch keyed by data-layer names (the CLI
+        config-script contract); falls back to the single-input ``x``
+        convention when names don't match."""
+        names = self.input_names()
+        if isinstance(batch, dict) and all(n in batch for n in names):
+            return self.init(rng, *[batch[n] for n in names], train=True)
+        return self.init(rng, batch["x"], train=True)
+
     def forward(self, *inputs, **kwargs):
         feed = list(inputs)
         values: List[Any] = []
@@ -332,3 +349,169 @@ def build_network(*outputs: LayerOut, name: str = "network") -> NetworkModule:
     takes = [n[3].get("_take", -1) for n in g.nodes]
     return NetworkModule(mods, edges, names, takes,
                          [o.idx for o in outputs], name=name)
+
+
+# -- run-config surface (the v1 config-script workflow) -----------------------
+#
+# A v1 config script is a COMPLETE run description: `settings(...)` for the
+# optimizer/batch knobs (reference: trainer_config_helpers/optimizers.py
+# `settings`), the DSL graph for the model, and `outputs(cost)` to mark the
+# cost node (reference: config_parser.py `Outputs`). The CLI
+# (`python -m paddle_tpu.train.cli --config script.py`) executes the script
+# and trains it with no user code — the `paddle_trainer --config=` workflow.
+
+@dataclasses.dataclass
+class RunConfig:
+    network: "NetworkModule" = None
+    settings: dict = dataclasses.field(default_factory=dict)
+    train_reader: Any = None
+    test_reader: Any = None
+
+
+def _run_cfg() -> RunConfig:
+    if not hasattr(_tls, "run_cfg") or _tls.run_cfg is None:
+        _tls.run_cfg = RunConfig()
+    return _tls.run_cfg
+
+
+def settings(**kw) -> None:
+    """Record run settings (reference: ``settings(batch_size=...,
+    learning_rate=..., ...)`` in every v1 config script). Recognised keys:
+    batch_size, learning_rate, optimizer (name in paddle_tpu.optim),
+    num_passes, evaluator, plus free-form extras the CLI flags can read."""
+    _run_cfg().settings.update(kw)
+
+
+def outputs(*outs: LayerOut, name: str = "network") -> "NetworkModule":
+    """Freeze the graph (like :func:`build_network`) AND record it as the
+    run's network (reference: ``outputs(...)`` in config scripts)."""
+    net = build_network(*outs, name=name)
+    _run_cfg().network = net
+    return net
+
+
+def get_run_config(reset: bool = True) -> RunConfig:
+    """Collect what the config script declared (CLI entry point). The
+    script's reader callables are picked off the returned object by the CLI
+    (scripts set ``cfg = get_run_config`` indirection is NOT needed — the
+    CLI assigns script-level ``train_reader``/``test_reader`` itself)."""
+    cfg = _run_cfg()
+    if reset:
+        _tls.run_cfg = None
+    return cfg
+
+
+# -- acceptance-set cost & composite layers -----------------------------------
+
+class _FnCost(Module):
+    """Generic (out, label) -> per-example cost node."""
+
+    def __init__(self, kind: str, name=None):
+        super().__init__(name=name)
+        self.kind = kind
+
+    def forward(self, out, label):
+        from paddle_tpu.nn import costs as C
+        return {"softmax_ce": C.softmax_cross_entropy,
+                "mse": C.mse}[self.kind](out, label)
+
+
+def classification_cost(input: LayerOut, label: LayerOut,
+                        name=None) -> LayerOut:
+    """Per-example softmax cross-entropy (reference: ``classification_cost``,
+    trainer_config_helpers/layers.py)."""
+    return input.graph.add(_FnCost("softmax_ce", name=name), [input, label])
+
+
+def mse_cost(input: LayerOut, label: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(_FnCost("mse", name=name), [input, label])
+
+
+class _CrfTaggingCost(Module):
+    """Sparse linear-CRF tagger cost over (tokens, length, label)
+    (reference: ``v1_api_demo/sequence_tagging/linear_crf.py`` —
+    crf_layer + sparse feature projections)."""
+
+    def __init__(self, vocab: int, num_tags: int, context: int = 2,
+                 name=None):
+        super().__init__(name=name)
+        from paddle_tpu.models.tagging import LinearCrfTagger
+        self.tagger = LinearCrfTagger(vocab, num_tags, context=context,
+                                      name="tagger")
+
+    def forward(self, tokens, length, label, train: bool = False):
+        return self.tagger({"tokens": tokens, "length": length,
+                            "label": label}, train=train)
+
+    def decode(self, tokens, length):
+        return self.tagger.decode({"tokens": tokens, "length": length})
+
+
+def crf_tagging_cost(tokens: LayerOut, length: LayerOut, label: LayerOut,
+                     vocab: int, num_tags: int, context: int = 2,
+                     name=None) -> LayerOut:
+    """Linear-chain CRF sequence-tagging cost (reference: ``crf_layer``,
+    trainer_config_helpers/layers.py + linear_crf.py demo)."""
+    return tokens.graph.add(
+        _CrfTaggingCost(vocab, num_tags, context=context, name=name),
+        [tokens, length, label])
+
+
+class _Seq2SeqCost(Module):
+    """Attention seq2seq teacher-forcing cost over (src, src_len, tgt,
+    tgt_len) (reference: ``simple_attention``, networks.py:1320, as used by
+    the seqToseq demo)."""
+
+    def __init__(self, src_vocab: int, tgt_vocab: int, emb_dim: int = 128,
+                 hidden: int = 256, name=None):
+        super().__init__(name=name)
+        from paddle_tpu.models.seq2seq import Seq2SeqAttention
+        self.model = Seq2SeqAttention(src_vocab, tgt_vocab, emb_dim=emb_dim,
+                                      hidden=hidden, name="seq2seq")
+
+    def forward(self, src, src_len, tgt, tgt_len, train: bool = False):
+        return self.model({"src": src, "src_len": src_len, "tgt": tgt,
+                           "tgt_len": tgt_len}, train=train)
+
+
+def simple_attention_seq2seq(src: LayerOut, src_len: LayerOut,
+                             tgt: LayerOut, tgt_len: LayerOut,
+                             src_vocab: int, tgt_vocab: int,
+                             emb_dim: int = 128, hidden: int = 256,
+                             name=None) -> LayerOut:
+    """Attention encoder-decoder cost (reference: ``simple_attention``
+    recurrent group, networks.py:1320)."""
+    return src.graph.add(
+        _Seq2SeqCost(src_vocab, tgt_vocab, emb_dim=emb_dim, hidden=hidden,
+                     name=name), [src, src_len, tgt, tgt_len])
+
+
+class _SSDCost(Module):
+    """SSD heads + multibox loss over backbone feature maps
+    (reference: ``MultiBoxLossLayer`` + the SSD config family)."""
+
+    def __init__(self, num_classes, feature_shapes, image_shape, min_sizes,
+                 max_sizes=(), name=None):
+        super().__init__(name=name)
+        from paddle_tpu.models.ssd import SSDHead
+        self.head = SSDHead(num_classes, feature_shapes, image_shape,
+                            min_sizes, max_sizes, name="head")
+        self.loss = self.head.multibox_loss()
+
+    def forward(self, *args):
+        feats, (gt_boxes, gt_labels) = list(args[:-2]), args[-2:]
+        loc, conf = self.head(feats)
+        return self.loss(loc, conf, gt_boxes, gt_labels)
+
+
+def ssd_cost(features: Sequence[LayerOut], gt_boxes: LayerOut,
+             gt_labels: LayerOut, num_classes: int,
+             feature_shapes: Sequence[Tuple[int, int]],
+             image_shape: Tuple[int, int], min_sizes: Sequence[float],
+             max_sizes: Sequence[float] = (), name=None) -> LayerOut:
+    """Multi-scale SSD loc/conf heads + multibox training loss (reference:
+    the SSD detection config; ``MultiBoxLossLayer.cpp``)."""
+    return _graph_of(list(features)).add(
+        _SSDCost(num_classes, feature_shapes, image_shape, min_sizes,
+                 max_sizes, name=name),
+        list(features) + [gt_boxes, gt_labels])

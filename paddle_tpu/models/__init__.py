@@ -11,5 +11,6 @@ from .seq2seq import Seq2SeqAttention
 from .ssd import SSDHead
 from .vae import VAE, elbo_loss
 from .tagging import LinearCrfTagger, RnnCrfTagger
+from .text_cls import LSTMTextClassifier
 from .traffic import TrafficPredictor
 from .transformer import TransformerBlock, TransformerLM

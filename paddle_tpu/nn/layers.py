@@ -264,9 +264,11 @@ def _bn_train_norm(x, mean, inv, gamma, beta):
 
     This is the *total* derivative (the mean/inv dependence on x is folded
     in), so the bwd returns zero cotangents for mean/inv and the upstream
-    stats-backward graph dead-code-eliminates. Measured ~2x fewer BN
-    reduction passes on the ResNet-50 step (experiments/, round 3). Do not
-    differentiate through mean/inv from elsewhere — they are treated as
+    stats-backward graph dead-code-eliminates. (On the ResNet-50 step XLA's
+    fusion already absorbed most of the difference — measured perf-neutral,
+    experiments/ round 3 — but the backward HLO is structurally minimal and
+    numerically pinned by test_batchnorm_custom_vjp_matches_autodiff.) Do
+    not differentiate through mean/inv from elsewhere — they are treated as
     x-derived here.
     """
     xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)

@@ -34,6 +34,7 @@ __all__ = ["TrainCliFlags", "run", "main"]
 @dataclasses.dataclass
 class TrainCliFlags(TrainerFlags):
     model_config: str = ""           # IR json file, or an export()ed dir
+    config: str = ""                 # v1-style DSL config SCRIPT (.py)
     dataset: str = "mnist"           # name in paddle_tpu.data.datasets
     optimizer: str = "adam"          # name in paddle_tpu.optim
     loss: str = "softmax_ce"         # softmax_ce | mse
@@ -76,14 +77,110 @@ def _make_loss(name: str):
     raise SystemExit(f"unknown loss {name!r}")
 
 
+def _make_evaluator(name):
+    from paddle_tpu.train import evaluators as ev
+    table = {"classification_error": ev.ClassificationError,
+             "auc": ev.Auc, "chunk": ev.ChunkEvaluator}
+    if name in (None, "", "none"):
+        return None
+    if name not in table:
+        raise SystemExit(f"unknown evaluator {name!r}")
+    return table[name]()
+
+
+def run_config_script(flags: TrainCliFlags) -> dict:
+    """Execute a v1-style DSL config SCRIPT and train it — the
+    ``paddle_trainer --config=trainer_config.py`` workflow (reference:
+    ``TrainerMain.cpp:17`` embedding CPython to run ``parse_config``).
+
+    The script (see ``configs/``) uses ``paddle_tpu.config_helpers``:
+    ``settings(...)`` for run knobs, the layer DSL for the model, and
+    ``outputs(cost_node)``; it defines ``train_reader`` (and optionally
+    ``test_reader``) callables yielding dict batches keyed by data-layer
+    names — the ``@provider`` analog living next to the config, exactly as
+    the reference paired config scripts with dataprovider scripts.
+    """
+    import contextlib
+
+    from paddle_tpu import config_helpers as H
+    from paddle_tpu.core import dtypes
+
+    ns = {"__name__": "__paddle_tpu_config__",
+          "__file__": os.path.abspath(flags.config)}
+    with open(flags.config) as f:
+        code = compile(f.read(), flags.config, "exec")
+    H.get_run_config(reset=True)       # drop any stale state
+    exec(code, ns)                     # the config IS a program (v1 semantics)
+    cfg = H.get_run_config(reset=True)
+    if cfg.network is None:
+        raise SystemExit(f"{flags.config} never called outputs(...)")
+    if "train_reader" not in ns:
+        raise SystemExit(f"{flags.config} must define train_reader()")
+    net = cfg.network
+    s = cfg.settings
+    input_names = [n for n in net.data_names if n is not None]
+
+    # Precedence: an explicitly-passed flag (CLI/env/json) beats the
+    # script's settings(); otherwise the script wins over the flag default
+    # (the reference's gflags-beat-config ordering, utils/Flags.cpp).
+    explicit = getattr(flags, "_explicit", frozenset())
+
+    def pick(key, flag_val):
+        if key in explicit:
+            return flag_val
+        return s.get(key, flag_val)
+
+    def net_forward(model, variables, batch, train, rngs):
+        args = [batch[n] for n in input_names]
+        if train:
+            out, new = model.apply(variables, *args, train=True,
+                                   mutable=("state",), rngs=rngs)
+            return out, new.get("state", {})
+        return model.apply(variables, *args), variables.get("state", {})
+
+    batch_size = int(pick("batch_size", flags.batch_size))
+    reader = ns["train_reader"](batch_size)
+    trainer = Trainer(
+        model=net,
+        loss_fn=lambda out, b: out,    # cost layers return per-example costs
+        optimizer=_make_optimizer(
+            pick("optimizer", flags.optimizer),
+            float(pick("learning_rate", flags.learning_rate))),
+        forward=net_forward,
+        evaluator=_make_evaluator(s.get("evaluator")),
+        nan_check=flags.nan_check,
+        param_stats_period=flags.param_stats_period or None)
+    last = {}
+
+    def handler(e):
+        from paddle_tpu.train import events as ev
+        if isinstance(e, ev.EndPass):
+            last.update(e.metrics)
+
+    policy = (dtypes.use_policy(dtypes.bfloat16_compute)
+              if flags.use_bf16 else contextlib.nullcontext())
+    num_passes = int(pick("num_passes", flags.num_passes))
+    with policy:
+        trainer.init(jax.random.PRNGKey(flags.seed), next(iter(reader())))
+        trainer.train(
+            reader, num_passes=num_passes, event_handler=handler,
+            checkpoint_dir=flags.checkpoint_dir or None,
+            checkpoint_keep=flags.checkpoint_keep,
+            saving_period=flags.saving_period or None,
+            log_period=flags.log_period, resume=flags.resume)
+    return last
+
+
 def run(flags: TrainCliFlags) -> dict:
     """Build everything from config and train; returns final pass metrics."""
     import contextlib
 
     from paddle_tpu.core import dtypes
 
+    if flags.config:
+        return run_config_script(flags)
     if not flags.model_config:
-        raise SystemExit("--model_config is required")
+        raise SystemExit("--model_config or --config is required")
     model = _load_model(flags.model_config, flags.trusted_config)
     reader = _make_reader(flags.dataset, flags.batch_size)
     trainer = Trainer(

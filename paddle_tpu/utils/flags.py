@@ -117,7 +117,12 @@ def parse_flags(cls: Type[T] = TrainerFlags,
         cli = getattr(ns, f.name)
         if cli is not None:
             values[f.name] = _coerce(hints[f.name], cli)
-    return cls(**values)
+    out = cls(**values)
+    # Which fields were explicitly set (CLI/env/json, any source) — lets
+    # consumers implement "explicit flag beats script settings()" without
+    # guessing from defaults (cli.run_config_script uses this).
+    object.__setattr__(out, "_explicit", frozenset(values))
+    return out
 
 
 def flags_to_json(flags) -> str:

@@ -1,0 +1,53 @@
+"""The acceptance-set config scripts train through the CLI with no user
+code — VERDICT r2 item 7 (reference workflow: ``paddle_trainer
+--config=trainer_config.py``; configs in ``configs/`` mirror
+``v1_api_demo/sequence_tagging/linear_crf.py``, the seqToseq attention
+config, and the SSD config family)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.train import cli
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, extra=()):
+    flags = cli.parse_flags(
+        cli.TrainCliFlags,
+        ["--config", os.path.join(_REPO, "configs", script),
+         "--log_period", "0", *extra])
+    return cli.run(flags)
+
+
+def test_crf_tagging_config_trains():
+    metrics = _run("sequence_tagging_crf.py")
+    assert np.isfinite(metrics["mean_cost"])
+    # the tag rule is deterministic: 3 passes must cut the NLL sharply
+    first = _run("sequence_tagging_crf.py", ["--num_passes", "1"])
+    assert metrics["mean_cost"] < first["mean_cost"]
+
+
+def test_seq2seq_attention_config_trains():
+    metrics = _run("seq2seq_attention.py")
+    assert np.isfinite(metrics["mean_cost"])
+
+
+def test_ssd_detection_config_trains():
+    metrics = _run("ssd_detection.py")
+    assert np.isfinite(metrics["mean_cost"])
+
+
+def test_config_script_missing_outputs_rejected(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("from paddle_tpu.config_helpers import *\n"
+                   "settings(batch_size=4)\n"
+                   "def train_reader(bs):\n"
+                   "    def r():\n"
+                   "        yield {}\n"
+                   "    return r\n")
+    flags = cli.parse_flags(cli.TrainCliFlags, ["--config", str(bad)])
+    with pytest.raises(SystemExit, match="outputs"):
+        cli.run(flags)
