@@ -68,11 +68,40 @@ def _read_idx_labels(path):
         return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int32)
 
 
+# Public MNIST idx files (stable S3 mirror) + their well-known md5s — the
+# URL/md5 table the reference keeps per dataset module (common.py pattern).
+_MNIST_URLS = {
+    "train-images-idx3-ubyte.gz": "f68b3c2dcbeaaa9fbdd348bbdeb94873",
+    "train-labels-idx1-ubyte.gz": "d53e105ee54ea40749a09fcbcd1e9432",
+    "t10k-images-idx3-ubyte.gz": "9fb629c4189551a2d022fa330f9573f3",
+    "t10k-labels-idx1-ubyte.gz": "ec29112dd5afa0611ce80d1b7f02629c",
+}
+_MNIST_BASE = "https://ossci-datasets.s3.amazonaws.com/mnist/"
+
+
+def _try_download_mnist(split):
+    from .download import DownloadDisabled, download, downloads_enabled
+    if not downloads_enabled():
+        return
+    names = (["train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"]
+             if split == "train" else
+             ["t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"])
+    try:
+        for n in names:
+            download(_MNIST_BASE + n, "mnist", _MNIST_URLS[n])
+    except (DownloadDisabled, IOError):
+        pass                            # loader falls back to synthetic
+
+
 def mnist(split: str = "train", synthetic_n: Optional[int] = None):
     """MNIST reader (reference: ``v2/dataset/mnist.py``) yielding
-    ``(image [28,28,1] float32 in [-1,1], label int)``. Falls back to a
-    deterministic synthetic set when the idx files aren't cached locally."""
+    ``(image [28,28,1] float32 in [-1,1], label int)``. Auto-downloads into
+    the cache when ``PADDLE_TPU_AUTO_DOWNLOAD=1`` (``data/download.py``, the
+    common.py analog); otherwise falls back to a deterministic synthetic set
+    when the idx files aren't cached locally."""
     imgs_p, lbls_p = _mnist_files(split)
+    if not (os.path.exists(imgs_p) and os.path.exists(lbls_p)):
+        _try_download_mnist(split)
     if os.path.exists(imgs_p) and os.path.exists(lbls_p):
         images = _read_idx_images(imgs_p)
         labels = _read_idx_labels(lbls_p)
@@ -91,13 +120,33 @@ def mnist(split: str = "train", synthetic_n: Optional[int] = None):
     return reader
 
 
+_CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+_CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
+
+
+def _try_download_cifar10():
+    from .download import DownloadDisabled, download, downloads_enabled
+    if not downloads_enabled():
+        return
+    try:
+        tar = download(_CIFAR10_URL, "cifar", _CIFAR10_MD5)
+    except (DownloadDisabled, IOError):
+        return
+    import tarfile
+    with tarfile.open(tar, "r:gz") as tf:
+        tf.extractall(data_home(), filter="data")
+
+
 def cifar10(split: str = "train", synthetic_n: Optional[int] = None):
     """CIFAR-10 reader (reference: ``v2/dataset/cifar.py``) yielding
-    ``(image [32,32,3], label)``; synthetic fallback."""
+    ``(image [32,32,3], label)``; auto-download via ``data/download.py``
+    when enabled, synthetic fallback otherwise."""
     base = os.path.join(data_home(), "cifar-10-batches-py")
     files = ([f"data_batch_{i}" for i in range(1, 6)] if split == "train"
              else ["test_batch"])
     paths = [os.path.join(base, f) for f in files]
+    if not all(os.path.exists(p) for p in paths):
+        _try_download_cifar10()
     if all(os.path.exists(p) for p in paths):
         import pickle
         xs, ys = [], []
@@ -154,12 +203,80 @@ def uci_housing(split: str = "train"):
     return reader
 
 
+_IMDB_URL = "https://ai.stanford.edu/~amaas/data/sentiment/aclImdb_v1.tar.gz"
+_IMDB_MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
+
+def _imdb_tar_path():
+    from .download import DownloadDisabled, download, downloads_enabled
+    path = os.path.join(data_home(), "imdb", "aclImdb_v1.tar.gz")
+    if os.path.exists(path):
+        return path
+    if downloads_enabled():
+        try:
+            return download(_IMDB_URL, "imdb", _IMDB_MD5)
+        except (DownloadDisabled, IOError):
+            pass
+    return None
+
+
+def _imdb_real(split, vocab_size, max_len):
+    """Parse the aclImdb tarball (reference: ``v2/dataset/imdb.py`` —
+    tokenize, build the frequency word dict from train, map to ids).
+    Returns (samples, labels) lists or None when no tarball is cached."""
+    tar_path = _imdb_tar_path()
+    if tar_path is None:
+        return None
+    import collections
+    import re
+    import tarfile
+    token_re = re.compile(r"[a-z']+")
+
+    def docs(section):
+        with tarfile.open(tar_path, "r:gz") as tf:
+            for m in tf:
+                parts = m.name.split("/")
+                if len(parts) == 4 and parts[1] == section and \
+                        parts[2] in ("pos", "neg") and m.isfile():
+                    text = tf.extractfile(m).read().decode(
+                        "utf-8", errors="replace").lower()
+                    yield token_re.findall(text), int(parts[2] == "pos")
+
+    freq = collections.Counter()
+    for toks, _ in docs("train"):
+        freq.update(toks)
+    # id 0 = <unk>; 1..vocab_size-1 = most frequent words (dict order of the
+    # reference's build_dict: frequency desc, word asc for ties)
+    vocab = {w: i + 1 for i, (w, _) in enumerate(
+        sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        [:vocab_size - 1])}
+    samples, labels = [], []
+    for toks, lab in docs("train" if split == "train" else "test"):
+        ids = np.asarray([vocab.get(t, 0) for t in toks[:max_len]], np.int32)
+        samples.append(ids)
+        labels.append(lab)
+    return samples, labels
+
+
 def imdb(split: str = "train", vocab_size: int = 5000, max_len: int = 100,
          synthetic_n: Optional[int] = None):
     """IMDB sentiment (reference: ``v2/dataset/imdb.py``) yielding
-    ``(token_ids varying-length, label 0/1)``. Synthetic fallback generates
-    label-correlated token distributions (positive reviews draw from the upper
-    vocab half more often) so models actually learn."""
+    ``(token_ids varying-length, label 0/1)``. Uses the real aclImdb corpus
+    when cached or downloadable (``PADDLE_TPU_AUTO_DOWNLOAD=1``); synthetic
+    fallback generates label-correlated token distributions (positive
+    reviews draw from the upper vocab half more often) so models actually
+    learn."""
+    real = _imdb_real(split, vocab_size, max_len)
+    if real is not None:
+        samples, labels = real
+
+        def reader():
+            for ids, lab in zip(samples, labels):
+                yield ids, lab
+        reader.is_synthetic = False
+        reader.num_samples = len(labels)
+        return reader
+
     n = synthetic_n or (4096 if split == "train" else 1024)
 
     def reader():
