@@ -3,12 +3,20 @@ axis — a forward-looking capability (the 2017 reference has no MoE; the
 mesh declares the axis, ``core/mesh.py``, and this layer is what uses it).
 
 TPU-native shape: the classic static dispatch/combine einsum formulation —
-top-1 routing with a fixed per-expert capacity, dispatch as a one-hot
+top-k routing with a fixed per-expert capacity, dispatch as a one-hot
 [tokens, experts, capacity] tensor, expert FFNs batched over the expert
 dimension. Everything is dense matmuls with static shapes (MXU-friendly, no
 sorting/gathering), and sharding the expert-major weights/activations over
 the ``expert`` axis (see :func:`moe_sharding_rules`) makes XLA insert the
 token all-to-alls over ICI.
+
+Routing is top-k (k static; k=1 is the Switch formulation, k=2 the classic
+GShard/expert-choice-free variant): each token's k expert choices claim
+capacity slots in choice-major order (first choices of all tokens beat
+second choices — the standard priority), gates optionally renormalized over
+the kept choices. Overflowing (token, choice) pairs are dropped
+(contribute zero), and the layer REPORTS the drop rate instead of hiding it
+(``return_stats=True``).
 """
 
 from __future__ import annotations
@@ -25,29 +33,37 @@ __all__ = ["MoEFFN", "moe_sharding_rules"]
 
 
 class MoEFFN(Module):
-    """Top-1 routed expert FFN: ``x [B, T, D] -> [B, T, D]``.
+    """Top-k routed expert FFN: ``x [B, T, D] -> [B, T, D]``.
 
     ``capacity_factor`` sizes each expert's token buffer
-    (``C = ceil(tokens/experts * factor)``); overflowing tokens are dropped
-    (contribute zero — the standard static-capacity trade).
-    ``forward(x, return_aux=True)`` also returns the Switch-style
-    load-balancing auxiliary loss to add to the training objective."""
+    (``C = ceil(tokens*k/experts * factor)``); overflowing (token, choice)
+    pairs are dropped (contribute zero — the standard static-capacity
+    trade). ``forward(x, return_aux=True)`` also returns the Switch-style
+    load-balancing auxiliary loss; ``return_stats=True`` additionally
+    returns routing telemetry: ``drop_rate`` (fraction of token-choices
+    that overflowed) and ``expert_fraction`` (per-expert token share).
+    """
 
     def __init__(self, num_experts: int, hidden: int,
                  capacity_factor: float = 1.25, act: str = "gelu",
-                 name=None):
+                 top_k: int = 1, renormalize: bool = True, name=None):
         super().__init__(name=name)
+        assert 1 <= top_k <= num_experts
         self.num_experts = num_experts
         self.hidden = hidden
         self.capacity_factor = capacity_factor
         self.act_name = act
+        self.top_k = top_k
+        self.renormalize = renormalize
 
-    def forward(self, x, return_aux: bool = False):
+    def forward(self, x, return_aux: bool = False,
+                return_stats: bool = False):
         from . import activations
         B, T, D = x.shape
         E = self.num_experts
+        K = self.top_k
         N = B * T
-        C = max(1, math.ceil(N / E * self.capacity_factor))
+        C = max(1, math.ceil(N * K / E * self.capacity_factor))
         act = activations.get(self.act_name)
 
         wg = self.param("wg", I.xavier_uniform, (D, E))
@@ -59,19 +75,33 @@ class MoEFFN(Module):
         xf = x.reshape(N, D)
         logits = xf @ wg                                    # [N, E]
         probs = jax.nn.softmax(logits, axis=-1)
-        expert = jnp.argmax(probs, axis=-1)                 # [N]
-        gate = jnp.max(probs, axis=-1)                      # [N]
-        # Routing bookkeeping stays int32 regardless of x.dtype: a bf16
-        # cumsum only counts exactly to 256, which would collide capacity
-        # slots on real batch sizes.
-        onehot_i = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [N, E]
-        pos = jnp.cumsum(onehot_i, axis=0) * onehot_i - 1      # [N, E]
-        kept = (pos < C) & (onehot_i > 0)
-        pos_c = jnp.clip(pos, 0, C - 1)
-        pos_onehot = jax.nn.one_hot(pos_c, C, dtype=x.dtype)   # [N, E, C]
-        dispatch = pos_onehot * kept.astype(x.dtype)[..., None]
-        combine = dispatch * gate.astype(x.dtype)[:, None, None]
-        onehot = onehot_i.astype(jnp.float32)
+        top_gates, top_idx = jax.lax.top_k(probs, K)        # [N, K]
+        if self.renormalize and K > 1:
+            top_gates = top_gates / jnp.maximum(
+                jnp.sum(top_gates, axis=-1, keepdims=True), 1e-9)
+
+        # Capacity assignment, choice-major priority: all first choices
+        # claim slots before any second choice. Routing bookkeeping stays
+        # int32 regardless of x.dtype: a bf16 cumsum only counts exactly to
+        # 256, which would collide capacity slots on real batch sizes.
+        counts = jnp.zeros((E,), jnp.int32)                 # slots used
+        dispatch = jnp.zeros((N, E, C), x.dtype)
+        combine = jnp.zeros((N, E, C), x.dtype)
+        kept_total = jnp.zeros((), jnp.int32)
+        for j in range(K):                                  # K is static
+            onehot_j = jax.nn.one_hot(top_idx[:, j], E, dtype=jnp.int32)
+            pos_j = (jnp.cumsum(onehot_j, axis=0) - 1
+                     + counts[None, :]) * onehot_j          # [N, E]
+            kept = (pos_j < C) & (onehot_j > 0)
+            pos_c = jnp.clip(pos_j, 0, C - 1)
+            pos_onehot = jax.nn.one_hot(pos_c, C, dtype=x.dtype)  # [N, E, C]
+            disp_j = pos_onehot * kept.astype(x.dtype)[..., None]
+            dispatch = dispatch + disp_j
+            combine = combine + disp_j * top_gates[:, j, None, None].astype(
+                x.dtype)
+            counts = counts + jnp.sum(onehot_j * kept.astype(jnp.int32),
+                                      axis=0)
+            kept_total = kept_total + jnp.sum(kept.astype(jnp.int32))
 
         # [E, C, D] expert inputs; batched expert FFN; combine back
         expert_in = jnp.einsum("nd,nec->ecd", xf, dispatch)
@@ -80,12 +110,24 @@ class MoEFFN(Module):
         out = jnp.einsum("ecd,nec->nd", expert_out, combine)
 
         out = out.reshape(B, T, D)
-        if not return_aux:
+        if not (return_aux or return_stats):
             return out
-        # Switch-style load-balance aux: E * sum_e (frac_tokens_e * mean_prob_e)
-        frac = jnp.mean(onehot, axis=0)
+        # Switch-style load-balance aux over FIRST choices:
+        # E * sum_e (frac_tokens_e * mean_prob_e)
+        onehot1 = jax.nn.one_hot(top_idx[:, 0], E, dtype=jnp.float32)
+        frac = jnp.mean(onehot1, axis=0)
         mean_prob = jnp.mean(probs.astype(jnp.float32), axis=0)
-        return out, E * jnp.sum(frac * mean_prob)
+        aux = E * jnp.sum(frac * mean_prob)
+        if not return_stats:
+            return out, aux
+        stats = {
+            "drop_rate": 1.0 - kept_total.astype(jnp.float32) / (N * K),
+            "expert_fraction": frac,
+            "capacity": jnp.asarray(C, jnp.int32),
+        }
+        if not return_aux:
+            return out, stats
+        return out, aux, stats
 
 
 def moe_sharding_rules(expert_axis: str = "expert"):

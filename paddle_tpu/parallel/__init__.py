@@ -21,12 +21,14 @@ from .ring import ring_attention, make_ring_attention
 from .ulysses import ulysses_attention, make_ulysses_attention
 from .multihost import (initialize, is_initialized,
                         host_sharded_reader, multihost_mesh)
-from .pipeline import pipeline_apply, make_pipeline
+from .pipeline import (pipeline_apply, make_pipeline,
+                       pipeline_grads_1f1b, make_pipeline_1f1b)
 
 __all__ = [
     "ShardingRules", "spec_tree", "named_shardings", "shard_tree",
     "sharded_init", "ring_attention", "make_ring_attention",
     "ulysses_attention", "make_ulysses_attention", "initialize",
-    "pipeline_apply", "make_pipeline",
+    "pipeline_apply", "make_pipeline", "pipeline_grads_1f1b",
+    "make_pipeline_1f1b",
     "is_initialized", "host_sharded_reader", "multihost_mesh",
 ]

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_apply", "make_pipeline"]
+__all__ = ["pipeline_apply", "make_pipeline", "pipeline_grads_1f1b",
+           "make_pipeline_1f1b"]
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x, axis_name: str,
@@ -99,3 +100,104 @@ def make_pipeline(mesh: Mesh, stage_fn: Callable, pipe_axis: str = "pipe"):
         inner, mesh=mesh,
         in_specs=(P(pipe_axis), P()),
         out_specs=P())
+
+
+def pipeline_grads_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
+                        x, axis_name: str, axis_size: int):
+    """One-forward-one-backward (1F1B) training schedule — call INSIDE
+    shard_map. Returns ``(total_loss, param_grads)`` for
+    ``sum_m loss_fn(pipeline(x[m]))``.
+
+    Where GPipe (``pipeline_apply`` + ``jax.grad``) runs all M forwards then
+    all M backwards and therefore holds M microbatches of saved activations
+    per stage, 1F1B interleaves: on the standard half-step grid, stage ``s``
+    runs forward #i at t = s + 2i and backward #i at t = 2S-1-s + 2i, so at
+    most S microbatches are ever in flight and the stage-input buffer is a
+    fixed ``[S, ...]`` ring regardless of M — the schedule that makes
+    M >> S gradient accumulation memory-feasible. The backward recomputes
+    the stage forward from its saved INPUT (`jax.vjp` at backward time):
+    boundary-only saving + in-stage rematerialisation, the standard
+    memory/FLOP trade.
+    """
+    S = axis_size
+    stage = lax.axis_index(axis_name)
+    M = x.shape[0]
+    T = 2 * M + 2 * S - 2                     # last event: t = 2M + 2S - 3
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    # Probes derive every buffer from device-varying values (shard_map
+    # varying-axes rule, same trick as pipeline_apply / ring.py).
+    y0 = stage_fn(stage_params, x[0])
+    act0 = y0 * 0.0                               # inter-stage activation
+    cot0 = y0 * 0.0                               # inter-stage cotangent
+    abuf0 = jnp.broadcast_to((y0 * 0.0)[None], (S,) + y0.shape)
+    grad0 = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+    loss0 = jnp.sum(y0) * 0.0
+
+    def body(t, carry):
+        fwd_act, bwd_cot, abuf, gacc, lacc = carry
+
+        # -- forward event: t == stage + 2*fi -------------------------------
+        df = t - stage
+        fi = df // 2
+        fwd_on = (df >= 0) & (df % 2 == 0) & (fi < M)
+        f_in = jnp.where(stage == 0, x[jnp.clip(fi, 0, M - 1)], fwd_act)
+        abuf = jnp.where(fwd_on, abuf.at[fi % S].set(f_in), abuf)
+        y = stage_fn(stage_params, f_in)
+        send_f = jnp.where(fwd_on, y, y * 0.0)
+
+        # -- backward event: t == 2S-1-stage + 2*bi -------------------------
+        db = t - (2 * S - 1 - stage)
+        bi = db // 2
+        bwd_on = (db >= 0) & (db % 2 == 0) & (bi < M)
+        b_in = abuf[jnp.clip(bi, 0, M - 1) % S]
+
+        def fwd_loss(p, a):
+            out = stage_fn(p, a)
+            # last stage closes the loss; others forward the cotangent
+            l = loss_fn(out)
+            return jnp.where(stage == S - 1, l, jnp.sum(out * bwd_cot)), l
+
+        val, vjp, l = jax.vjp(fwd_loss, stage_params, b_in, has_aux=True)
+        dparams, dact = vjp(jnp.ones_like(val))
+        gacc = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(bwd_on, d, 0.0), gacc, dparams)
+        lacc = lacc + jnp.where(bwd_on & (stage == S - 1), l, 0.0)
+        send_b = jnp.where(bwd_on, dact, dact * 0.0)
+
+        # -- hops ------------------------------------------------------------
+        fwd_act_next = lax.ppermute(send_f, axis_name, fwd_perm)
+        bwd_cot_next = lax.ppermute(send_b, axis_name, bwd_perm)
+        return fwd_act_next, bwd_cot_next, abuf, gacc, lacc
+
+    _, _, _, grads, loss = lax.fori_loop(
+        0, T, body, (act0, cot0, abuf0, grad0, loss0))
+    return lax.psum(loss, axis_name), grads
+
+
+def make_pipeline_1f1b(mesh: Mesh, stage_fn: Callable, loss_fn: Callable,
+                       pipe_axis: str = "pipe"):
+    """Wrap :func:`pipeline_grads_1f1b` in shard_map over ``mesh``.
+
+    Takes GLOBAL arrays (``stage_params`` [S, ...] sharded over
+    ``pipe_axis``; ``x`` [M, mb, ...] replicated) and returns
+    ``(total_loss, grads)`` with grads in the same stage-stacked sharded
+    layout as the params — ready for any :mod:`paddle_tpu.optim` rule.
+    ``loss_fn(out_mb) -> scalar`` is the per-microbatch loss applied at the
+    last stage (sum-reduced over microbatches)."""
+    try:
+        from jax import shard_map
+    except ImportError:            # older jax
+        from jax.experimental.shard_map import shard_map
+
+    S = dict(zip(mesh.axis_names, mesh.devices.shape))[pipe_axis]
+
+    def inner(stage_params, x):
+        squeezed = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        loss, grads = pipeline_grads_1f1b(stage_fn, loss_fn, squeezed, x,
+                                          pipe_axis, S)
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+    return shard_map(inner, mesh=mesh, in_specs=(P(pipe_axis), P()),
+                     out_specs=(P(), P(pipe_axis)))
