@@ -709,7 +709,12 @@ class MaxIdPrinter(_Printer):
 
 class SequenceTextPrinter(_Printer):
     """Maps id sequences through a vocabulary and logs the text (reference:
-    ``SequenceTextPrinter``, ``Evaluator.cpp:1192``)."""
+    ``SequenceTextPrinter``, ``Evaluator.cpp:1192``).
+
+    Debugging tool, like the reference's whole printer family: ``_format``
+    does per-token host-side Python string work on every batch it sees —
+    attach it to small evaluation/inspection runs, never inside the hot
+    training loop."""
 
     def __init__(self, vocab=None, name="seq_text_printer", sink=None):
         super().__init__(name, sink)
