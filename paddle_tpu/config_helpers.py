@@ -515,3 +515,145 @@ def ssd_cost(features: Sequence[LayerOut], gt_boxes: LayerOut,
         _SSDCost(num_classes, feature_shapes, image_shape, min_sizes,
                  max_sizes, name=name),
         list(features) + [gt_boxes, gt_labels])
+
+
+# -- thin wrappers widening the v1 DSL surface --------------------------------
+# (reference: trainer_config_helpers/layers.py — ~100 one-module wrappers;
+# each maps 1:1 onto a library Module, so the DSL name set keeps growing at
+# near-zero cost. All follow the same shape: append one node.)
+
+def maxout_layer(input: LayerOut, groups: int, name=None) -> LayerOut:
+    return input.graph.add(L.Maxout(groups, name=name), [input])
+
+
+def bias_layer(input: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(L.Bias(name=name), [input])
+
+
+def scale_shift_layer(input: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(L.ScaleShift(name=name), [input])
+
+
+def interpolation_layer(a: LayerOut, b: LayerOut, w: LayerOut,
+                        name=None) -> LayerOut:
+    return a.graph.add(L.Interpolation(name=name), [a, b, w])
+
+
+def power_layer(input: LayerOut, p: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(L.Power(name=name), [input, p])
+
+
+def scaling_layer(input: LayerOut, s: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(L.Scaling(name=name), [input, s])
+
+
+def slope_intercept_layer(input: LayerOut, slope: float = 1.0,
+                          intercept: float = 0.0, name=None) -> LayerOut:
+    return input.graph.add(L.SlopeIntercept(slope, intercept, name=name),
+                           [input])
+
+
+def sum_to_one_norm_layer(input: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(L.SumToOneNorm(name=name), [input])
+
+
+def row_l2_norm_layer(input: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(L.RowL2Norm(name=name), [input])
+
+
+def l2_distance_layer(a: LayerOut, b: LayerOut, name=None) -> LayerOut:
+    return a.graph.add(L.L2Distance(name=name), [a, b])
+
+
+def outer_prod_layer(a: LayerOut, b: LayerOut, name=None) -> LayerOut:
+    return a.graph.add(L.OuterProd(name=name), [a, b])
+
+
+def conv_shift_layer(a: LayerOut, b: LayerOut, name=None) -> LayerOut:
+    return a.graph.add(L.ConvShift(name=name), [a, b])
+
+
+def pad_layer(input: LayerOut, pad, name=None) -> LayerOut:
+    return input.graph.add(L.Pad2D(pad, name=name), [input])
+
+
+def crop_layer(input: LayerOut, offsets, shape, name=None) -> LayerOut:
+    return input.graph.add(L.Crop2D(offsets, shape, name=name), [input])
+
+
+def resize_layer(input: LayerOut, size, name=None) -> LayerOut:
+    return input.graph.add(L.Resize(size, name=name), [input])
+
+
+def rotate_layer(input: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(L.Rotate(name=name), [input])
+
+
+def multiplex_layer(index: LayerOut, inputs: Sequence[LayerOut],
+                    name=None) -> LayerOut:
+    return index.graph.add(L.Multiplex(name=name), [index] + list(inputs))
+
+
+def featuremap_expand_layer(input: LayerOut, num: int, name=None) -> LayerOut:
+    return input.graph.add(L.FeatureMapExpand(num, name=name), [input])
+
+
+def block_expand_layer(input: LayerOut, block, stride=None,
+                       name=None) -> LayerOut:
+    return input.graph.add(L.BlockExpand(block, stride, name=name), [input])
+
+
+def spp_layer(input: LayerOut, levels: int = 3, pool_type: str = "max",
+              name=None) -> LayerOut:
+    """Pyramid levels are powers of two: level l pools a 2^l x 2^l grid
+    (reference: SpatialPyramidPoolLayer pyramid_height)."""
+    return input.graph.add(L.SpatialPyramidPool(levels, pool_type,
+                                                name=name), [input])
+
+
+def img_cmrnorm_layer(input: LayerOut, size: int = 5, name=None) -> LayerOut:
+    return input.graph.add(L.CrossMapNormal(size, name=name), [input])
+
+
+def row_conv_layer(input: LayerOut, future: int, name=None) -> LayerOut:
+    return input.graph.add(L.RowConv(future, name=name), [input])
+
+
+def depthwise_conv_layer(input: LayerOut, filter_size, multiplier: int = 1,
+                         stride=1, act: str = "", name=None) -> LayerOut:
+    return input.graph.add(
+        L.DepthwiseConv2D(multiplier, kernel=filter_size, stride=stride,
+                          act=act, name=name), [input])
+
+
+def img_conv_transpose_layer(input: LayerOut, filter_size, num_filters: int,
+                             stride=1, act: str = "", name=None) -> LayerOut:
+    return input.graph.add(
+        L.Conv2DTranspose(num_filters, kernel=filter_size, stride=stride,
+                          act=act, name=name), [input])
+
+
+def layer_norm_layer(input: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(L.LayerNorm(name=name), [input])
+
+
+def global_pool_layer(input: LayerOut, pool_type: str = "avg",
+                      name=None) -> LayerOut:
+    return input.graph.add(L.GlobalPool(pool_type, name=name), [input])
+
+
+def sampling_id_layer(input: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(L.SamplingId(name=name), [input])
+
+
+__all__ += [
+    "maxout_layer", "bias_layer", "scale_shift_layer", "interpolation_layer",
+    "power_layer", "scaling_layer", "slope_intercept_layer",
+    "sum_to_one_norm_layer", "row_l2_norm_layer", "l2_distance_layer",
+    "outer_prod_layer", "conv_shift_layer", "pad_layer", "crop_layer",
+    "resize_layer", "rotate_layer", "multiplex_layer",
+    "featuremap_expand_layer", "block_expand_layer", "spp_layer",
+    "img_cmrnorm_layer", "row_conv_layer", "depthwise_conv_layer",
+    "img_conv_transpose_layer", "layer_norm_layer", "global_pool_layer",
+    "sampling_id_layer",
+]

@@ -162,3 +162,44 @@ def test_graph_scope_nested_outer_survives():
     net = H.build_network(H.fc_layer(outer, size=3))
     assert sum(m is None for m in inner_net.modules) == 1
     assert sum(m is None for m in net.modules) == 1
+
+
+def test_thin_wrapper_surface_builds_and_runs():
+    """The widened wrapper set: a net touching many of the thin DSL
+    wrappers builds, initializes, and runs."""
+    x = H.data_layer("x")
+    h = H.fc_layer(x, size=12, act="relu")
+    h = H.layer_norm_layer(h)
+    h = H.maxout_layer(h, groups=3)            # 12 -> 4
+    h = H.bias_layer(h)
+    h = H.scale_shift_layer(h)
+    h = H.slope_intercept_layer(h, 2.0, 0.5)
+    h = H.row_l2_norm_layer(h)
+    a = H.fc_layer(h, size=4)
+    d = H.l2_distance_layer(a, h)
+    s = H.sum_to_one_norm_layer(H.fc_layer(h, size=4, act="sigmoid"))
+    out = H.concat_layer([s, a])
+    net = H.build_network(out)
+    xv = jnp.asarray(np.random.RandomState(0).normal(
+        size=(3, 8)).astype(np.float32))
+    p = net.init(jax.random.PRNGKey(0), xv)
+    y = net.apply(p, xv)
+    assert y.shape == (3, 8)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_img_wrapper_surface_builds_and_runs():
+    img = H.data_layer("img")
+    c = H.img_conv_layer(img, 3, 8, act="relu")
+    c = H.img_cmrnorm_layer(c, size=3)
+    c = H.depthwise_conv_layer(c, 3)
+    c = H.pad_layer(c, (1, 1, 1, 1))
+    c = H.crop_layer(c, (1, 1), (8, 8))
+    c = H.spp_layer(c, levels=2)
+    out = H.fc_layer(c, size=5)
+    net = H.build_network(out)
+    xv = jnp.asarray(np.random.RandomState(0).normal(
+        size=(2, 8, 8, 3)).astype(np.float32))
+    p = net.init(jax.random.PRNGKey(0), xv)
+    y = net.apply(p, xv)
+    assert y.shape == (2, 5)
