@@ -366,13 +366,70 @@ def synthetic_ctr(split: str = "train", num_fields: int = 8,
     return reader
 
 
+_ML1M_URL = "https://files.grouplens.org/datasets/movielens/ml-1m.zip"
+_ML1M_MD5 = "c4d9eecfca2ab87c1945afe126590906"
+_ML_GENRES = ["Action", "Adventure", "Animation", "Children's", "Comedy",
+              "Crime"]          # first 6 kept (fixed [6] feature contract)
+
+
+def _movielens_real(split):
+    """Parse the real ml-1m archive (reference: ``v2/dataset/movielens.py``
+    — users.dat/movies.dat/ratings.dat '::'-separated). Deterministic 90/10
+    train/test split by rating index."""
+    from .download import DownloadDisabled, download, downloads_enabled
+    path = os.path.join(data_home(), "movielens", "ml-1m.zip")
+    if not os.path.exists(path):
+        if not downloads_enabled():
+            return None
+        try:
+            path = download(_ML1M_URL, "movielens", _ML1M_MD5)
+        except (DownloadDisabled, IOError):
+            return None
+    import zipfile
+
+    def rows(zf, name):
+        with zf.open(name) as f:
+            for raw in f.read().decode("latin-1").splitlines():
+                if raw.strip():
+                    yield raw.split("::")
+
+    with zipfile.ZipFile(path) as zf:
+        users = {}
+        for uid, gender, age, occ, _zip in rows(zf, "ml-1m/users.dat"):
+            users[int(uid)] = np.asarray(
+                [int(gender == "M"), int(age) // 10, int(occ), 0], np.int32)
+        movies = {}
+        for mid, _title, genres in rows(zf, "ml-1m/movies.dat"):
+            gset = set(genres.split("|"))
+            movies[int(mid)] = np.asarray(
+                [int(g in gset) for g in _ML_GENRES], np.int32)
+        samples = []
+        for i, (uid, mid, rating, _ts) in enumerate(
+                rows(zf, "ml-1m/ratings.dat")):
+            if (i % 10 == 9) != (split != "train"):
+                continue
+            uid, mid = int(uid), int(mid)
+            samples.append((np.int32(uid), np.int32(mid), users[uid],
+                            movies.get(mid, np.zeros(6, np.int32)),
+                            np.float32(rating)))
+    return samples
+
+
 def movielens(split: str = "train", n_users: int = 500, n_movies: int = 300,
               n: Optional[int] = None):
-    """MovieLens-style rating triples (reference: ``v2/dataset/movielens.py``)
+    """MovieLens rating samples (reference: ``v2/dataset/movielens.py``)
     yielding ``(user_id, movie_id, user_features [4], movie_genres [6],
-    rating)``. Synthetic fallback: ratings from a hidden low-rank
-    user x movie factor model plus genre affinity, so matrix-factorisation
-    recommenders actually learn."""
+    rating)``. Real ml-1m when cached/downloadable. Synthetic fallback:
+    ratings from a hidden low-rank user x movie factor model plus genre
+    affinity, so matrix-factorisation recommenders actually learn."""
+    real = _movielens_real(split)
+    if real is not None:
+        def reader():
+            yield from real
+        reader.is_synthetic = False
+        reader.num_samples = len(real)
+        return reader
+
     n = n or (16384 if split == "train" else 2048)
     g = np.random.RandomState(44)
     u_fac = g.normal(0, 1, (n_users, 6)).astype(np.float32)
@@ -425,12 +482,68 @@ def conll05(split: str = "train", vocab: int = 3000, n_labels: int = 13,
     return reader
 
 
+_IMIKOLOV_URL = ("http://www.fit.vutbr.cz/~imikolov/rnnlm/"
+                 "simple-examples.tgz")
+_IMIKOLOV_MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+
+
+def _imikolov_real(split, vocab_size, ngram):
+    """Parse the real PTB tarball (simple-examples.tgz) into n-gram windows
+    (reference: ``v2/dataset/imikolov.py`` build_dict + reader)."""
+    from .download import DownloadDisabled, download, downloads_enabled
+    path = os.path.join(data_home(), "imikolov", "simple-examples.tgz")
+    if not os.path.exists(path):
+        if not downloads_enabled():
+            return None
+        try:
+            path = download(_IMIKOLOV_URL, "imikolov", _IMIKOLOV_MD5)
+        except (DownloadDisabled, IOError):
+            return None
+    import collections
+    import tarfile
+    member = {"train": "./simple-examples/data/ptb.train.txt",
+              "test": "./simple-examples/data/ptb.test.txt"}
+
+    def lines(name):
+        with tarfile.open(path) as tf:
+            for m in tf:
+                if m.name.lstrip("./") == name.lstrip("./") and m.isfile():
+                    for raw in tf.extractfile(m).read().decode(
+                            "utf-8", errors="replace").splitlines():
+                        yield raw.split()
+                    return
+
+    freq = collections.Counter()
+    for toks in lines(member["train"]):
+        freq.update(toks)
+    # id 0 = <unk>; frequency-desc, word-asc tie-break (build_dict order)
+    vocab = {w: i + 1 for i, (w, _) in enumerate(
+        sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        [:vocab_size - 1])}
+    windows = []
+    for toks in lines(member["train" if split == "train" else "test"]):
+        ids = [vocab.get(t, 0) for t in toks]
+        for i in range(len(ids) - ngram + 1):
+            windows.append(np.asarray(ids[i:i + ngram], np.int32))
+    return windows
+
+
 def imikolov(split: str = "train", vocab: int = 2000, ngram: int = 5,
              n: Optional[int] = None):
     """PTB n-gram language-model windows (reference:
     ``v2/dataset/imikolov.py``) yielding ``(context [ngram-1], next_word)``.
-    Synthetic fallback: a first-order Markov chain over the vocab so context
-    genuinely predicts the next word."""
+    Real PTB when cached/downloadable; synthetic fallback: a first-order
+    Markov chain over the vocab so context genuinely predicts the next
+    word."""
+    real = _imikolov_real(split, vocab, ngram)
+    if real is not None:
+        def reader():
+            for w in real:
+                yield w[:-1], w[-1]
+        reader.is_synthetic = False
+        reader.num_samples = len(real)
+        return reader
+
     n = n or (16384 if split == "train" else 2048)
     g = np.random.RandomState(45)
     # sparse-ish transition preferences: each word has 4 likely successors

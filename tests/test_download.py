@@ -118,3 +118,54 @@ def test_imdb_real_tarball_parsed(home):
     assert 1 in ids_pos
     rt = datasets.imdb("test", vocab_size=10)
     assert rt.num_samples == 2 and rt.is_synthetic is False
+
+
+def test_imikolov_real_tarball_parsed(home):
+    d = home / "imikolov"
+    d.mkdir(parents=True)
+    buf = io.BytesIO()
+    train = b"the cat sat on the mat\nthe dog sat on the rug\n"
+    test = b"the cat sat on the rug\n"
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for name, text in (("./simple-examples/data/ptb.train.txt", train),
+                           ("./simple-examples/data/ptb.test.txt", test)):
+            info = tarfile.TarInfo(name)
+            info.size = len(text)
+            tf.addfile(info, io.BytesIO(text))
+    (d / "simple-examples.tgz").write_bytes(buf.getvalue())
+
+    r = datasets.imikolov("train", vocab=10, ngram=3)
+    assert r.is_synthetic is False
+    samples = list(r())
+    # 2 lines x 6 tokens, ngram 3 -> 4 windows per line
+    assert len(samples) == 8
+    ctx, nxt = samples[0]
+    assert ctx.shape == (2,)
+    # 'the' is the most frequent token -> id 1; appears as first context
+    assert ctx[0] == 1
+    rt = datasets.imikolov("test", vocab=10, ngram=3)
+    assert rt.num_samples == 4 and rt.is_synthetic is False
+
+
+def test_movielens_real_zip_parsed(home):
+    import zipfile
+    d = home / "movielens"
+    d.mkdir(parents=True)
+    zpath = d / "ml-1m.zip"
+    with zipfile.ZipFile(zpath, "w") as zf:
+        zf.writestr("ml-1m/users.dat",
+                    "1::M::25::12::12345\n2::F::35::7::54321\n")
+        zf.writestr("ml-1m/movies.dat",
+                    "10::Toy Story (1995)::Animation|Children's|Comedy\n"
+                    "20::Heat (1995)::Action|Crime\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "\n".join(f"{1 + i % 2}::{10 + 10 * (i % 2)}::"
+                              f"{1 + i % 5}::97830{i}"
+                              for i in range(20)) + "\n")
+    r = datasets.movielens("train")
+    rt = datasets.movielens("test")
+    assert r.is_synthetic is False and rt.is_synthetic is False
+    assert r.num_samples == 18 and rt.num_samples == 2   # 90/10 split
+    uid, mid, ufeat, genres, rating = next(iter(r()))
+    assert ufeat.shape == (4,) and genres.shape == (6,)
+    assert 1.0 <= float(rating) <= 5.0
