@@ -24,7 +24,7 @@ from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 import numpy as np
 
 __all__ = ["RecordWriter", "read_records", "write_samples", "read_samples",
-           "sharded_records", "num_records"]
+           "sharded_records", "num_records", "recover_index"]
 
 _HEADER = struct.Struct("<II")           # length, crc32
 
@@ -79,9 +79,57 @@ def _read_at(f, offset: int) -> bytes:
     return payload
 
 
+def recover_index(path: str, write: bool = True) -> List[int]:
+    """Rebuild the offset index by scanning the raw record stream with CRC
+    verification — the sidecar is a cache, not the source of truth (the Go
+    master rebuilt its chunk index the same way,
+    ``go/master/service.go:253``). Hot loop is native
+    (``native/packer.cpp:ptn_recordio_scan``) with a tested-equal Python
+    fallback. Raises on the first corrupt/truncated record."""
+    with open(path, "rb") as f:
+        data = f.read()
+
+    from .. import native
+    L = native.lib()
+    offsets: List[int] = []
+    if L is not None:
+        import ctypes
+
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) \
+            if data else (ctypes.c_uint8 * 1)()
+        max_records = len(data) // _HEADER.size + 1
+        out = (ctypes.c_int64 * max_records)()
+        n = L.ptn_recordio_scan(buf, len(data), max_records, out)
+        if n < 0:
+            raise IOError(f"corrupt record stream in {path} at byte "
+                          f"{-(n + 1)}")
+        offsets = list(out[:n])
+    else:
+        off = 0
+        while off < len(data):
+            if off + _HEADER.size > len(data):
+                raise IOError(f"corrupt record stream in {path} at byte "
+                              f"{off}")
+            length, crc = _HEADER.unpack_from(data, off)
+            payload = data[off + _HEADER.size: off + _HEADER.size + length]
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                raise IOError(f"corrupt record stream in {path} at byte "
+                              f"{off}")
+            offsets.append(off)
+            off += _HEADER.size + length
+    if write:
+        with open(path + ".idx", "w") as f:
+            json.dump({"offsets": offsets}, f)
+    return offsets
+
+
 def _offsets(path: str) -> List[int]:
-    with open(path + ".idx") as f:
-        return json.load(f)["offsets"]
+    try:
+        with open(path + ".idx") as f:
+            return json.load(f)["offsets"]
+    except FileNotFoundError:
+        # lost sidecar: recover by scanning (never fatal for intact data)
+        return recover_index(path)
 
 
 def num_records(path: str) -> int:

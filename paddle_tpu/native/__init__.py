@@ -75,6 +75,10 @@ def lib() -> Optional[ctypes.CDLL]:
         L.ptn_positions_from_segments.restype = None
         L.ptn_positions_from_segments.argtypes = [i32p, ctypes.c_int64,
                                                   ctypes.c_int64, i32p]
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        L.ptn_recordio_scan.restype = ctypes.c_int64
+        L.ptn_recordio_scan.argtypes = [u8p, ctypes.c_int64,
+                                        ctypes.c_int64, i64p]
         _lib = L
         return _lib
 

@@ -68,3 +68,65 @@ void ptn_positions_from_segments(const int32_t* seg, int64_t b, int64_t t,
 }
 
 }  // extern "C"
+
+// -- recordio scan -----------------------------------------------------------
+//
+// Index recovery for the record file format (data/recordio.py:
+// [u32 len][u32 crc32][payload] stream + JSON offset sidecar). The sidecar
+// is a cache; when it is lost or stale the reader rebuilds it by scanning
+// the raw bytes and CRC-checking every record — the role the reference's Go
+// master performed when building its RecordIO chunk index
+// (go/master/service.go:253). This is the hot loop of that recovery.
+
+namespace {
+
+// CRC-32 (IEEE 802.3, reflected 0xEDB88320) — the same polynomial as
+// zlib.crc32 so native and Python paths agree bit-for-bit.
+uint32_t crc32_update(uint32_t crc, const uint8_t* p, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  crc = ~crc;
+  for (size_t i = 0; i < n; ++i)
+    crc = table[(crc ^ p[i]) & 0xFFu] ^ (crc >> 8);
+  return ~crc;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan a raw record buffer, recovering record offsets and verifying every
+// CRC. Returns the record count, or -(1 + byte_offset) at the first
+// corrupt/truncated record. offsets_out must hold max_records entries.
+int64_t ptn_recordio_scan(const uint8_t* data, int64_t nbytes,
+                          int64_t max_records, int64_t* offsets_out) {
+  int64_t off = 0;
+  int64_t n = 0;
+  while (off < nbytes) {
+    if (off + 8 > nbytes || n >= max_records) return -(1 + off);
+    const uint32_t len = static_cast<uint32_t>(data[off]) |
+                         (static_cast<uint32_t>(data[off + 1]) << 8) |
+                         (static_cast<uint32_t>(data[off + 2]) << 16) |
+                         (static_cast<uint32_t>(data[off + 3]) << 24);
+    const uint32_t crc = static_cast<uint32_t>(data[off + 4]) |
+                         (static_cast<uint32_t>(data[off + 5]) << 8) |
+                         (static_cast<uint32_t>(data[off + 6]) << 16) |
+                         (static_cast<uint32_t>(data[off + 7]) << 24);
+    if (off + 8 + static_cast<int64_t>(len) > nbytes) return -(1 + off);
+    if (crc32_update(0, data + off + 8, len) != crc) return -(1 + off);
+    offsets_out[n++] = off;
+    off += 8 + static_cast<int64_t>(len);
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -67,3 +67,49 @@ def test_pack_roundtrip_with_native():
 def test_disable_env_forces_python(monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_NO_NATIVE", "1")
     assert native.lib() is None
+
+
+def test_recordio_index_recovery_native_equals_python(tmp_path):
+    """Lost .idx sidecar: the scanner rebuilds it (native fast path and
+    Python fallback must agree exactly), and corruption is caught with the
+    failing byte offset."""
+    import json
+    import os
+
+    from paddle_tpu.data import recordio
+
+    path = str(tmp_path / "data.rec")
+    payloads = [bytes([i]) * (7 * i + 1) for i in range(12)]
+    with recordio.RecordWriter(path) as w:
+        for p in payloads:
+            w.write(p)
+    with open(path + ".idx") as f:
+        want = json.load(f)["offsets"]
+    os.remove(path + ".idx")
+
+    # native path (if compiler available)
+    got_native = recordio.recover_index(path, write=False)
+    assert got_native == want
+
+    # forced Python fallback
+    os.environ["PADDLE_TPU_NO_NATIVE"] = "1"
+    try:
+        import paddle_tpu.native as native
+        native._tried, native._lib = False, None
+        got_py = recordio.recover_index(path, write=False)
+    finally:
+        del os.environ["PADDLE_TPU_NO_NATIVE"]
+        native._tried, native._lib = False, None
+    assert got_py == want
+
+    # reading with a lost index works end-to-end
+    assert [bytes(r) for r in recordio.read_records(path)] == payloads
+    assert os.path.exists(path + ".idx")       # sidecar restored
+
+    # corruption detection with byte offset
+    with open(path, "r+b") as f:
+        f.seek(want[3] + 9)
+        f.write(b"\xff")
+    os.remove(path + ".idx")
+    with pytest.raises(IOError, match="corrupt"):
+        recordio.recover_index(path, write=False)
