@@ -31,12 +31,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-# Persistent XLA compile cache: the five sub-benches compile several large
-# programs; re-runs in the same environment (driver retries, experiments)
-# skip straight to execution.
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("JAX_CACHE_DIR", "/tmp/jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+# NOTE: do NOT enable jax's persistent compilation cache here — executables
+# deserialized from the cache hang at execution time under the remote-TPU
+# (axon) plugin (observed round 3: cache-hit runs block forever in
+# device_get while fresh compiles of the same HLO run fine).
 
 # Reference's published numbers (BASELINE.md).
 BASELINE_RESNET50_IMG_S = 82.35     # ResNet-50 bs128, 2xXeon 6148 MKL-DNN
