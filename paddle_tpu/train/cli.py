@@ -39,6 +39,11 @@ class TrainCliFlags(TrainerFlags):
     optimizer: str = "adam"          # name in paddle_tpu.optim
     loss: str = "softmax_ce"         # softmax_ce | mse
     trusted_config: bool = False     # allow non-registry classes in the IR
+    # job: train | test | checkgrad | time — the reference trainer's --job
+    # modes (TrainerMain.cpp:25: train / test / checkgrad; TrainerBenchmark
+    # --job=time)
+    job: str = "train"
+    time_batches: int = 10           # batches timed by --job time
 
 
 def _load_model(path: str, trusted: bool):
@@ -160,8 +165,12 @@ def run_config_script(flags: TrainCliFlags) -> dict:
     policy = (dtypes.use_policy(dtypes.bfloat16_compute)
               if flags.use_bf16 else contextlib.nullcontext())
     num_passes = int(pick("num_passes", flags.num_passes))
+    test_reader = (ns["test_reader"](batch_size)
+                   if "test_reader" in ns else None)
     with policy:
         trainer.init(jax.random.PRNGKey(flags.seed), next(iter(reader())))
+        if flags.job != "train":
+            return _run_alt_job(flags, trainer, reader, test_reader)
         trainer.train(
             reader, num_passes=num_passes, event_handler=handler,
             checkpoint_dir=flags.checkpoint_dir or None,
@@ -169,6 +178,78 @@ def run_config_script(flags: TrainCliFlags) -> dict:
             saving_period=flags.saving_period or None,
             log_period=flags.log_period, resume=flags.resume)
     return last
+
+
+def _run_alt_job(flags: TrainCliFlags, trainer: Trainer, reader,
+                 test_reader=None) -> dict:
+    """The reference trainer's non-train --job modes
+    (``TrainerMain.cpp:25``: test / checkgrad; ``TrainerBenchmark.cpp``:
+    --job=time). Shared by the IR and config-script paths (trainer already
+    initialized)."""
+    import time as _time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    if flags.job == "test":
+        # load latest checkpoint if available, evaluate the test stream
+        if flags.checkpoint_dir:
+            from . import checkpoint as ckpt_mod
+            if ckpt_mod.latest_pass(flags.checkpoint_dir) is not None:
+                trainer.restore(flags.checkpoint_dir)
+        cost, metrics = trainer.evaluate(test_reader or reader)
+        return {"test_cost": cost, **{f"test_{k}": v
+                                      for k, v in metrics.items()}}
+
+    if flags.job == "checkgrad":
+        # whole-model numeric gradient check on one batch
+        # (Trainer::checkGradient, --job=checkgrad)
+        from paddle_tpu.utils.gradcheck import check_gradients
+        batch = jax.tree_util.tree_map(jnp.asarray, next(iter(reader())))
+        state = trainer.train_state.state
+        fwd = trainer._forward
+        loss_fn = trainer.loss_fn
+        model = trainer.model
+
+        def loss_of(p):
+            out, _ = fwd(model, {"params": p, "state": state}, batch, True,
+                         {"dropout": jax.random.PRNGKey(0)})
+            return jnp.mean(loss_fn(out, batch))
+
+        # smoke-level whole-model check (rigorous per-layer checks live in
+        # tests/): f32 central differences over a full model need headroom
+        worst = check_gradients(loss_of, trainer.train_state.params,
+                                num_directions=3, rtol=6e-2)
+        return {"checkgrad_worst_rel_err": float(worst), "checkgrad_ok": 1}
+
+    if flags.job == "time":
+        # --job=time: ms/batch over time_batches (TrainerBenchmark.cpp)
+        trainer._build_train_step()
+        ts = trainer.train_state
+        batches = []
+        for i, b in enumerate(reader()):
+            if i >= flags.time_batches:
+                break
+            batches.append(trainer._shard(b))
+        if not batches:
+            raise SystemExit("--job time: reader yielded no batches")
+        params, state, opt_state, step = (ts.params, ts.state, ts.opt_state,
+                                          ts.step)
+        key = jax.random.PRNGKey(1)
+        # warmup (compile)
+        params, state, opt_state, step, loss, _ = trainer._train_step(
+            params, state, opt_state, step, batches[0], key)
+        float(np.asarray(jax.device_get(loss)))
+        t0 = _time.perf_counter()
+        for b in batches:
+            params, state, opt_state, step, loss, _ = trainer._train_step(
+                params, state, opt_state, step, b, key)
+        float(np.asarray(jax.device_get(loss)))
+        ms = (_time.perf_counter() - t0) / len(batches) * 1e3
+        return {"ms_per_batch": ms, "batches": len(batches)}
+
+    raise SystemExit(f"unknown --job {flags.job!r} "
+                     "(train | test | checkgrad | time)")
 
 
 def run(flags: TrainCliFlags) -> dict:
@@ -202,6 +283,10 @@ def run(flags: TrainCliFlags) -> dict:
               if flags.use_bf16 else contextlib.nullcontext())
     with policy:
         trainer.init(jax.random.PRNGKey(flags.seed), next(iter(reader())))
+        if flags.job != "train":
+            test_reader = _make_reader(flags.dataset, flags.batch_size,
+                                       split="test")
+            return _run_alt_job(flags, trainer, reader, test_reader)
         trainer.train(
             reader, num_passes=flags.num_passes, event_handler=handler,
             checkpoint_dir=flags.checkpoint_dir or None,

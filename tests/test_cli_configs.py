@@ -51,3 +51,16 @@ def test_config_script_missing_outputs_rejected(tmp_path):
     flags = cli.parse_flags(cli.TrainCliFlags, ["--config", str(bad)])
     with pytest.raises(SystemExit, match="outputs"):
         cli.run(flags)
+
+
+def test_cli_job_modes():
+    """--job test / checkgrad / time — the reference trainer's non-train
+    modes (TrainerMain.cpp:25, TrainerBenchmark.cpp)."""
+    t = _run("sequence_tagging_crf.py",
+             ["--job", "time", "--time_batches", "3", "--use_bf16", "0"])
+    assert t["batches"] == 3 and t["ms_per_batch"] > 0
+    g = _run("sequence_tagging_crf.py", ["--job", "checkgrad",
+                                         "--use_bf16", "0"])
+    assert g["checkgrad_ok"] == 1
+    e = _run("sequence_tagging_crf.py", ["--job", "test", "--use_bf16", "0"])
+    assert np.isfinite(e["test_cost"])
