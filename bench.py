@@ -411,21 +411,40 @@ def main():
         print(json.dumps(single[flags.metric]()))
         return
 
-    # default: every north-star metric in one driver-visible JSON object,
-    # headline = the flagship ResNet-50 fields (driver/judge continuity)
+    # Default: every north-star metric, each in its OWN subprocess with a
+    # hard timeout and one retry. Process isolation is deliberate: the
+    # remote-TPU tunnel occasionally wedges mid-session (a blocked compile/
+    # execute RPC never returns — observed round 3), and a fresh process =
+    # a fresh tunnel connection; a hung sub-bench must not sink the rest.
+    # Output: ONE JSON object, headline = the flagship ResNet-50 fields
+    # (driver/judge continuity), `all_metrics` carrying everything.
+    repo = os.path.dirname(os.path.abspath(__file__))
     results = {}
     errors = {}
-    for name, fn in (("resnet50", lambda: bench_resnet50(
-            batch_size=flags.batch_size, warmup=flags.warmup,
-            iters=flags.iters)),
-            ("seq2seq", bench_seq2seq),
-            ("transformer", bench_transformer),
-            ("lstm", bench_lstm),
-            ("scaling", bench_scaling)):
-        try:
-            results[name] = fn()
-        except Exception as e:       # noqa: BLE001 — one bench must not sink the rest
-            errors[name] = repr(e)[-500:]
+    plan = [("resnet50", 2400), ("seq2seq", 1800), ("transformer", 2400),
+            ("lstm", 1800), ("scaling", 1800)]
+    for name, budget in plan:
+        for attempt in (1, 2):
+            try:
+                res = subprocess.run(
+                    [sys.executable, os.path.join(repo, "bench.py"),
+                     "--metric", name],
+                    capture_output=True, text=True, timeout=budget, cwd=repo)
+            except subprocess.TimeoutExpired:
+                errors[name] = f"attempt {attempt}: timeout after {budget}s"
+                continue
+            if res.returncode == 0:
+                try:
+                    results[name] = json.loads(
+                        res.stdout.strip().splitlines()[-1])
+                    errors.pop(name, None)
+                    break
+                except (ValueError, IndexError):
+                    errors[name] = (f"attempt {attempt}: unparseable output "
+                                    f"{res.stdout[-300:]!r}")
+            else:
+                errors[name] = (f"attempt {attempt}: rc={res.returncode} "
+                                f"{res.stderr[-400:]}")
     headline = results.get("resnet50", {})
     out = {**headline,
            "all_metrics": {r["metric"]: r for r in results.values()
