@@ -425,12 +425,26 @@ def main():
             ("lstm", 1800), ("scaling", 1800)]
     for name, budget in plan:
         for attempt in (1, 2):
+            # Own session per sub-bench: on timeout the WHOLE process group
+            # dies (bench_scaling spawns a grandchild for the virtual-CPU
+            # mesh; a plain subprocess timeout would orphan it, leaving it
+            # burning host cores under later sub-benches).
+            proc = subprocess.Popen(
+                [sys.executable, os.path.join(repo, "bench.py"),
+                 "--metric", name],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                cwd=repo, start_new_session=True)
             try:
-                res = subprocess.run(
-                    [sys.executable, os.path.join(repo, "bench.py"),
-                     "--metric", name],
-                    capture_output=True, text=True, timeout=budget, cwd=repo)
+                out_s, err_s = proc.communicate(timeout=budget)
+                res = subprocess.CompletedProcess(proc.args, proc.returncode,
+                                                  out_s, err_s)
             except subprocess.TimeoutExpired:
+                import signal
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                proc.wait()
                 errors[name] = f"attempt {attempt}: timeout after {budget}s"
                 continue
             if res.returncode == 0:
