@@ -80,70 +80,41 @@ def _build_resnet_trainer(batch_size, model=None, image=224, classes=1000):
     return trainer, batch
 
 
-def _multi_step_jit(trainer, mesh=None):
-    """K train steps per dispatch via lax.fori_loop (same math as
-    Trainer._train_step; amortises per-call dispatch)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from paddle_tpu.core import mesh as mesh_lib
-    from paddle_tpu.optim.optimizers import apply_updates
-
-    model, loss_fn, opt = trainer.model, trainer.loss_fn, trainer.optimizer
-    mesh = mesh or trainer.mesh
-
-    def one_step(carry, batch, rng):
-        params, state, opt_state, step = carry
-
-        def compute_loss(p):
-            out, new = model.apply({"params": p, "state": state},
-                                   batch["x"], train=True,
-                                   mutable=("state",),
-                                   rngs={"dropout": rng})
-            return jnp.mean(loss_fn(out, batch)), new["state"]
-
-        (loss, new_state), grads = jax.value_and_grad(
-            compute_loss, has_aux=True)(params)
-        updates, new_opt = opt.update(grads, opt_state, params, step)
-        return (apply_updates(params, updates), new_state, new_opt,
-                step + 1), loss
-
-    def multi(carry, batch, rng, k):
-        def body(i, c_l):
-            return one_step(c_l[0], batch, rng)
-        return jax.lax.fori_loop(0, k, body, (carry, jnp.zeros(())))
-
-    repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
-    return jax.jit(multi, in_shardings=((repl,) * 4, data, repl),
-                   static_argnums=(3,), donate_argnums=(0,))
-
-
-def _time_multi(trainer, batch, warmup_calls, calls, steps_per_call,
-                mesh=None):
+def _time_steps(trainer, batch, warmup, iters, mesh=None):
+    """Chained per-call train steps (donated state; each step's inputs are
+    the previous step's outputs, so dispatch pipelines). NOTE: a
+    lax.fori_loop multi-step harness measured faster when first built
+    (dispatch amortisation, experiments/PERF.md exp 2) but the remote-TPU
+    tunnel later regressed to re-dispatching every loop iteration
+    host-side (~35x slowdown on large carries, measured round 3) — the
+    portable per-call protocol is the shipped harness."""
     from paddle_tpu.core.dtypes import bfloat16_compute, use_policy
     with use_policy(bfloat16_compute):
-        multi = _multi_step_jit(trainer, mesh=mesh)
+        trainer._build_train_step()
         ts = trainer.train_state
         sharded = trainer._shard(batch)
         key = jax.random.PRNGKey(1)
-        carry = (ts.params, ts.state, ts.opt_state, ts.step)
-        for _ in range(max(1, warmup_calls)):
-            carry, loss = multi(carry, sharded, key, steps_per_call)
+        params, state, opt_state, step = (ts.params, ts.state, ts.opt_state,
+                                          ts.step)
+        for _ in range(max(1, warmup)):
+            params, state, opt_state, step, loss, _ = trainer._train_step(
+                params, state, opt_state, step, sharded, key)
         _fence(loss)
         t0 = time.perf_counter()
-        for _ in range(calls):
-            carry, loss = multi(carry, sharded, key, steps_per_call)
+        for _ in range(iters):
+            params, state, opt_state, step, loss, _ = trainer._train_step(
+                params, state, opt_state, step, sharded, key)
         loss = _fence(loss)
-        dt = (time.perf_counter() - t0) / (calls * steps_per_call)
+        dt = (time.perf_counter() - t0) / iters
     n_dev = int((mesh or trainer.mesh).devices.size)
     return dt, loss, n_dev
 
 
-def bench_resnet50(batch_size=128, warmup=1, iters=4, steps_per_call=10):
+def bench_resnet50(batch_size=128, warmup=3, iters=20):
     """ResNet-50 NHWC bf16 training throughput (img/s/chip) — the flagship
     (``benchmark/paddle/image/resnet.py`` protocol)."""
     trainer, batch = _build_resnet_trainer(batch_size)
-    dt, loss, n_dev = _time_multi(trainer, batch, warmup, iters,
-                                  steps_per_call)
+    dt, loss, n_dev = _time_steps(trainer, batch, warmup, iters)
     img_s = batch_size / dt / n_dev
     peak = PEAK_FLOPS.get(jax.devices()[0].device_kind)
     mfu = (img_s * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak) if peak else None
@@ -154,7 +125,6 @@ def bench_resnet50(batch_size=128, warmup=1, iters=4, steps_per_call=10):
         "vs_baseline": round(img_s / BASELINE_RESNET50_IMG_S, 2),
         "batch_size": batch_size,
         "ms_per_step": round(dt * 1e3, 2),
-        "steps_per_call": steps_per_call,
         "mfu_pct": round(100 * mfu, 2) if mfu is not None else None,
         "device": jax.devices()[0].device_kind,
         "final_loss": round(loss, 4),
@@ -182,7 +152,7 @@ def bench_lstm(batch_size=64, seq_len=100, hidden=512, vocab=30000,
              "label": rng.randint(0, 2, batch_size).astype(np.int32)}
     with use_policy(bfloat16_compute):
         trainer.init(jax.random.PRNGKey(0), batch)
-    dt, loss, n_dev = _time_multi(trainer, batch, 1, max(1, iters // 5), 5)
+    dt, loss, n_dev = _time_steps(trainer, batch, 3, iters)
     ms = dt * 1e3
     return {
         "metric": "lstm_textcls_ms_per_batch",
@@ -316,7 +286,7 @@ def bench_seq2seq(batch_size=64, src_len=30, tgt_len=30, vocab=30000,
     }
 
 
-def bench_scaling(per_device_batch=64, iters=3, steps_per_call=4):
+def bench_scaling(per_device_batch=32, iters=2, steps_per_call=4):
     """Throughput vs device count at fixed per-device batch — the third
     north-star metric (reference anchor: 3.85x at 4 GPUs,
     ``benchmark/README.md:70-93``).
@@ -360,7 +330,8 @@ def bench_scaling(per_device_batch=64, iters=3, steps_per_call=4):
         trainer, batch = _build_resnet_trainer(
             bs, model=resnet_cifar(depth_n=2), image=32, classes=10)
         trainer.mesh = mesh
-        dt, loss, _ = _time_multi(trainer, batch, 1, iters, steps_per_call,
+        dt, loss, _ = _time_steps(trainer, batch, 1,
+                                  max(2, iters * steps_per_call // 2),
                                   mesh=mesh)
         throughput[n] = bs / dt
     base = throughput[counts[0]]
