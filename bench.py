@@ -392,8 +392,12 @@ def main():
     repo = os.path.dirname(os.path.abspath(__file__))
     results = {}
     errors = {}
+    # The scaling probe is NOT in the default plan: with one real chip it
+    # runs on the virtual-CPU mesh and its 4 CPU compiles cost ~20 min —
+    # run it explicitly (`--metric scaling`); the committed artifact is
+    # SCALING_r03.json.
     plan = [("resnet50", 2400), ("seq2seq", 1800), ("transformer", 2400),
-            ("lstm", 1800), ("scaling", 1800)]
+            ("lstm", 1800)]
     for name, budget in plan:
         for attempt in (1, 2):
             # Own session per sub-bench: on timeout the WHOLE process group
