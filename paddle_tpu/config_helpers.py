@@ -657,3 +657,68 @@ __all__ += [
     "img_conv_transpose_layer", "layer_norm_layer", "global_pool_layer",
     "sampling_id_layer",
 ]
+
+
+# -- composite networks (the networks.py tier) --------------------------------
+
+def img_conv_group(input: LayerOut, num_filters: Sequence[int],
+                   filter_size=3, pool_size=2, act: str = "relu",
+                   with_bn: bool = False) -> LayerOut:
+    """Conv(xN) -> [BN] -> pool block (reference: ``img_conv_group``,
+    networks.py)."""
+    h = input
+    for nf in num_filters:
+        h = img_conv_layer(h, filter_size, nf,
+                           act="" if with_bn else act)
+        if with_bn:
+            h = batch_norm_layer(h, act=act)
+    return img_pool_layer(h, pool_size)
+
+
+class _Flatten(Module):
+    def forward(self, x):
+        return x.reshape(x.shape[0], -1)
+
+
+def flatten_layer(input: LayerOut, name=None) -> LayerOut:
+    return input.graph.add(_Flatten(name=name), [input])
+
+
+def vgg_16_network(input_image: LayerOut, num_classes: int = 1000,
+                   with_bn: bool = True) -> LayerOut:
+    """VGG-16 head-to-logits composite (reference: ``vgg_16_network``,
+    networks.py:468)."""
+    h = input_image
+    for filters, reps in ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3)):
+        h = img_conv_group(h, [filters] * reps, with_bn=with_bn)
+    h = flatten_layer(h)
+    h = fc_layer(h, size=4096, act="relu")
+    h = dropout_layer(h, 0.5)
+    h = fc_layer(h, size=4096, act="relu")
+    h = dropout_layer(h, 0.5)
+    return fc_layer(h, size=num_classes)
+
+
+def simple_lstm(input: LayerOut, size: int, reverse: bool = False) -> LayerOut:
+    """fc -> lstm composite (reference: ``simple_lstm``, networks.py:553 —
+    the input projection lives outside the recurrence)."""
+    return lstmemory(fc_layer(input, size=size * 4), size, reverse=reverse)
+
+
+def simple_gru(input: LayerOut, size: int, reverse: bool = False) -> LayerOut:
+    """fc -> gru composite (reference: ``simple_gru``, networks.py:997)."""
+    return grumemory(fc_layer(input, size=size * 3), size, reverse=reverse)
+
+
+def sequence_conv_pool(input: LayerOut, lengths: LayerOut,
+                       context_len: int, hidden_size: int,
+                       pooling_type: str = "max") -> LayerOut:
+    """Context-window conv over a sequence then pool (reference:
+    ``sequence_conv_pool``, networks.py — the text-classification block)."""
+    ctx = input.graph.add(L.ContextProjection(context_len), [input])
+    h = fc_layer(ctx, size=hidden_size, act="tanh")
+    return pooling_layer(h, lengths, pooling_type=pooling_type)
+
+
+__all__ += ["img_conv_group", "vgg_16_network", "simple_lstm", "simple_gru",
+            "sequence_conv_pool", "flatten_layer"]

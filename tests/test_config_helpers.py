@@ -203,3 +203,33 @@ def test_img_wrapper_surface_builds_and_runs():
     p = net.init(jax.random.PRNGKey(0), xv)
     y = net.apply(p, xv)
     assert y.shape == (2, 5)
+
+
+def test_composite_networks_build_and_run():
+    """networks.py-tier composites: vgg_16_network (downscaled input),
+    simple_lstm/simple_gru, sequence_conv_pool."""
+    img = H.data_layer("image")
+    logits = H.vgg_16_network(img, num_classes=7, with_bn=False)
+    net = H.build_network(logits)
+    x = jnp.asarray(np.random.RandomState(0).normal(
+        size=(2, 32, 32, 3)).astype(np.float32))
+    p = net.init(jax.random.PRNGKey(0), x, train=True)
+    y, _ = net.apply(p, x, train=True, mutable=("state",),
+                     rngs={"dropout": jax.random.PRNGKey(1)})
+    assert y.shape == (2, 7)
+
+    seq = H.data_layer("tokens")
+    lengths = H.data_layer("length")
+    e = H.embedding_layer(seq, size=16, vocab=50)
+    a = H.simple_lstm(e, 12)
+    b = H.simple_gru(e, 12)
+    c = H.sequence_conv_pool(e, lengths, context_len=3, hidden_size=20)
+    last = H.last_seq(H.concat_layer([a, b]), lengths)
+    out = H.fc_layer(H.concat_layer([last, c]), size=3)
+    net2 = H.build_network(out)
+    toks = jnp.asarray(np.random.RandomState(1).randint(0, 50, (2, 9)))
+    lens = jnp.asarray(np.array([9, 5], np.int32))
+    p2 = net2.init(jax.random.PRNGKey(0), toks, lens)
+    y2 = net2.apply(p2, toks, lens)
+    assert y2.shape == (2, 3)
+    assert np.isfinite(np.asarray(y2)).all()
