@@ -1,0 +1,61 @@
+"""MNIST MLP config script — the light_mnist acceptance config
+(reference: ``v1_api_demo/mnist/light_mnist.py``: the canonical
+config-script workflow with ``classification_cost`` + the
+classification-error evaluator).
+
+Run:  python -m paddle_tpu.train.cli --config configs/mnist_mlp.py
+"""
+
+import numpy as np
+
+from paddle_tpu.config_helpers import (classification_cost, data_layer,
+                                       fc_layer, outputs, settings)
+
+settings(batch_size=64, learning_rate=0.05, optimizer="momentum",
+         num_passes=2, evaluator="classification_error")
+
+img = data_layer("image")
+label = data_layer("label")
+h = fc_layer(img, size=128, act="relu")
+h = fc_layer(h, size=64, act="relu")
+logits = fc_layer(h, size=10)
+cost = classification_cost(logits, label)
+# outputs[0] = the training cost; outputs[1] feeds the evaluator (the v1
+# evaluator-layer attachment, here: classification error over the logits)
+outputs(cost, logits, name="mnist_mlp")
+
+
+def train_reader(batch_size, n_batches=24, seed=0):
+    """Synthetic-MNIST provider (the dataprovider.py analog): the dataset
+    module's labelled synthetic fallback, flattened to vectors."""
+    from paddle_tpu.data import datasets
+
+    base = datasets.mnist("train", synthetic_n=batch_size * n_batches)
+
+    def reader():
+        xs, ys = [], []
+        for x, y in base():
+            xs.append(np.asarray(x).reshape(-1))
+            ys.append(y)
+            if len(xs) == batch_size:
+                yield {"image": np.stack(xs).astype(np.float32),
+                       "label": np.asarray(ys, np.int32)}
+                xs, ys = [], []
+    return reader
+
+
+def test_reader(batch_size, n_batches=4, seed=1):
+    from paddle_tpu.data import datasets
+
+    base = datasets.mnist("test", synthetic_n=batch_size * n_batches)
+
+    def reader():
+        xs, ys = [], []
+        for x, y in base():
+            xs.append(np.asarray(x).reshape(-1))
+            ys.append(y)
+            if len(xs) == batch_size:
+                yield {"image": np.stack(xs).astype(np.float32),
+                       "label": np.asarray(ys, np.int32)}
+                xs, ys = [], []
+    return reader

@@ -145,14 +145,42 @@ def run_config_script(flags: TrainCliFlags) -> dict:
 
     batch_size = int(pick("batch_size", flags.batch_size))
     reader = ns["train_reader"](batch_size)
+
+    # Output contract (v1 `Outputs(...)`): outputs[0] is the per-example
+    # cost; an optional second output (e.g. logits) feeds the evaluator —
+    # the role of the reference's evaluator layers attached to specific
+    # layer outputs.
+    def script_loss(out, b):
+        return out[0] if isinstance(out, tuple) else out
+
+    evaluator = _make_evaluator(s.get("evaluator"))
+    if evaluator is not None:
+        inner = evaluator
+
+        class _SecondOutput:
+            def reset(self):
+                inner.reset()
+
+            def batch_stats(self, out, batch):
+                o = out[1] if isinstance(out, tuple) else out
+                return inner.batch_stats(o, batch)
+
+            def update(self, stats):
+                inner.update(stats)
+
+            def result(self):
+                return inner.result()
+
+        evaluator = _SecondOutput()
+
     trainer = Trainer(
         model=net,
-        loss_fn=lambda out, b: out,    # cost layers return per-example costs
+        loss_fn=script_loss,           # cost layers return per-example costs
         optimizer=_make_optimizer(
             pick("optimizer", flags.optimizer),
             float(pick("learning_rate", flags.learning_rate))),
         forward=net_forward,
-        evaluator=_make_evaluator(s.get("evaluator")),
+        evaluator=evaluator,
         nan_check=flags.nan_check,
         param_stats_period=flags.param_stats_period or None)
     last = {}

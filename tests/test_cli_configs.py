@@ -64,3 +64,15 @@ def test_cli_job_modes():
     assert g["checkgrad_ok"] == 1
     e = _run("sequence_tagging_crf.py", ["--job", "test", "--use_bf16", "0"])
     assert np.isfinite(e["test_cost"])
+
+
+def test_mnist_mlp_config_with_evaluator():
+    """classification_cost + evaluator surface: the light_mnist config
+    trains from script with classification-error computed in-step, and the
+    test_reader feeds --job test."""
+    m = _run("mnist_mlp.py", ["--use_bf16", "0"])
+    assert "classification_error" in m
+    assert 0.0 <= m["classification_error"] <= 1.0
+    assert m["classification_error"] < 0.5      # separable synthetic task
+    t = _run("mnist_mlp.py", ["--job", "test", "--use_bf16", "0"])
+    assert np.isfinite(t["test_cost"])
