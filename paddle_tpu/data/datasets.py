@@ -455,12 +455,95 @@ def movielens(split: str = "train", n_users: int = 500, n_movies: int = 300,
     return reader
 
 
+def _conll05_real(split, vocab_size):
+    """Parse the real CoNLL-05 words/props pair (reference:
+    ``v2/dataset/conll05.py`` — test.wsj.words.gz + test.wsj.props.gz,
+    span-bracket notation per predicate column). Yields one sample per
+    (sentence, predicate): (word_ids, predicate_index, iob_label_ids)."""
+    base = os.path.join(data_home(), "conll05")
+    words_p = os.path.join(base, f"{split}.wsj.words.gz")
+    props_p = os.path.join(base, f"{split}.wsj.props.gz")
+    if not (os.path.exists(words_p) and os.path.exists(props_p)):
+        return None
+
+    def sentences(path, ncols=None):
+        out, cur = [], []
+        with gzip.open(path, "rt") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    if cur:
+                        out.append(cur)
+                        cur = []
+                else:
+                    cur.append(line.split())
+        if cur:
+            out.append(cur)
+        return out
+
+    word_sents = sentences(words_p)
+    prop_sents = sentences(props_p)
+    # word dict (frequency desc, word asc) — 0 = <unk>
+    import collections
+    freq = collections.Counter(w[0] for s in word_sents for w in s)
+    wdict = {w: i + 1 for i, (w, _) in enumerate(
+        sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        [:vocab_size - 1])}
+
+    samples = []
+    roles = set()
+    parsed = []
+    for ws, ps in zip(word_sents, prop_sents):
+        ids = np.asarray([wdict.get(w[0], 0) for w in ws], np.int32)
+        ncols = len(ps[0]) - 1            # col 0 = predicate lemma
+        for c in range(ncols):
+            spans = []                    # (role, start, end) inclusive
+            open_role, start = None, 0
+            pred_idx = 0
+            for t, row in enumerate(ps):
+                cell = row[1 + c]
+                if cell.startswith("("):
+                    open_role = cell[1:].split("*")[0].rstrip(")")
+                    start = t
+                if open_role == "V" and cell.startswith("("):
+                    pred_idx = t
+                if cell.endswith(")"):
+                    spans.append((open_role, start, t))
+                    open_role = None
+            roles.update(r for r, _, _ in spans if r != "V")
+            parsed.append((ids, pred_idx, spans))
+    role_ids = {r: i for i, r in enumerate(sorted(roles))}
+    for ids, pred_idx, spans in parsed:
+        labels = np.zeros(len(ids), np.int32)          # 0 = O
+        for r, s, e in spans:
+            if r == "V":
+                continue
+            rid = role_ids[r]
+            labels[s] = 1 + 2 * rid                    # B-
+            labels[s + 1:e + 1] = 2 + 2 * rid          # I-
+        samples.append((ids, np.int32(pred_idx), labels))
+    return samples, 1 + 2 * len(role_ids)
+
+
 def conll05(split: str = "train", vocab: int = 3000, n_labels: int = 13,
             max_len: int = 40, n: Optional[int] = None):
-    """CoNLL-05 semantic-role-labeling style data (reference:
+    """CoNLL-05 semantic-role-labeling data (reference:
     ``v2/dataset/conll05.py``) yielding ``(words, predicate_index,
-    labels)`` with IOB-coded labels. Synthetic fallback: arguments cluster
-    around the predicate so position features matter."""
+    labels)`` with IOB-coded labels. Parses real cached
+    ``{split}.wsj.words.gz`` + ``{split}.wsj.props.gz`` pairs; synthetic
+    fallback: arguments cluster around the predicate so position features
+    matter."""
+    real = _conll05_real(split, vocab)
+    if real is not None:
+        samples, real_n_labels = real
+
+        def reader():
+            yield from samples
+        reader.is_synthetic = False
+        reader.num_samples = len(samples)
+        reader.num_labels = real_n_labels
+        return reader
+
     n = n or (4096 if split == "train" else 512)
 
     def reader():

@@ -169,3 +169,33 @@ def test_movielens_real_zip_parsed(home):
     uid, mid, ufeat, genres, rating = next(iter(r()))
     assert ufeat.shape == (4,) and genres.shape == (6,)
     assert 1.0 <= float(rating) <= 5.0
+
+
+def test_conll05_real_files_parsed(home):
+    d = home / "conll05"
+    d.mkdir(parents=True)
+    # sentence 1: "the cat chased the mouse" — predicate 'chased',
+    # A0 = "the cat", A1 = "the mouse"; sentence 2: one predicate 'sat'
+    words1 = "the\ncat\nchased\nthe\nmouse\n\n"
+    words2 = "dogs\nsat\n\n"
+    props1 = ("-    (A0*\n-    *)\nchase    (V*)\n-    (A1*\n-    *)\n\n")
+    props2 = ("-    *\nsit    (V*)\n\n")
+    with gzip.open(d / "test.wsj.words.gz", "wt") as f:
+        f.write(words1 + words2)
+    with gzip.open(d / "test.wsj.props.gz", "wt") as f:
+        f.write(props1 + props2)
+    r = datasets.conll05("test", vocab=20)
+    assert r.is_synthetic is False
+    samples = list(r())
+    assert len(samples) == 2               # one per (sentence, predicate)
+    ids, pred, labels = samples[0]
+    assert int(pred) == 2                  # 'chased'
+    # A0 span = tokens 0-1 (B, I); A1 span = tokens 3-4 (B, I); V = O
+    assert labels[2] == 0
+    assert labels[0] != 0 and labels[1] == labels[0] + 1
+    assert labels[3] != 0 and labels[4] == labels[3] + 1
+    assert labels[0] != labels[3]
+    ids2, pred2, labels2 = samples[1]
+    assert int(pred2) == 1 and (labels2 == 0).all()
+    # 'the' is most frequent -> id 1
+    assert ids[0] == 1
