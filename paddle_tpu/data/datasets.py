@@ -649,11 +649,66 @@ def imikolov(split: str = "train", vocab: int = 2000, ngram: int = 5,
     return reader
 
 
+_WMT14_RESERVED = 3        # <s>=0, <e>=1, <unk>=2 (the reference's layout)
+
+
+def _wmt14_real(split, dict_size, max_len):
+    """Parse the real shrunk-WMT14 tarball (reference ``v2/dataset/
+    wmt14.py``: src.dict/trg.dict member files = one word per line, id =
+    line number; train/test members = tab-separated parallel lines; the
+    <s>/<e>/<unk> convention and the >80-token filter)."""
+    path = os.path.join(data_home(), "wmt14", "wmt14.tgz")
+    if not os.path.exists(path):
+        return None
+    import tarfile
+
+    def to_dict(fd, size):
+        out = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            out[line.strip().decode("utf-8", errors="replace")] = i
+        return out
+
+    samples = []
+    with tarfile.open(path) as tf:
+        src_name = [m.name for m in tf if m.name.endswith("src.dict")][0]
+        trg_name = [m.name for m in tf if m.name.endswith("trg.dict")][0]
+        src_dict = to_dict(tf.extractfile(src_name), dict_size)
+        trg_dict = to_dict(tf.extractfile(trg_name), dict_size)
+        member = "train/train" if split == "train" else "test/test"
+        names = [m.name for m in tf if m.name.endswith(member)]
+        for name in names:
+            for raw in tf.extractfile(name):
+                parts = raw.decode("utf-8", errors="replace").strip() \
+                    .split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [src_dict.get(w, 2) for w in
+                       ["<s>"] + parts[0].split() + ["<e>"]]
+                trg = [trg_dict.get(w, 2) for w in parts[1].split()]
+                if len(src) > 80 or len(trg) > 80:
+                    continue
+                tgt = [trg_dict.get("<s>", 0)] + trg + [trg_dict.get("<e>", 1)]
+                samples.append((np.asarray(src[:max_len], np.int32),
+                                np.asarray(tgt[:max_len + 1], np.int32)))
+    return samples
+
+
 def wmt14(split: str = "train", src_vocab: int = 1000, tgt_vocab: int = 1000,
           max_len: int = 30, n: Optional[int] = None):
-    """WMT14 en-fr translation surface (reference: ``v2/dataset/wmt14.py``).
-    Zero-egress stand-in: delegates to :func:`synthetic_nmt` (same structure
-    and reserved ids) under the reference's dataset name."""
+    """WMT14 en-fr translation surface (reference: ``v2/dataset/wmt14.py``)
+    yielding ``(src_ids, tgt_ids)`` (tgt bos-prefixed/eos-suffixed). Real
+    shrunk-WMT14 tarball when cached; otherwise delegates to
+    :func:`synthetic_nmt` (same structure and reserved ids) under the
+    reference's dataset name."""
+    real = _wmt14_real(split, max(src_vocab, tgt_vocab), max_len)
+    if real is not None:
+        def reader():
+            yield from real
+        reader.is_synthetic = False
+        reader.num_samples = len(real)
+        return reader
     return synthetic_nmt(split, src_vocab, tgt_vocab, max_len, n)
 
 

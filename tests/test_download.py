@@ -199,3 +199,35 @@ def test_conll05_real_files_parsed(home):
     assert int(pred2) == 1 and (labels2 == 0).all()
     # 'the' is most frequent -> id 1
     assert ids[0] == 1
+
+
+def test_wmt14_real_tarball_parsed(home):
+    d = home / "wmt14"
+    d.mkdir(parents=True)
+    buf = io.BytesIO()
+    src_dict = "<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = "<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    train = "hello world\tbonjour monde\nhello\tbonjour\n"
+    test = "world\tmonde\n"
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for name, text in (("wmt14/src.dict", src_dict),
+                           ("wmt14/trg.dict", trg_dict),
+                           ("wmt14/train/train", train),
+                           ("wmt14/test/test", test)):
+            data = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    (d / "wmt14.tgz").write_bytes(buf.getvalue())
+
+    r = datasets.wmt14("train")
+    assert r.is_synthetic is False
+    samples = list(r())
+    assert len(samples) == 2
+    src, tgt = samples[0]
+    # src = <s> hello world <e> = [0, 3, 4, 1]
+    np.testing.assert_array_equal(src, [0, 3, 4, 1])
+    # tgt = <s> bonjour monde <e> = [0, 3, 4, 1]
+    np.testing.assert_array_equal(tgt, [0, 3, 4, 1])
+    rt = datasets.wmt14("test")
+    assert rt.num_samples == 1 and rt.is_synthetic is False
