@@ -745,11 +745,46 @@ def voc2012(split: str = "train", hw: Tuple[int, int] = (96, 96),
     return reader
 
 
+def _mq2007_real(split):
+    """Parse real LETOR-format files (reference: ``v2/dataset/mq2007.py``;
+    line = ``rel qid:Q 1:v 2:v ... #docid``), grouping docs per query."""
+    path = os.path.join(data_home(), "mq2007", f"{split}.txt")
+    if not os.path.exists(path):
+        return None
+    import collections
+    by_query = collections.OrderedDict()
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            rel = int(parts[0])
+            qid = parts[1].split(":")[1]
+            feats = [float(p.split(":")[1]) for p in parts[2:]]
+            by_query.setdefault(qid, []).append((rel, feats))
+    samples = []
+    for qid, docs in by_query.items():
+        f = np.asarray([d[1] for d in docs], np.float32)
+        rel = np.asarray([d[0] for d in docs], np.int32)
+        samples.append((f, rel))
+    return samples
+
+
 def mq2007(split: str = "train", n_queries: int = 400, docs_per_query: int = 8,
            n_features: int = 16):
     """MQ2007 learning-to-rank surface (reference: ``v2/dataset/mq2007.py``)
-    yielding per-query groups ``(features [D, F], relevance [D])`` with
-    graded relevance 0-2 from a hidden linear model."""
+    yielding per-query groups ``(features [D, F], relevance [D])``. Real
+    LETOR files when cached (``mq2007/{split}.txt``); synthetic fallback
+    with graded relevance 0-2 from a hidden linear model."""
+    real = _mq2007_real(split)
+    if real is not None:
+        def reader():
+            yield from real
+        reader.is_synthetic = False
+        reader.num_samples = len(real)
+        return reader
+
     nq = n_queries if split == "train" else max(1, n_queries // 8)
     g = np.random.RandomState(46)
     w_hidden = g.normal(0, 1, n_features).astype(np.float32)

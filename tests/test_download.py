@@ -231,3 +231,20 @@ def test_wmt14_real_tarball_parsed(home):
     np.testing.assert_array_equal(tgt, [0, 3, 4, 1])
     rt = datasets.wmt14("test")
     assert rt.num_samples == 1 and rt.is_synthetic is False
+
+
+def test_mq2007_real_letor_parsed(home):
+    d = home / "mq2007"
+    d.mkdir(parents=True)
+    (d / "train.txt").write_text(
+        "2 qid:10 1:0.1 2:0.5 #docA\n"
+        "0 qid:10 1:0.3 2:0.1 #docB\n"
+        "1 qid:11 1:0.9 2:0.2 #docC\n")
+    r = datasets.mq2007("train")
+    assert r.is_synthetic is False
+    groups = list(r())
+    assert len(groups) == 2
+    f, rel = groups[0]
+    assert f.shape == (2, 2)
+    np.testing.assert_array_equal(rel, [2, 0])
+    np.testing.assert_allclose(f[0], [0.1, 0.5])
