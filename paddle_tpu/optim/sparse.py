@@ -39,6 +39,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -148,3 +149,76 @@ def l1_catchup(lr: float, decay: float) -> Callable:
         mag = jnp.maximum(jnp.abs(rows) - shrink.astype(rows.dtype), 0.0)
         return jnp.sign(rows) * mag
     return apply
+
+
+# -- host-offloaded tables ----------------------------------------------------
+
+class HostSparseTable:
+    """A sparse table whose storage lives in HOST memory — for tables larger
+    than device HBM (the regime the reference served with parameter
+    servers: the trainer only ever held the rows of the current batch,
+    ``SparseRemoteParameterUpdater`` + ``CacheRowCpuMatrix``,
+    ``math/SparseRowMatrix.h:31``).
+
+    Storage (``rows``, optimizer ``slots``, ``last_step``) are host numpy
+    arrays; :meth:`prefetch` gathers the batch's unique rows host-side and
+    ships only [U, D] to the device; :meth:`commit` scatters updated rows
+    back. The device never sees a [vocab, D] buffer. Same lazy-decay
+    catch-up semantics as the device path (:func:`sparse_prefetch`).
+    """
+
+    def __init__(self, rows, optimizer: Optimizer, catchup=None):
+        self.rows = np.asarray(rows)
+        # optimizer slots built on a single-row probe then expanded: avoids
+        # ever materialising a second full table on device
+        probe = jnp.asarray(self.rows[:1])
+        slot_probe = optimizer.init(probe)
+        self.slots = tmap(
+            lambda s: np.zeros((self.rows.shape[0],) + tuple(s.shape[1:]),
+                               s.dtype), slot_probe)
+        self.last_step = np.full((self.rows.shape[0],), -1, np.int64)
+        self.optimizer = optimizer
+        self.catchup = catchup
+
+    @property
+    def vocab(self):
+        return self.rows.shape[0]
+
+    def prefetch(self, ids, step: int):
+        """Host-side unique+gather; returns (uniq [U], gather_idx like ids,
+        device rows [U, D], device slots)."""
+        flat = np.asarray(ids).reshape(-1)
+        flat = np.where((flat >= 0) & (flat < self.vocab), flat, self.vocab)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        valid = uniq < self.vocab
+        safe = np.minimum(uniq, self.vocab - 1)
+        rows = self.rows[safe] * valid[:, None].astype(self.rows.dtype)
+        if self.catchup is not None:
+            last = self.last_step[safe]
+            idle = np.where(last < 0, step,
+                            np.maximum(step - last - 1, 0)).astype(np.int32)
+            rows = np.asarray(self.catchup(jnp.asarray(rows),
+                                           jnp.asarray(idle)))
+        slots = tmap(lambda s: jnp.asarray(s[safe]), self.slots)
+        return (uniq, inverse.reshape(np.shape(ids)), jnp.asarray(rows),
+                slots)
+
+    def commit(self, uniq, new_rows, new_slots, step: int) -> None:
+        """Scatter updated rows/slots back into host storage (in place)."""
+        keep = uniq < self.vocab
+        idx = uniq[keep]
+        self.rows[idx] = np.asarray(new_rows)[keep]
+        flat_self = jax.tree_util.tree_leaves(self.slots)
+        flat_new = jax.tree_util.tree_leaves(new_slots)
+        for s_host, s_new in zip(flat_self, flat_new):
+            s_host[idx] = np.asarray(s_new)[keep]
+        self.last_step[idx] = step
+
+    def update(self, uniq, row_grads, rows, slots, step) -> None:
+        """Row optimizer update + commit in one call (host driver side)."""
+        upd, new_slots = self.optimizer.update(row_grads, slots, rows,
+                                               jnp.asarray(step))
+        self.commit(uniq, np.asarray(rows + upd), new_slots, step)
+
+
+__all__ += ["HostSparseTable"]

@@ -238,3 +238,73 @@ def test_sparse_ctr_e2e_loss_decreases(nprng):
             params, opt_state, wide_tbl, deep_tbl, jnp.asarray(i), b)
         losses.append(float(loss))
     assert np.mean(losses[-5:]) < 0.55 * np.mean(losses[:5]), losses
+
+
+def test_host_offloaded_table_matches_device_path(nprng):
+    """HostSparseTable (storage in host RAM, only [U, D] rows on device)
+    must reproduce the device-resident sparse path exactly — the
+    tables->bigger-than-HBM regime the reference served with pservers."""
+    V, D = 64, 8
+    rows0 = nprng.normal(size=(V, D)).astype(np.float32)
+    opt_dev = optim.adagrad(0.1)
+    opt_host = optim.adagrad(0.1)
+    dev_tbl = sp.SparseTable(jnp.asarray(rows0), opt_dev.init(
+        jnp.asarray(rows0)), jnp.full((V,), -1, jnp.int32))
+    host_tbl = sp.HostSparseTable(rows0.copy(), opt_host)
+
+    rng = np.random.RandomState(3)
+    for step in range(5):
+        ids = rng.randint(-1, V, size=(6, 3)).astype(np.int32)
+        target = jnp.asarray(rng.normal(size=(6, 3, D)).astype(np.float32))
+
+        # device path
+        pre = sp.sparse_prefetch(dev_tbl, jnp.asarray(ids),
+                                 jnp.asarray(step))
+
+        def loss_dev(r):
+            e = jnp.where((jnp.asarray(ids) >= 0)[..., None],
+                          r[pre.gather_idx], 0.0)
+            return jnp.mean((e - target) ** 2)
+
+        g = jax.grad(loss_dev)(pre.rows)
+        upd, slots = opt_dev.update(g, pre.slots, pre.rows,
+                                    jnp.asarray(step))
+        dev_tbl = sp.sparse_commit(dev_tbl, pre, pre.rows + upd, slots,
+                                   step)
+
+        # host path
+        uniq, gidx, rows, hslots = host_tbl.prefetch(ids, step)
+
+        def loss_host(r):
+            e = jnp.where((jnp.asarray(ids) >= 0)[..., None], r[gidx], 0.0)
+            return jnp.mean((e - target) ** 2)
+
+        gh = jax.grad(loss_host)(rows)
+        uh, new_hslots = opt_host.update(gh, hslots, rows, jnp.asarray(step))
+        host_tbl.commit(uniq, np.asarray(rows + uh), new_hslots, step)
+
+    np.testing.assert_allclose(host_tbl.rows, np.asarray(dev_tbl.rows),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(
+        jax.tree_util.tree_leaves(host_tbl.slots)[0],
+        np.asarray(jax.tree_util.tree_leaves(dev_tbl.slots)[0]),
+        rtol=1e-6, atol=1e-7)
+
+
+def test_host_offloaded_lazy_catchup(nprng):
+    """Host table applies the same closed-form idle-decay catch-up."""
+    V, D, lr, decay = 16, 4, 0.1, 0.05
+    rows0 = np.ones((V, D), np.float32)
+    tbl = sp.HostSparseTable(rows0.copy(),
+                             optim.chain(optim.weight_decay(decay),
+                                         optim.sgd(lr)),
+                             catchup=sp.l2_catchup(lr, decay))
+    # touch row 0 at step 0, then row 0 again at step 3: catch-up must
+    # apply (1-lr*decay)^2 for the idle steps 1, 2
+    ids = np.array([[0]], np.int32)
+    uniq, gidx, rows, slots = tbl.prefetch(ids, 0)
+    tbl.update(uniq, jnp.zeros_like(rows), rows, slots, 0)
+    v_after0 = tbl.rows[0].copy()
+    uniq, gidx, rows, slots = tbl.prefetch(ids, 3)
+    want = v_after0 * (1 - lr * decay) ** 2
+    np.testing.assert_allclose(np.asarray(rows)[0], want, rtol=1e-6)
