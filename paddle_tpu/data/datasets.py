@@ -712,13 +712,74 @@ def wmt14(split: str = "train", src_vocab: int = 1000, tgt_vocab: int = 1000,
     return synthetic_nmt(split, src_vocab, tgt_vocab, max_len, n)
 
 
+VOC_CLASSES = ["aeroplane", "bicycle", "bird", "boat", "bottle", "bus",
+               "car", "cat", "chair", "cow", "diningtable", "dog", "horse",
+               "motorbike", "person", "pottedplant", "sheep", "sofa",
+               "train", "tvmonitor"]
+
+
+def _voc2012_real(split, hw, max_boxes):
+    """Parse a real VOCdevkit layout (reference: ``v2/dataset/voc2012.py``):
+    ``VOCdevkit/VOC2012/{JPEGImages,Annotations,ImageSets/Main}``; labels =
+    1 + index into the 20 VOC classes (0 = background)."""
+    root = os.path.join(data_home(), "voc2012", "VOCdevkit", "VOC2012")
+    setfile = os.path.join(root, "ImageSets", "Main",
+                           "train.txt" if split == "train" else "val.txt")
+    if not os.path.exists(setfile):
+        return None
+    import xml.etree.ElementTree as ET
+
+    from PIL import Image
+    H, W = hw
+    cls_id = {c: i + 1 for i, c in enumerate(VOC_CLASSES)}
+    with open(setfile) as f:
+        names = [ln.strip() for ln in f if ln.strip()]
+
+    def load(name):
+        img = Image.open(os.path.join(root, "JPEGImages",
+                                      name + ".jpg")).convert("RGB")
+        iw, ih = img.size
+        arr = np.asarray(img.resize((W, H)), np.float32) / 127.5 - 1.0
+        boxes = np.zeros((max_boxes, 4), np.float32)
+        labels = np.full((max_boxes,), -1, np.int32)
+        tree = ET.parse(os.path.join(root, "Annotations", name + ".xml"))
+        k = 0
+        for obj in tree.findall("object"):
+            if k >= max_boxes:
+                break
+            cname = obj.findtext("name")
+            bb = obj.find("bndbox")
+            if cname not in cls_id or bb is None:
+                continue
+            boxes[k] = [float(bb.findtext("xmin")) / iw,
+                        float(bb.findtext("ymin")) / ih,
+                        float(bb.findtext("xmax")) / iw,
+                        float(bb.findtext("ymax")) / ih]
+            labels[k] = cls_id[cname]
+            k += 1
+        return arr, boxes, labels
+
+    return names, load
+
+
 def voc2012(split: str = "train", hw: Tuple[int, int] = (96, 96),
             num_classes: int = 5, max_boxes: int = 4,
             n: Optional[int] = None):
     """VOC-style detection data (reference: ``v2/dataset/voc2012.py``)
     yielding ``(image [H,W,3], gt_boxes [max_boxes,4] normalized xyxy,
-    gt_labels [max_boxes] with -1 padding)``. Synthetic fallback: colored
+    gt_labels [max_boxes] with -1 padding)``. Real VOCdevkit when cached
+    (labels then span the 20 VOC classes); synthetic fallback: colored
     rectangles on noise — class = dominant channel, so detectors learn."""
+    real = _voc2012_real(split, hw, max_boxes)
+    if real is not None:
+        names, load = real
+
+        def reader():
+            for name in names:
+                yield load(name)
+        reader.is_synthetic = False
+        reader.num_samples = len(names)
+        return reader
     n = n or (2048 if split == "train" else 256)
     H, W = hw
 
@@ -808,11 +869,50 @@ def sentiment(split: str = "train", **kw):
     return imdb(split, **kw)
 
 
+def _flowers_real(split, hw):
+    """Parse the real Flowers-102 layout (reference:
+    ``v2/dataset/flowers.py``: ``102flowers/jpg`` images +
+    ``imagelabels.mat`` + ``setid.mat`` split ids)."""
+    base = os.path.join(data_home(), "flowers")
+    labels_p = os.path.join(base, "imagelabels.mat")
+    setid_p = os.path.join(base, "setid.mat")
+    jpg_dir = os.path.join(base, "jpg")
+    if not (os.path.exists(labels_p) and os.path.exists(setid_p)
+            and os.path.isdir(jpg_dir)):
+        return None
+    from PIL import Image
+    from scipy.io import loadmat
+    H, W = hw
+    labels = loadmat(labels_p)["labels"].ravel().astype(np.int32) - 1
+    sets = loadmat(setid_p)
+    # reference uses trnid for train, tstid for test
+    ids = sets["trnid" if split == "train" else "tstid"].ravel()
+
+    def load(i):
+        img = Image.open(os.path.join(
+            jpg_dir, f"image_{int(i):05d}.jpg")).convert("RGB")
+        arr = np.asarray(img.resize((W, H)), np.float32) / 127.5 - 1.0
+        return arr, labels[int(i) - 1]
+
+    return ids, load
+
+
 def flowers(split: str = "train", hw: Tuple[int, int] = (64, 64),
             num_classes: int = 102, synthetic_n: Optional[int] = None):
     """Flowers-102 classification surface (reference:
-    ``v2/dataset/flowers.py``) yielding ``(image [H,W,3], label)``;
-    synthetic separable fallback."""
+    ``v2/dataset/flowers.py``) yielding ``(image [H,W,3], label)``. Real
+    102flowers layout when cached; synthetic separable fallback."""
+    real = _flowers_real(split, hw)
+    if real is not None:
+        ids, load = real
+
+        def reader():
+            for i in ids:
+                yield load(i)
+        reader.is_synthetic = False
+        reader.num_samples = len(ids)
+        return reader
+
     n = synthetic_n or (2048 if split == "train" else 256)
     seed = 24 if split == "train" else 25
     images, labels = _synth_images(n, num_classes, hw, 3, seed)

@@ -248,3 +248,51 @@ def test_mq2007_real_letor_parsed(home):
     assert f.shape == (2, 2)
     np.testing.assert_array_equal(rel, [2, 0])
     np.testing.assert_allclose(f[0], [0.1, 0.5])
+
+
+def test_voc2012_real_devkit_parsed(home):
+    from PIL import Image
+    root = home / "voc2012" / "VOCdevkit" / "VOC2012"
+    (root / "JPEGImages").mkdir(parents=True)
+    (root / "Annotations").mkdir(parents=True)
+    (root / "ImageSets" / "Main").mkdir(parents=True)
+    Image.new("RGB", (100, 80), (120, 30, 200)).save(
+        root / "JPEGImages" / "x1.jpg")
+    (root / "Annotations" / "x1.xml").write_text(
+        "<annotation><object><name>dog</name><bndbox>"
+        "<xmin>10</xmin><ymin>8</ymin><xmax>60</xmax><ymax>40</ymax>"
+        "</bndbox></object>"
+        "<object><name>person</name><bndbox>"
+        "<xmin>50</xmin><ymin>20</ymin><xmax>90</xmax><ymax>70</ymax>"
+        "</bndbox></object></annotation>")
+    (root / "ImageSets" / "Main" / "train.txt").write_text("x1\n")
+    r = datasets.voc2012("train", hw=(32, 32), max_boxes=3)
+    assert r.is_synthetic is False
+    img, boxes, labels = next(iter(r()))
+    assert img.shape == (32, 32, 3)
+    np.testing.assert_allclose(boxes[0], [0.1, 0.1, 0.6, 0.5], atol=1e-6)
+    from paddle_tpu.data.datasets import VOC_CLASSES
+    assert labels[0] == 1 + VOC_CLASSES.index("dog")
+    assert labels[1] == 1 + VOC_CLASSES.index("person")
+    assert labels[2] == -1
+
+
+def test_flowers_real_layout_parsed(home):
+    from PIL import Image
+    from scipy.io import savemat
+    base = home / "flowers"
+    (base / "jpg").mkdir(parents=True)
+    for i in (1, 2, 3):
+        Image.new("RGB", (40, 40), (i * 40, 10, 10)).save(
+            base / "jpg" / f"image_{i:05d}.jpg")
+    savemat(base / "imagelabels.mat",
+            {"labels": np.array([[5, 7, 9]])})
+    savemat(base / "setid.mat",
+            {"trnid": np.array([[1, 3]]), "tstid": np.array([[2]])})
+    r = datasets.flowers("train", hw=(16, 16))
+    assert r.is_synthetic is False and r.num_samples == 2
+    img, lab = next(iter(r()))
+    assert img.shape == (16, 16, 3) and lab == 4    # label 5 -> 0-based 4
+    rt = datasets.flowers("test", hw=(16, 16))
+    _, lab_t = next(iter(rt()))
+    assert rt.num_samples == 1 and lab_t == 6
