@@ -102,6 +102,17 @@ def make_pipeline(mesh: Mesh, stage_fn: Callable, pipe_axis: str = "pipe"):
         out_specs=P())
 
 
+def _call_loss(loss_fn, out, mb_index):
+    """loss_fn may take (out) or (out, microbatch_index) — the index form
+    lets per-microbatch targets live in closure arrays."""
+    import inspect
+    try:
+        n = len(inspect.signature(loss_fn).parameters)
+    except (TypeError, ValueError):
+        n = 1
+    return loss_fn(out, mb_index) if n >= 2 else loss_fn(out)
+
+
 def pipeline_grads_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
                         x, axis_name: str, axis_size: int):
     """One-forward-one-backward (1F1B) training schedule — call INSIDE
@@ -155,8 +166,10 @@ def pipeline_grads_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
 
         def fwd_loss(p, a):
             out = stage_fn(p, a)
-            # last stage closes the loss; others forward the cotangent
-            l = loss_fn(out)
+            # last stage closes the loss; others forward the cotangent.
+            # loss_fn takes (out_mb, mb_index) so per-microbatch targets
+            # (labels, masks) can be indexed from closure state.
+            l = _call_loss(loss_fn, out, jnp.clip(bi, 0, M - 1))
             return jnp.where(stage == S - 1, l, jnp.sum(out * bwd_cot)), l
 
         val, vjp, l = jax.vjp(fwd_loss, stage_params, b_in, has_aux=True)
