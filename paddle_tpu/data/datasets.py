@@ -19,7 +19,8 @@ from typing import Callable, Iterator, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["data_home", "mnist", "cifar10", "uci_housing", "imdb", "synthetic_nmt",
+__all__ = ["data_home", "mnist", "cifar10", "cifar100", "uci_housing",
+           "imdb", "synthetic_nmt",
            "synthetic_tagging", "synthetic_ctr", "movielens", "conll05",
            "imikolov", "wmt14", "voc2012", "mq2007", "sentiment", "flowers",
            "traffic"]
@@ -122,14 +123,16 @@ def mnist(split: str = "train", synthetic_n: Optional[int] = None):
 
 _CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
 _CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
+_CIFAR100_URL = "https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz"
+_CIFAR100_MD5 = "eb9058c3a382ffc7106e4002c42a8d85"
 
 
-def _try_download_cifar10():
+def _try_download_cifar(url, md5):
     from .download import DownloadDisabled, download, downloads_enabled
     if not downloads_enabled():
         return
     try:
-        tar = download(_CIFAR10_URL, "cifar", _CIFAR10_MD5)
+        tar = download(url, "cifar", md5)
     except (DownloadDisabled, IOError):
         return
     import tarfile
@@ -137,16 +140,14 @@ def _try_download_cifar10():
         tf.extractall(data_home(), filter="data")
 
 
-def cifar10(split: str = "train", synthetic_n: Optional[int] = None):
-    """CIFAR-10 reader (reference: ``v2/dataset/cifar.py``) yielding
-    ``(image [32,32,3], label)``; auto-download via ``data/download.py``
-    when enabled, synthetic fallback otherwise."""
-    base = os.path.join(data_home(), "cifar-10-batches-py")
-    files = ([f"data_batch_{i}" for i in range(1, 6)] if split == "train"
-             else ["test_batch"])
+def _cifar_reader(base, files, label_key, num_classes, url, md5, split,
+                  synthetic_n, synth_seeds):
+    """Shared CIFAR-10/100 loader (both splits live in one pickle format;
+    reference ``v2/dataset/cifar.py`` serves the two sets from one
+    ``reader_creator``)."""
     paths = [os.path.join(base, f) for f in files]
     if not all(os.path.exists(p) for p in paths):
-        _try_download_cifar10()
+        _try_download_cifar(url, md5)
     if all(os.path.exists(p) for p in paths):
         import pickle
         xs, ys = [], []
@@ -154,16 +155,17 @@ def cifar10(split: str = "train", synthetic_n: Optional[int] = None):
             with open(p, "rb") as f:
                 d = pickle.load(f, encoding="bytes")
             xs.append(np.asarray(d[b"data"], np.float32))
-            ys.extend(d[b"labels"])
+            ys.extend(d[label_key])
         images = (np.concatenate(xs).reshape(-1, 3, 32, 32)
                   .transpose(0, 2, 3, 1) / 127.5 - 1.0).astype(np.float32)
         labels = np.asarray(ys, np.int32)
         is_synthetic = False
     else:
         n = synthetic_n or (8192 if split == "train" else 2048)
-        images, labels = _synth_images(n, 10, (32, 32), 3,
-                                       seed=2 if split == "train" else 3,
-                                       proto_seed=4321)
+        images, labels = _synth_images(
+            n, num_classes, (32, 32), 3,
+            seed=synth_seeds[0] if split == "train" else synth_seeds[1],
+            proto_seed=synth_seeds[2])
         is_synthetic = True
 
     def reader():
@@ -172,6 +174,31 @@ def cifar10(split: str = "train", synthetic_n: Optional[int] = None):
     reader.is_synthetic = is_synthetic
     reader.num_samples = len(labels)
     return reader
+
+
+def cifar10(split: str = "train", synthetic_n: Optional[int] = None):
+    """CIFAR-10 reader (reference: ``v2/dataset/cifar.py``) yielding
+    ``(image [32,32,3], label)``; auto-download via ``data/download.py``
+    when enabled, synthetic fallback otherwise."""
+    files = ([f"data_batch_{i}" for i in range(1, 6)] if split == "train"
+             else ["test_batch"])
+    return _cifar_reader(os.path.join(data_home(), "cifar-10-batches-py"),
+                         files, b"labels", 10, _CIFAR10_URL, _CIFAR10_MD5,
+                         split, synthetic_n, (2, 3, 4321))
+
+
+def cifar100(split: str = "train", synthetic_n: Optional[int] = None,
+             label_kind: str = "fine"):
+    """CIFAR-100 reader (reference: ``v2/dataset/cifar.py`` serves 10 and
+    100 from the same pickle format) yielding ``(image [32,32,3], label)``
+    with fine (100-way) or coarse (20-way) labels."""
+    assert label_kind in ("fine", "coarse")
+    key = (b"fine_labels" if label_kind == "fine" else b"coarse_labels")
+    return _cifar_reader(os.path.join(data_home(), "cifar-100-python"),
+                         ["train" if split == "train" else "test"],
+                         key, 100 if label_kind == "fine" else 20,
+                         _CIFAR100_URL, _CIFAR100_MD5,
+                         split, synthetic_n, (5, 6, 8765))
 
 
 def uci_housing(split: str = "train"):

@@ -119,10 +119,26 @@ class Embedding(Module):
         return jnp.dot(x, self.table().T)
 
 
+# How 1x1 convs lower: "conv" = lax.conv_general_dilated; "matmul" =
+# reshape + dot (XLA's matmul path — different tiling than its conv path);
+# "pallas" = matmul forward + Pallas dW reduction kernel
+# (nn/pallas_conv.py). Measured per-shape in experiments/conv1x1_backward.py.
+_CONV1X1_IMPL = "conv"
+
+
+def set_conv1x1_impl(impl: str) -> str:
+    """Select the 1x1-conv lowering globally; returns the previous value."""
+    global _CONV1X1_IMPL
+    assert impl in ("conv", "matmul", "pallas"), impl
+    prev, _CONV1X1_IMPL = _CONV1X1_IMPL, impl
+    return prev
+
+
 class Conv2D(Module):
     """2-D convolution, NHWC/HWIO (reference: ``ExpandConvLayer`` /
     ``CudnnConvLayer``, ``gserver/layers/ExpandConvLayer.cpp``; function-layer
-    ``GemmConvOp``). XLA lowers this onto the MXU directly."""
+    ``GemmConvOp``). XLA lowers this onto the MXU directly; 1x1 convs can
+    route through the matmul/Pallas path (:func:`set_conv1x1_impl`)."""
 
     def __init__(self, features: int, kernel: Pair, stride: Pair = 1,
                  padding="SAME", dilation: Pair = 1, groups: int = 1, act="",
@@ -147,11 +163,31 @@ class Conv2D(Module):
         # Output stays in compute dtype (the MXU accumulates f32 internally
         # for bf16 operands); upcasting via preferred_element_type would break
         # the conv rhs-transpose rule, which requires operand dtypes to match.
-        y = lax.conv_general_dilated(
-            pol.cast_compute(x), pol.cast_compute(w),
-            window_strides=self.stride, padding=self.padding,
-            rhs_dilation=self.dilation, feature_group_count=self.groups,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # for a 1x1 kernel SAME == VALID == zero padding; only explicit
+        # nonzero padding keeps the conv path
+        pad_free = (self.padding in ("SAME", "VALID")
+                    or all(p == (0, 0) for p in self.padding))
+        if ((kh, kw) == (1, 1) and self.dilation == (1, 1)
+                and self.groups == 1 and pad_free
+                and _CONV1X1_IMPL != "conv"):
+            from . import pallas_conv
+            xc = pol.cast_compute(x)
+            wc = pol.cast_compute(w).reshape(cin, self.features)
+            if _CONV1X1_IMPL == "pallas":
+                y = pallas_conv.conv1x1_strided(xc, wc, self.stride)
+            else:
+                sh, sw = self.stride
+                if (sh, sw) != (1, 1):
+                    xc = xc[:, ::sh, ::sw, :]
+                b_, h_, w_, _ = xc.shape
+                y = (xc.reshape(b_ * h_ * w_, cin) @ wc).reshape(
+                    b_, h_, w_, self.features)
+        else:
+            y = lax.conv_general_dilated(
+                pol.cast_compute(x), pol.cast_compute(w),
+                window_strides=self.stride, padding=self.padding,
+                rhs_dilation=self.dilation, feature_group_count=self.groups,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.use_bias:
             y = y + self.param("b", I.zeros, (self.features,)).astype(y.dtype)
         return self.act(y)

@@ -186,10 +186,21 @@ class HostSparseTable:
 
     def prefetch(self, ids, step: int):
         """Host-side unique+gather; returns (uniq [U], gather_idx like ids,
-        device rows [U, D], device slots)."""
+        device rows [U, D], device slots).
+
+        U is FIXED at ``ids.size`` regardless of the batch's duplicate
+        structure (padding slots carry the sentinel id ``vocab`` and zero
+        rows, exactly like the device path's ``jnp.unique(size=...)``): a
+        jitted consumer of the [U, D] rows sees one static shape across
+        batches and compiles once — the reference's fixed working set
+        (``CacheRowCpuMatrix``, ``math/SparseRowMatrix.h``)."""
         flat = np.asarray(ids).reshape(-1)
         flat = np.where((flat >= 0) & (flat < self.vocab), flat, self.vocab)
         uniq, inverse = np.unique(flat, return_inverse=True)
+        pad = flat.size - uniq.size
+        if pad:
+            uniq = np.concatenate(
+                [uniq, np.full(pad, self.vocab, uniq.dtype)])
         valid = uniq < self.vocab
         safe = np.minimum(uniq, self.vocab - 1)
         rows = self.rows[safe] * valid[:, None].astype(self.rows.dtype)

@@ -129,6 +129,13 @@ def pipeline_grads_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
     the stage forward from its saved INPUT (`jax.vjp` at backward time):
     boundary-only saving + in-stage rematerialisation, the standard
     memory/FLOP trade.
+
+    Compute cost: off-tick events are skipped via ``lax.cond`` (HLO
+    conditional), so each device executes exactly M forwards and M
+    recompute-vjp passes over the whole schedule — the ideal 1F1B budget
+    plus the rematerialisation forward, NOT ``T = 2M+2S-2`` copies of each
+    (the pre-round-4 version ran every event on every tick and masked the
+    results, ~3x the FLOPs).
     """
     S = axis_size
     stage = lax.axis_index(axis_name)
@@ -149,14 +156,26 @@ def pipeline_grads_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
     def body(t, carry):
         fwd_act, bwd_cot, abuf, gacc, lacc = carry
 
+        # Off-tick events SKIP their stage compute via lax.cond (HLO
+        # conditional executes one branch): per tick a device runs at most
+        # one forward and one recompute-vjp, so total stage executions are
+        # M fwd + M recompute + M bwd per device — the ideal 1F1B compute
+        # budget — not T = 2M+2S-2 of each. The predicates are
+        # device-varying (stage enters them), which shard_map's varying-axes
+        # tracking allows for collective-free branches; the ppermute hops
+        # stay outside, executed by every device every tick.
+
         # -- forward event: t == stage + 2*fi -------------------------------
         df = t - stage
         fi = df // 2
         fwd_on = (df >= 0) & (df % 2 == 0) & (fi < M)
         f_in = jnp.where(stage == 0, x[jnp.clip(fi, 0, M - 1)], fwd_act)
-        abuf = jnp.where(fwd_on, abuf.at[fi % S].set(f_in), abuf)
-        y = stage_fn(stage_params, f_in)
-        send_f = jnp.where(fwd_on, y, y * 0.0)
+
+        def do_fwd(abuf):
+            return stage_fn(stage_params, f_in), abuf.at[fi % S].set(f_in)
+
+        send_f, abuf = lax.cond(fwd_on, do_fwd,
+                                lambda abuf: (act0, abuf), abuf)
 
         # -- backward event: t == 2S-1-stage + 2*bi -------------------------
         db = t - (2 * S - 1 - stage)
@@ -172,12 +191,15 @@ def pipeline_grads_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
             l = _call_loss(loss_fn, out, jnp.clip(bi, 0, M - 1))
             return jnp.where(stage == S - 1, l, jnp.sum(out * bwd_cot)), l
 
-        val, vjp, l = jax.vjp(fwd_loss, stage_params, b_in, has_aux=True)
-        dparams, dact = vjp(jnp.ones_like(val))
-        gacc = jax.tree_util.tree_map(
-            lambda g, d: g + jnp.where(bwd_on, d, 0.0), gacc, dparams)
-        lacc = lacc + jnp.where(bwd_on & (stage == S - 1), l, 0.0)
-        send_b = jnp.where(bwd_on, dact, dact * 0.0)
+        def do_bwd(_):
+            val, vjp, l = jax.vjp(fwd_loss, stage_params, b_in, has_aux=True)
+            dparams, dact = vjp(jnp.ones_like(val))
+            return dparams, dact, l
+
+        dparams, send_b, l = lax.cond(
+            bwd_on, do_bwd, lambda _: (grad0, cot0, loss0), None)
+        gacc = jax.tree_util.tree_map(lambda g, d: g + d, gacc, dparams)
+        lacc = lacc + jnp.where(stage == S - 1, l, 0.0)
 
         # -- hops ------------------------------------------------------------
         fwd_act_next = lax.ppermute(send_f, axis_name, fwd_perm)

@@ -1,0 +1,117 @@
+"""Plot training curves from trainer logs — the ``paddle.utils.plotcurve``
+analog (reference: ``/root/reference/python/paddle/utils/plotcurve.py``,
+which greps ``Pass=.. Batch=.. AvgCost=..`` lines out of ``paddle.INFO``
+and plots the requested keys).
+
+This framework's trainer logs ``pass P batch B cost=C k=v ...``
+(``train/trainer.py`` log_period lines); this tool parses any ``key=float``
+token from those lines, from a file or stdin, and writes a figure (or,
+with no matplotlib, a gnuplot-style ``.dat`` table — the plot data is the
+point; rendering is optional).
+
+Usage::
+
+    python -m paddle_tpu.utils.plotcurve -i train.log -o curve.png cost
+    some_cmd | python -m paddle_tpu.utils.plotcurve -o curve.png cost error
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+__all__ = ["parse_log", "plot_curves", "main"]
+
+# a trainer progress line: "... pass 0 batch 100 cost=0.6931 error=0.4 ..."
+_LINE_RE = re.compile(r"pass\s+(\d+)\s+batch\s+(\d+)", re.IGNORECASE)
+_KV_RE = re.compile(r"([A-Za-z_][\w.]*)=([-+.\deE]+)")
+
+
+def parse_log(lines: Iterable[str], keys: Sequence[str]) -> Dict[
+        str, List[Tuple[int, float]]]:
+    """Extract ``key=value`` series from trainer log lines.
+
+    Returns ``{key: [(global_batch_index, value), ...]}``; the x axis is the
+    running line count of progress lines (the reference plots sequence
+    position too — batch counters reset every pass)."""
+    out: Dict[str, List[Tuple[int, float]]] = {k: [] for k in keys}
+    x = 0
+    for line in lines:
+        if not _LINE_RE.search(line):
+            continue
+        kvs = dict(_KV_RE.findall(line))
+        hit = False
+        for k in keys:
+            if k in kvs:
+                try:
+                    out[k].append((x, float(kvs[k])))
+                    hit = True
+                except ValueError:
+                    pass
+        if hit:
+            x += 1
+    return out
+
+
+def plot_curves(series: Dict[str, List[Tuple[int, float]]], output,
+                fmt: str = "png") -> str:
+    """Render the parsed series. With matplotlib available writes a figure
+    and returns "figure"; otherwise writes a plain-text table and returns
+    "table"."""
+    try:
+        import matplotlib
+        matplotlib.use("Agg")           # headless (remote session) safe
+        import matplotlib.pyplot as plt
+    except ImportError:
+        rows = sorted({x for pts in series.values() for x, _ in pts})
+        cols = {k: dict(pts) for k, pts in series.items()}
+        with (open(output, "w") if isinstance(output, str) else output) as f:
+            f.write("# x " + " ".join(series) + "\n")
+            for x in rows:
+                f.write(" ".join([str(x)] + [
+                    format(cols[k].get(x, float("nan")), ".6g")
+                    for k in series]) + "\n")
+        return "table"
+
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for k, pts in series.items():
+        if pts:
+            xs, ys = zip(*pts)
+            ax.plot(xs, ys, label=k)
+    ax.set_xlabel("batch (cumulative)")
+    ax.legend()
+    ax.grid(True, alpha=0.3)
+    fig.savefig(output, format=fmt, bbox_inches="tight")
+    plt.close(fig)
+    return "figure"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Plot training curves from paddle_tpu trainer logs.")
+    p.add_argument("-i", "--input", default=None,
+                   help="log file (default: stdin)")
+    p.add_argument("-o", "--output", default=None,
+                   help="figure file (default: stdout)")
+    p.add_argument("--format", default="png",
+                   help="figure format (png|pdf|ps|eps|svg)")
+    p.add_argument("key", nargs="*", default=["cost"],
+                   help="keys to plot (default: cost)")
+    args = p.parse_args(argv)
+    keys = args.key or ["cost"]
+    stream = open(args.input) if args.input else sys.stdin
+    try:
+        series = parse_log(stream, keys)
+    finally:
+        if args.input:
+            stream.close()
+    out = args.output or getattr(sys.stdout, "buffer", sys.stdout)
+    kind = plot_curves(series, out, fmt=args.format)
+    n = {k: len(v) for k, v in series.items()}
+    print(f"plotted {n} as {kind}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -89,6 +89,37 @@ def test_mnist_synthetic_fallback_is_labelled(home, monkeypatch):
     assert r.is_synthetic is True
 
 
+def test_cifar100_real_pickles_parsed(home):
+    import pickle
+    d = home / "cifar-100-python"
+    d.mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    for split, n in (("train", 6), ("test", 3)):
+        payload = {
+            b"data": rng.randint(0, 256, size=(n, 3072)).astype(np.uint8),
+            b"fine_labels": list(range(n)),
+            b"coarse_labels": [i % 20 for i in range(n)],
+        }
+        (d / split).write_bytes(pickle.dumps(payload))
+
+    r = datasets.cifar100("train")
+    assert r.is_synthetic is False and r.num_samples == 6
+    x, y = next(iter(r()))
+    assert x.shape == (32, 32, 3) and x.dtype == np.float32
+    assert x.min() >= -1.0 and x.max() <= 1.0 and y == 0
+    rc = datasets.cifar100("test", label_kind="coarse")
+    labels = [lab for _, lab in rc()]
+    assert labels == [0, 1, 2] and rc.num_samples == 3
+
+
+def test_cifar100_synthetic_fallback_is_labelled(home, monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_AUTO_DOWNLOAD", raising=False)
+    r = datasets.cifar100("train", synthetic_n=16)
+    assert r.is_synthetic is True and r.num_samples == 16
+    labels = {lab for _, lab in r()}
+    assert labels <= set(range(100))
+
+
 def test_imdb_real_tarball_parsed(home):
     d = home / "imdb"
     d.mkdir(parents=True)

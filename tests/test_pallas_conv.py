@@ -1,0 +1,107 @@
+"""1x1-conv matmul/Pallas path (nn/pallas_conv.py): numeric oracles.
+
+The bottleneck-backward perf lever (PERF.md r3 -> r4): forward, dx and the
+Pallas-accumulated dW must match the lax.conv path exactly; Conv2D must
+produce identical models under every ``set_conv1x1_impl`` choice."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu import nn
+from paddle_tpu.nn import pallas_conv
+from paddle_tpu.nn.layers import set_conv1x1_impl
+
+
+@pytest.fixture
+def nprng():
+    return np.random.RandomState(0)
+
+
+def conv_form(x, w):
+    return lax.conv_general_dilated(
+        x, w.reshape(1, 1, *w.shape), window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def test_dw_pallas_matches_oracle(nprng):
+    m, cin, cout = 160, 8, 24
+    x = jnp.asarray(nprng.normal(size=(m, cin)).astype(np.float32))
+    dy = jnp.asarray(nprng.normal(size=(m, cout)).astype(np.float32))
+    got = pallas_conv.dw_pallas(x, dy)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x.T @ dy),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dw_pallas_single_chunk_odd_m(nprng):
+    # m prime-ish: falls back to one chunk
+    m, cin, cout = 34, 8, 8
+    x = jnp.asarray(nprng.normal(size=(m, cin)).astype(np.float32))
+    dy = jnp.asarray(nprng.normal(size=(m, cout)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(pallas_conv.dw_pallas(x, dy)),
+                               np.asarray(x.T @ dy), rtol=1e-5, atol=1e-5)
+
+
+def test_conv1x1_value_and_grads_match_conv(nprng):
+    b, h, w_, cin, cout = 4, 6, 6, 8, 16
+    x = jnp.asarray(nprng.normal(size=(b, h, w_, cin)).astype(np.float32))
+    w = jnp.asarray(nprng.normal(size=(cin, cout)).astype(np.float32) * 0.1)
+    dy = jnp.asarray(nprng.normal(size=(b, h, w_, cout)).astype(np.float32))
+
+    y1, vjp1 = jax.vjp(pallas_conv.conv1x1, x, w)
+    y2, vjp2 = jax.vjp(conv_form, x, w)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-5, atol=1e-5)
+    (dx1, dw1), (dx2, dw2) = vjp1(dy), vjp2(dy)
+    np.testing.assert_allclose(np.asarray(dx1), np.asarray(dx2),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw1), np.asarray(dw2),
+                               rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["matmul", "pallas"])
+@pytest.mark.parametrize("stride", [1, 2])
+def test_conv2d_impls_agree(nprng, impl, stride):
+    """Conv2D(1x1) under matmul/pallas == the conv lowering, values AND
+    parameter grads, including the strided (shortcut-downsample) case."""
+    x = jnp.asarray(nprng.normal(size=(2, 8, 8, 6)).astype(np.float32))
+    m = nn.Conv2D(10, 1, stride=stride, padding="SAME", name="c")
+    variables = m.init(jax.random.PRNGKey(0), x)
+
+    def loss(params):
+        return jnp.sum(m.apply({"params": params}, x) ** 2)
+
+    prev = set_conv1x1_impl("conv")
+    try:
+        want_y = m.apply(variables, x)
+        want_g = jax.grad(loss)(variables["params"])
+        set_conv1x1_impl(impl)
+        got_y = m.apply(variables, x)
+        got_g = jax.grad(loss)(variables["params"])
+    finally:
+        set_conv1x1_impl(prev)
+    np.testing.assert_allclose(np.asarray(got_y), np.asarray(want_y),
+                               rtol=1e-5, atol=1e-5)
+    for (pa, a), (_, b_) in zip(
+            jax.tree_util.tree_flatten_with_path(got_g)[0],
+            jax.tree_util.tree_flatten_with_path(want_g)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-4, err_msg=str(pa))
+
+
+def test_conv2d_3x3_unaffected_by_impl(nprng):
+    """Non-1x1 convs must ignore the impl switch."""
+    x = jnp.asarray(nprng.normal(size=(2, 8, 8, 4)).astype(np.float32))
+    m = nn.Conv2D(8, 3, padding="SAME", name="c")
+    variables = m.init(jax.random.PRNGKey(0), x)
+    prev = set_conv1x1_impl("pallas")
+    try:
+        got = m.apply(variables, x)
+    finally:
+        set_conv1x1_impl(prev)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(m.apply(variables, x)),
+                               rtol=1e-6, atol=1e-6)

@@ -422,3 +422,21 @@ def test_pipeline_1f1b_many_microbatches(nprng):
     np.testing.assert_allclose(np.asarray(grads["w"]),
                                np.asarray(jax.grad(seq_loss)({"w": w})["w"]),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_1f1b_gates_compute_with_conditionals(nprng):
+    """Off-tick events must SKIP stage compute, not run-and-mask it: the
+    lowered schedule carries one HLO conditional per event class (forward,
+    backward) inside the tick loop, so a device idles on its bubble ticks —
+    the ideal M-fwd + M-recompute-vjp 1F1B budget, not 2M+2S-2 of each."""
+    mesh = pt.make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    S, M, mb, D = 4, 6, 2, 8
+    w = jnp.asarray(nprng.normal(size=(S, D, D)).astype(np.float32) * 0.3)
+    x = jnp.asarray(nprng.normal(size=(M, mb, D)).astype(np.float32))
+
+    f1b = parallel.make_pipeline_1f1b(
+        mesh, lambda p, a: jnp.tanh(a @ p["w"]), lambda o: jnp.sum(o ** 2))
+    txt = jax.jit(f1b).lower({"w": w}, x).as_text()
+    n_cond = txt.count("stablehlo.case") + txt.count("stablehlo.if")
+    assert n_cond == 2, f"expected fwd+bwd conditionals in the tick loop, " \
+                        f"found {n_cond}"

@@ -1,0 +1,43 @@
+"""plotcurve analog (reference ``python/paddle/utils/plotcurve.py``):
+parse trainer progress lines, render a figure (or plain table headless)."""
+
+import io
+
+from paddle_tpu.utils import plotcurve
+
+
+LOG = """\
+INFO paddle_tpu.trainer pass 0 batch 100 cost=0.6931 error=0.5000
+some unrelated line
+INFO paddle_tpu.trainer pass 0 batch 200 cost=0.5122 error=0.4100
+INFO paddle_tpu.trainer pass 1 batch 100 cost=0.3301 error=0.2500
+"""
+
+
+def test_parse_log_extracts_series():
+    series = plotcurve.parse_log(LOG.splitlines(), ["cost", "error"])
+    assert [v for _, v in series["cost"]] == [0.6931, 0.5122, 0.3301]
+    assert [v for _, v in series["error"]] == [0.5, 0.41, 0.25]
+    # x is cumulative across passes (batch counters reset per pass)
+    assert [x for x, _ in series["cost"]] == [0, 1, 2]
+
+
+def test_parse_log_missing_key_is_empty():
+    series = plotcurve.parse_log(LOG.splitlines(), ["nope"])
+    assert series["nope"] == []
+
+
+def test_plot_curves_writes_output(tmp_path):
+    series = plotcurve.parse_log(LOG.splitlines(), ["cost"])
+    out = tmp_path / "curve.png"
+    kind = plotcurve.plot_curves(series, str(out))
+    assert kind in ("figure", "table")
+    assert out.exists() and out.stat().st_size > 0
+
+
+def test_cli_roundtrip(tmp_path, capsys):
+    log = tmp_path / "train.log"
+    log.write_text(LOG)
+    out = tmp_path / "fig.png"
+    plotcurve.main(["-i", str(log), "-o", str(out), "cost", "error"])
+    assert out.exists() and out.stat().st_size > 0

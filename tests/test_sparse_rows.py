@@ -291,6 +291,40 @@ def test_host_offloaded_table_matches_device_path(nprng):
         rtol=1e-6, atol=1e-7)
 
 
+def test_host_table_static_shapes_compile_once(nprng):
+    """HostSparseTable.prefetch pads unique rows to a FIXED U = ids.size
+    (sentinel id = vocab, zero rows), so a jitted consumer of the [U, D]
+    working set compiles ONCE across batches with different duplicate
+    structure — the reference's fixed working set (CacheRowCpuMatrix,
+    ``math/SparseRowMatrix.h``)."""
+    V, D = 32, 4
+    tbl = sp.HostSparseTable(
+        nprng.normal(size=(V, D)).astype(np.float32), optim.sgd(0.1))
+
+    consumer = jax.jit(lambda rows, gidx: jnp.sum(rows[gidx] ** 2))
+    rng = np.random.RandomState(0)
+    batches = [
+        np.zeros((4, 2), np.int32),                      # 1 unique id
+        rng.randint(0, V, size=(4, 2)).astype(np.int32),  # many unique
+        np.full((4, 2), -1, np.int32),                   # all padding
+    ]
+    seen_U = set()
+    for step, ids in enumerate(batches):
+        uniq, gidx, rows, _ = tbl.prefetch(ids, step)
+        assert uniq.shape[0] == ids.size
+        seen_U.add(rows.shape)
+        consumer(rows, jnp.asarray(gidx))
+    assert seen_U == {(batches[0].size, D)}
+    assert consumer._cache_size() == 1
+
+    # commit still drops the sentinel padding slots
+    uniq, gidx, rows, slots = tbl.prefetch(batches[0], 10)
+    before = tbl.rows.copy()
+    tbl.update(uniq, jnp.ones_like(rows), rows, slots, 10)
+    changed = np.where(np.any(tbl.rows != before, axis=1))[0]
+    np.testing.assert_array_equal(changed, [0])
+
+
 def test_host_offloaded_lazy_catchup(nprng):
     """Host table applies the same closed-form idle-decay catch-up."""
     V, D, lr, decay = 16, 4, 0.1, 0.05
