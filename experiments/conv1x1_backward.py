@@ -90,7 +90,9 @@ def timed(fn, x, w, dy):
         run(x, w, dy, k).block_until_ready()   # compile both variants
     once(K)                                    # warm
     t1, t2 = once(K), once(3 * K)
-    return max(t2 - t1, 1e-9) / (2 * K) * 1e3
+    if t2 <= t1:
+        return None        # drift swamped the signal: say so, don't clamp
+    return (t2 - t1) / (2 * K) * 1e3
 
 
 def main():
@@ -110,12 +112,18 @@ def main():
         flops = 3 * 2.0 * B * h * h * cin * cout      # fwd+dx+dW
         for name, fn in forms.items():
             ms = timed(fn, x, w, dy)
+            if ms is None:             # degenerate differential (drift)
+                row[name + "_ms"] = None
+                row[name + "_mxu_pct"] = None
+                continue
             row[name + "_ms"] = round(ms, 3)
             row[name + "_mxu_pct"] = round(
                 100 * flops / (ms * 1e-3) / 197e12, 1)
         rows.append(row)
         print(json.dumps(row))
-    tot = {f: sum(r[f + "_ms"] for r in rows) for f in forms}
+    tot = {f: (round(sum(r[f + "_ms"] for r in rows), 3)
+               if all(r[f + "_ms"] is not None for r in rows) else None)
+           for f in forms}
     print(json.dumps({"total_ms_per_step_equivalent": tot}))
 
 

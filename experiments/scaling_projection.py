@@ -49,12 +49,16 @@ ICI_POD_LIMIT = 256              # v5e pod: 256 chips on one ICI fabric
 # and per-step FLOPs for the projected workloads.
 WORKLOADS = {
     "resnet50_dp": {
-        "t_comp_ms": 48.3,           # measured (PERF.md fori k=10, bs128)
+        "t_comp_ms": 47.5,           # measured (PERF.md r4, bs128/chip)
         "note": "ResNet-50 bs128/chip bf16, pure data parallel",
     },
     "transformer_dp_tp": {
-        "t_comp_ms": 170.0,          # transformer d512 L6 bs8 seq2048 (r3)
-        "note": "TransformerLM d512 L6 seq2048, dp x tp=4",
+        # per-chip compute = measured single-chip 170 ms (bs8 seq2048)
+        # split ideally over the tp=4 group that now shares those tokens
+        "t_comp_ms": 170.0 / 4,
+        "note": "TransformerLM d512 L6 seq2048, dp x tp=4, bs8 per "
+                "tp-group (HLO compiled at the real token count; t_comp = "
+                "measured single-chip 170 ms / tp)",
     },
 }
 
@@ -94,16 +98,20 @@ if "{workload}" == "resnet50_dp":
                                         ts.step, sharded,
                                         jax.random.PRNGKey(1))
 else:
-    # TransformerLM dp x tp: batch over data, FFN/attn weights over model
+    # TransformerLM dp x tp: batch over data, FFN/attn weights over model.
+    # Compiled at the REAL bench token count (bs8 per tp-group, seq 2048):
+    # the Megatron-style TP activation all-reduces scale with B*seq*dim,
+    # so a shrunk compile shape would undercount them.
     from paddle_tpu.models import TransformerLM
     from paddle_tpu.optim.optimizers import apply_updates
     tp = 4
     mesh = pt.make_mesh({{"data": n // tp, "model": tp}}, devices=devices)
+    SEQ = 2048
     model = TransformerLM(vocab=32000, dim=512, num_layers=6, num_heads=8,
-                          ffn_hidden=2048, max_len=256)
+                          ffn_hidden=2048, max_len=SEQ)
     rng = np.random.RandomState(0)
-    B = max(2, 2 * (n // tp))
-    ids = jnp.asarray(rng.randint(0, 32000, (B, 257)), jnp.int32)
+    B = 8 * (n // tp)
+    ids = jnp.asarray(rng.randint(0, 32000, (B, SEQ + 1)), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), ids[:, :-1])
     rules = parallel.ShardingRules([
         ("*/attn/wq", P(None, "model")), ("*/attn/wk", P(None, "model")),
@@ -176,6 +184,9 @@ def _shape_bytes(shape_s: str) -> int:
 
 def parse_collectives(hlo: str, n_devices: int):
     """Per-device wire bytes by collective kind (ring-algorithm factors)."""
+    # XLA interleaves /*index=N*/ comments inside big variadic tuples —
+    # strip them or the tuple regex stops at the first comment
+    hlo = re.sub(r"/\*.*?\*/", "", hlo)
     by_kind = {}
     n = n_devices
     for m in _COLL_RE.finditer(hlo):
@@ -197,27 +208,49 @@ def parse_collectives(hlo: str, n_devices: int):
     return by_kind
 
 
+def _row(cfg, n, wire, colls=None, extrapolated_from=None):
+    bw = ICI_BYTES_PER_S if n <= ICI_POD_LIMIT else DCN_BYTES_PER_S
+    t_comm_ms = wire / bw * 1e3
+    t_comp = cfg["t_comp_ms"]
+    row = {
+        "n_devices": n,
+        "wire_bytes_per_device": round(wire),
+        "link": "ICI" if n <= ICI_POD_LIMIT else "DCN",
+        "t_comp_ms": t_comp,
+        "t_comm_ms": round(t_comm_ms, 3),
+        "efficiency_no_overlap": round(t_comp / (t_comp + t_comm_ms), 4),
+        "efficiency_full_overlap": round(t_comp / max(t_comp, t_comm_ms), 4),
+    }
+    if colls is not None:
+        row["collectives"] = colls
+    if extrapolated_from is not None:
+        row["extrapolated_from_n"] = extrapolated_from
+        row["note"] = ("wire bytes scaled by the ring (n-1)/n factor from "
+                       "the largest compiled mesh — the XLA compile at "
+                       "this size exceeded the harness budget")
+    return row
+
+
 def project(workload: str, counts=(8, 64, 256)):
     cfg = WORKLOADS[workload]
     rows = []
+    last_good = None
     for n in counts:
-        hlo = _collect_hlo(n, workload)
+        try:
+            hlo = _collect_hlo(n, workload)
+        except (RuntimeError, subprocess.TimeoutExpired):
+            if last_good is None:
+                raise
+            # extrapolate: per-device ring wire bytes grow only by the
+            # (n-1)/n factor once the per-group workload is fixed
+            wn, nn = last_good
+            wire = wn * ((n - 1) / n) / ((nn - 1) / nn)
+            rows.append(_row(cfg, n, wire, extrapolated_from=nn))
+            continue
         colls = parse_collectives(hlo, n)
         wire = sum(e["wire_bytes_per_device"] for e in colls.values())
-        bw = ICI_BYTES_PER_S if n <= ICI_POD_LIMIT else DCN_BYTES_PER_S
-        t_comm_ms = wire / bw * 1e3
-        t_comp = cfg["t_comp_ms"]
-        rows.append({
-            "n_devices": n,
-            "collectives": colls,
-            "wire_bytes_per_device": round(wire),
-            "link": "ICI" if n <= ICI_POD_LIMIT else "DCN",
-            "t_comp_ms": t_comp,
-            "t_comm_ms": round(t_comm_ms, 3),
-            "efficiency_no_overlap": round(t_comp / (t_comp + t_comm_ms), 4),
-            "efficiency_full_overlap": round(
-                t_comp / max(t_comp, t_comm_ms), 4),
-        })
+        last_good = (wire, n)
+        rows.append(_row(cfg, n, wire, colls=colls))
     return {"workload": workload, "note": cfg["note"], "projection": rows}
 
 

@@ -162,16 +162,17 @@ class MultiHeadAttention(Module):
             self._fast_path_checks(q_in, kv_in, mask)
             from .pallas_attention import flash_attention
             T = q.shape[1]
-            # largest divisor of T up to 128 keeps VMEM blocks bounded; a T
-            # with no reasonable divisor must be padded upstream
-            bq = next((b for b in (128, 64, 32, 16, 8) if T % b == 0), None)
-            if bq is None:
+            if next((b for b in (128, 64, 32, 16, 8) if T % b == 0),
+                    None) is None:
                 raise ValueError(
                     f"flash path needs seq len divisible by 8; pad T={T}")
+            # block sizes auto-select in the kernel (large blocks: the
+            # per-grid-step overhead dominated at the old fixed 128 —
+            # measured 5x per-layer, experiments/profile_transformer.py)
             ctx = flash_attention(jnp.moveaxis(q, 2, 1),
                                   jnp.moveaxis(k, 2, 1),
                                   jnp.moveaxis(v, 2, 1),
-                                  segments, causal, None, bq, bq)
+                                  segments, causal)
             ctx = jnp.moveaxis(ctx, 1, 2).astype(pol.compute_dtype)
         elif impl in ("ring", "seq"):
             self._fast_path_checks(q_in, kv_in, mask)

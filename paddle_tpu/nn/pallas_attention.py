@@ -250,9 +250,24 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
         dv_ref[:] = dv_s[:].astype(dv_ref.dtype)
 
 
+def _auto_block(T, cap):
+    """Largest block <= cap dividing T, preferring lane-friendly multiples
+    of 128. Measured on v5e (experiments/profile_transformer.py, T=2048
+    d64): per-layer fwd+bwd cost falls 76.6 ms -> 14.9 ms going from
+    128x128 to 512x1024 blocks — the per-grid-step overhead dominates at
+    small blocks, so default as large as VMEM comfortably allows."""
+    for b in range(min(cap, T) // 128 * 128, 127, -128):
+        if T % b == 0:
+            return b
+    for b in range(min(cap, T), 0, -1):
+        if T % b == 0:
+            return b
+    return min(cap, T)
+
+
 def _blocks(block_q, block_k, T):
-    bq = min(block_q, T)
-    bk = min(block_k, T)
+    bq = _auto_block(T, 512) if block_q is None else min(block_q, T)
+    bk = _auto_block(T, 1024) if block_k is None else min(block_k, T)
     assert T % bq == 0 and T % bk == 0, \
         f"seq len {T} must be a multiple of block sizes ({bq}, {bk})"
     return bq, bk
@@ -426,10 +441,15 @@ def _resolve_defaults(q, scale, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention(q, k, v, segments=None, causal: bool = False,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None):
+                    scale: Optional[float] = None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None):
     """Fused attention over [B, H, T, D]. ``T`` must divide by the block
     sizes (pack/pad upstream — static shapes are the framework contract).
+    ``block_q``/``block_k`` default to the largest T-dividing blocks up to
+    512/1024 — measured ~5x faster than 128x128 on v5e at T=2048
+    (``_auto_block``); pass explicit sizes to override (e.g. tighter VMEM).
     ``segments``: optional [B, T] packed-sequence ids (``core.sequence``
     convention: 1-based, 0 = padding) confining attention within each
     sub-sequence — shared across heads. ``interpret`` defaults to True

@@ -67,12 +67,23 @@ def plot_curves(series: Dict[str, List[Tuple[int, float]]], output,
     except ImportError:
         rows = sorted({x for pts in series.values() for x, _ in pts})
         cols = {k: dict(pts) for k, pts in series.items()}
-        with (open(output, "w") if isinstance(output, str) else output) as f:
-            f.write("# x " + " ".join(series) + "\n")
+        close = isinstance(output, str)
+        f = open(output, "w") if close else output
+
+        def w(s):                      # caller streams may be binary
+            try:
+                f.write(s)
+            except TypeError:
+                f.write(s.encode())
+        try:
+            w("# x " + " ".join(series) + "\n")
             for x in rows:
-                f.write(" ".join([str(x)] + [
+                w(" ".join([str(x)] + [
                     format(cols[k].get(x, float("nan")), ".6g")
                     for k in series]) + "\n")
+        finally:
+            if close:                  # never close a caller-provided stream
+                f.close()
         return "table"
 
     fig, ax = plt.subplots(figsize=(8, 5))

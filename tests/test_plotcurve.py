@@ -35,6 +35,27 @@ def test_plot_curves_writes_output(tmp_path):
     assert out.exists() and out.stat().st_size > 0
 
 
+def test_table_fallback_handles_binary_stream_and_keeps_it_open(monkeypatch):
+    """Without matplotlib the fallback must write the plain table to the
+    caller's stream — including a BINARY one like sys.stdout.buffer (the
+    CLI default) — and must not close a caller-provided stream."""
+    import builtins
+    real_import = builtins.__import__
+
+    def no_matplotlib(name, *a, **k):
+        if name.startswith("matplotlib"):
+            raise ImportError(name)
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_matplotlib)
+    series = {"cost": [(0, 1.0), (1, 0.5)]}
+    buf = io.BytesIO()
+    kind = plotcurve.plot_curves(series, buf)
+    assert kind == "table"
+    assert not buf.closed
+    assert buf.getvalue().startswith(b"# x cost")
+
+
 def test_cli_roundtrip(tmp_path, capsys):
     log = tmp_path / "train.log"
     log.write_text(LOG)
