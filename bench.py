@@ -130,7 +130,8 @@ def seq2seq_train_flops(bs, src_len, tgt_len, emb, hidden, vocab):
 # is the scalar loss that closes the timed region.
 # ---------------------------------------------------------------------------
 
-def _build_resnet_trainer(batch_size, model=None, image=224, classes=1000):
+def _build_resnet_trainer(batch_size, model=None, image=224, classes=1000,
+                          lr=0.1):
     from paddle_tpu import optim
     from paddle_tpu.core.dtypes import bfloat16_compute, use_policy
     from paddle_tpu.models import resnet50
@@ -140,7 +141,7 @@ def _build_resnet_trainer(batch_size, model=None, image=224, classes=1000):
     trainer = Trainer(
         model=model or resnet50(num_classes=classes),
         loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
-        optimizer=optim.momentum(0.1, 0.9))
+        optimizer=optim.momentum(lr, 0.9))
     rng = np.random.RandomState(0)
     batch = {
         "x": rng.normal(size=(batch_size, image, image, 3)).astype(np.float32),
@@ -181,8 +182,11 @@ def prep_resnet50(batch_size=128, model_name="resnet50", image=224,
                  "googlenet": image_zoo.GoogLeNet,
                  "vgg16": image_zoo.vgg16,
                  "vgg19": image_zoo.vgg19}[model_name](num_classes=classes)
+    # alexnet/googlenet have no batchnorm: the resnet lr diverges on them
+    lr = 0.01 if model_name in ("alexnet", "googlenet") else 0.1
     trainer, batch = _build_resnet_trainer(batch_size, model=model,
-                                           image=image, classes=classes)
+                                           image=image, classes=classes,
+                                           lr=lr)
     step_body, state0 = _trainer_step_body(trainer, batch)
     flops = (RESNET50_TRAIN_FLOPS_PER_IMAGE * batch_size
              if model_name == "resnet50" else None)

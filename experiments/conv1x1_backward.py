@@ -63,7 +63,11 @@ def matmul_form(x, w):
 def timed(fn, x, w, dy):
     """ms per fwd+vjp pass, differential: time (dispatch + fetch) at K and
     3K chained passes inside one jit call each and difference — the ~1 s
-    tunnel fetch/dispatch constant cancels (same rule as bench.py r4)."""
+    tunnel fetch/dispatch constant cancels (same rule as bench.py r4).
+
+    NOTE: bench.py's run_timed_child is the CANONICAL implementation of
+    the interleaved-differential protocol; protocol fixes land there
+    first — keep this experiment copy in sync when touching either."""
 
     @partial(jax.jit, static_argnames=("k",))
     def run(x, w, dy, k):

@@ -42,6 +42,11 @@ def _fence_state(state):
 def diff_time(make_body, state, k=8, reps=2, use_fori=False):
     """Interleaved differential of a state->state body: median ms/pass.
 
+    NOTE: bench.py's run_timed_child is the CANONICAL implementation of
+    this protocol (warmup fence, degenerate-sample sentinel, fallback
+    labelling); protocol fixes land there first — keep this experiment
+    copy in sync when touching either.
+
     use_fori=False dispatches the jitted body k / 3k times per region (the
     proven bench-child pattern — the remote compile service reproducibly
     breaks on fori-wrapped FULL-transformer programs, while k=1 programs

@@ -53,12 +53,17 @@ WORKLOADS = {
         "note": "ResNet-50 bs128/chip bf16, pure data parallel",
     },
     "transformer_dp_tp": {
-        # per-chip compute = measured single-chip 170 ms (bs8 seq2048)
-        # split ideally over the tp=4 group that now shares those tokens
-        "t_comp_ms": 170.0 / 4,
+        # per-chip compute = measured single-chip 65.6 ms (bs8 seq2048,
+        # post flash-block fix) split ideally over the tp=4 group that
+        # shares those tokens
+        "t_comp_ms": 65.6 / 4,
         "note": "TransformerLM d512 L6 seq2048, dp x tp=4, bs8 per "
                 "tp-group (HLO compiled at the real token count; t_comp = "
-                "measured single-chip 170 ms / tp)",
+                "measured single-chip 65.6 ms / tp). TAKEAWAY: at d512 the "
+                "Megatron-style activation all-reduces (~2.4 GB/step/chip) "
+                "make tp=4 ICI-bound — TP comm scales with d while compute "
+                "scales with d^2, so small models should shard dp-only "
+                "(96%+ projected) and reserve tp for larger dims",
     },
 }
 
@@ -182,29 +187,49 @@ def _shape_bytes(shape_s: str) -> int:
     return total
 
 
+def _group_size(op_line: str, default: int) -> int:
+    """Replica-group size of one collective op: the ring factor must use
+    the GROUP the op actually spans (a tp=4 activation all-reduce on a
+    dp x tp mesh rings over 4 devices, not the whole mesh)."""
+    m = re.search(r"replica_groups=\{\{([\d,]+)\}", op_line)
+    if m:                          # explicit form {{0,1,2,3},{4,...}}
+        return len(m.group(1).split(","))
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=", op_line)
+    if m:                          # iota form [groups, group_size]<=[...]
+        return int(m.group(2))
+    return default
+
+
 def parse_collectives(hlo: str, n_devices: int):
-    """Per-device wire bytes by collective kind (ring-algorithm factors)."""
+    """Per-device wire bytes by collective kind (ring-algorithm factors
+    over each op's replica group)."""
     # XLA interleaves /*index=N*/ comments inside big variadic tuples —
     # strip them or the tuple regex stops at the first comment
     hlo = re.sub(r"/\*.*?\*/", "", hlo)
     by_kind = {}
-    n = n_devices
-    for m in _COLL_RE.finditer(hlo):
+    for line in hlo.splitlines():
+        m = _COLL_RE.search(line)
+        if not m:
+            continue
         shape_s, kind = m.group(1), m.group(2)
         b = _shape_bytes(shape_s)
+        g = max(2, _group_size(line, n_devices))
         if kind == "all-reduce":
-            wire = 2.0 * b * (n - 1) / n
+            wire = 2.0 * b * (g - 1) / g
         elif kind == "reduce-scatter":
-            wire = 1.0 * b * (n - 1)     # result is the 1/n shard
+            wire = 1.0 * b * (g - 1)     # result is the 1/g shard
         elif kind in ("all-gather", "all-to-all"):
-            wire = 1.0 * b * (n - 1) / n
+            wire = 1.0 * b * (g - 1) / g
         else:                      # collective-permute
             wire = float(b)
         e = by_kind.setdefault(kind, {"ops": 0, "buffer_bytes": 0,
-                                      "wire_bytes_per_device": 0.0})
+                                      "wire_bytes_per_device": 0.0,
+                                      "group_sizes": []})
         e["ops"] += 1
         e["buffer_bytes"] += b
         e["wire_bytes_per_device"] += wire
+        if g not in e["group_sizes"]:
+            e["group_sizes"].append(g)
     return by_kind
 
 
@@ -225,31 +250,43 @@ def _row(cfg, n, wire, colls=None, extrapolated_from=None):
         row["collectives"] = colls
     if extrapolated_from is not None:
         row["extrapolated_from_n"] = extrapolated_from
-        row["note"] = ("wire bytes scaled by the ring (n-1)/n factor from "
-                       "the largest compiled mesh — the XLA compile at "
-                       "this size exceeded the harness budget")
+        row["note"] = ("UPPER BOUND on wire bytes (ring factor taken to "
+                       "its g->inf limit: 2B per all-reduce, B otherwise) "
+                       "from the largest compiled mesh — fixed-size "
+                       "replica groups (e.g. tp) keep constant per-device "
+                       "wire, growing groups approach the bound; the XLA "
+                       "compile at this mesh size exceeded the harness "
+                       "budget. Efficiency is therefore a LOWER bound.")
     return row
+
+
+def _wire_upper_bound(colls):
+    """g->inf limit of the ring factors: 2B for all-reduce, B otherwise.
+    >= the true wire at ANY group layout, so efficiencies computed from it
+    are lower bounds."""
+    total = 0.0
+    for kind, e in colls.items():
+        total += (2.0 if kind == "all-reduce" else 1.0) * e["buffer_bytes"]
+    return total
 
 
 def project(workload: str, counts=(8, 64, 256)):
     cfg = WORKLOADS[workload]
     rows = []
-    last_good = None
+    last_colls = None
     for n in counts:
         try:
             hlo = _collect_hlo(n, workload)
         except (RuntimeError, subprocess.TimeoutExpired):
-            if last_good is None:
+            if last_colls is None:
                 raise
-            # extrapolate: per-device ring wire bytes grow only by the
-            # (n-1)/n factor once the per-group workload is fixed
-            wn, nn = last_good
-            wire = wn * ((n - 1) / n) / ((nn - 1) / nn)
-            rows.append(_row(cfg, n, wire, extrapolated_from=nn))
+            colls, nn = last_colls
+            rows.append(_row(cfg, n, _wire_upper_bound(colls),
+                             extrapolated_from=nn))
             continue
         colls = parse_collectives(hlo, n)
         wire = sum(e["wire_bytes_per_device"] for e in colls.values())
-        last_good = (wire, n)
+        last_colls = (colls, n)
         rows.append(_row(cfg, n, wire, colls=colls))
     return {"workload": workload, "note": cfg["note"], "projection": rows}
 

@@ -259,6 +259,12 @@ def _auto_block(T, cap):
     for b in range(min(cap, T) // 128 * 128, 127, -128):
         if T % b == 0:
             return b
+    # no 128-multiple divides T: fall back to the largest sublane-aligned
+    # (multiple-of-8) divisor — odd blocks mis-tile on the TPU
+    for b in range(min(cap, T) // 8 * 8, 7, -8):
+        if T % b == 0:
+            return b
+    # T < 8 or not 8-divisible (interpreter-scale shapes): any divisor
     for b in range(min(cap, T), 0, -1):
         if T % b == 0:
             return b
