@@ -28,7 +28,10 @@ plugin (r3: it produced a physically impossible 352% MFU). Therefore:
    r4: the closing fetch of identical regions varied 12 s -> 40 s between
    sessions, which breaks a two-subprocess differential). If the median is
    degenerate (<= 0, pure noise) the harness falls back to the best
-   absolute rate and labels the result ``protocol: "absolute-fallback"``.
+   absolute rate and labels the result ``protocol:
+   "absolute-fallback-includes-fetch-constant"`` (the 3N-region wall time
+   divided there includes the single closing fetch, whose constant can
+   dominate in the degraded-tunnel mode that triggers this path).
 3. **A health probe runs first** (own subprocess): small put/get
    round-trip, chained-jit residency on a 100 MB carried state before and
    after a scalar fetch, 100 MB download bandwidth. The verdict and raw
@@ -442,7 +445,7 @@ def run_timed_child(name, timed_steps, steps_per_call, warmup_calls=2,
             loss = _fence(state[-1])       # the single fetch closes timing
             return time.perf_counter() - t0, ncalls * k, loss, state
 
-        samples, pairs, loss = [], [], float("nan")
+        samples, pairs, raw_tb, loss = [], [], [], float("nan")
         sa = sb = 1
         for _ in range(max(1, reps)):
             ta, sa, _, state = region(n, state)
@@ -450,13 +453,17 @@ def run_timed_child(name, timed_steps, steps_per_call, warmup_calls=2,
             # sb == sa iff steps_per_call swallowed the whole region
             # (k >= 3n): no differential signal, force the fallback
             samples.append((tb - ta) / (sb - sa) if sb > sa else -1.0)
-            pairs.append([round(ta, 3), round(tb, 3)])
+            pairs.append([round(ta, 3), round(tb, 3)])   # reporting only
+            raw_tb.append(tb)                            # computation
         med = sorted(samples)[len(samples) // 2]
         if med <= 0:
             # drift swamped the signal: report the best absolute rate
-            # (sb = steps actually executed in a 3N region)
-            med = min(tb for ta, tb in pairs) / sb
-            protocol = "absolute-fallback"
+            # (sb = steps actually executed in a 3N region). NOTE: this
+            # includes the one closing fetch, whose constant can dominate
+            # in the degraded-tunnel mode that triggers this path — the
+            # JSON carries the caveat.
+            med = min(raw_tb) / sb
+            protocol = "absolute-fallback-includes-fetch-constant"
         else:
             protocol = "differential-interleaved"
     print(json.dumps({"child": name, "per_step_s": med,
@@ -768,13 +775,79 @@ def main():
                     ValueError, IndexError) as e:
                 errors[name] = f"attempt {attempt}: {e}"
     headline = dict(results.get("resnet50", {}))
-    out = {**headline,
-           "environment": environment,
-           "all_metrics": {r["metric"]: r for r in results.values()
-                           if "metric" in r}}
+    full = {**headline,
+            "environment": environment,
+            "all_metrics": {r["metric"]: r for r in results.values()
+                            if "metric" in r}}
     if errors:
-        out["bench_errors"] = errors
-    print(json.dumps(out))
+        full["bench_errors"] = errors
+    # Full protocol detail goes to a committed sidecar and is printed BEFORE
+    # the final line; the FINAL stdout line is a compact record that must fit
+    # the driver's 2,000-char tail capture (round 4 lost its headline numbers
+    # to truncation — VERDICT r4 weak #1).
+    sidecar = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           SIDECAR_NAME)
+    sidecar_ok = True
+    try:
+        with open(sidecar, "w") as f:
+            json.dump(full, f, indent=1)
+    except OSError:
+        sidecar_ok = False
+    print(json.dumps(full))
+    print(json.dumps(compact_record(results, errors, environment,
+                                    sidecar_ok=sidecar_ok)))
+
+
+SIDECAR_NAME = "BENCH_FULL_r05.json"
+
+
+def compact_record(results, errors, environment, cap=1500, sidecar_ok=True):
+    """Final-line record: headline at top level (driver contract: metric/
+    value/unit/vs_baseline) plus one short row per metric. Hard-capped at
+    ``cap`` chars by progressively dropping optional detail."""
+    rows = {}
+    for r in results.values():
+        if "metric" not in r:
+            continue
+        row = {"v": r.get("value"), "u": r.get("unit"),
+               "ms": r.get("ms_per_step")}
+        if r.get("mfu_pct") is not None:
+            row["mfu"] = r["mfu_pct"]
+        if r.get("vs_baseline") is not None:
+            row["vs"] = r["vs_baseline"]
+        if r.get("final_loss") is not None:
+            row["loss"] = r["final_loss"]
+        rows[r["metric"]] = row
+    head = results.get("resnet50", {})
+    out = {"metric": head.get("metric"), "value": head.get("value"),
+           "unit": head.get("unit"), "vs_baseline": head.get("vs_baseline"),
+           "ms_per_step": head.get("ms_per_step"),
+           "mfu_pct": head.get("mfu_pct"),
+           "env": environment.get("verdict"),
+           "device": head.get("device"),
+           "full_record": SIDECAR_NAME if sidecar_ok else None,
+           "metrics": rows}
+    if errors:
+        out["errors"] = {k: str(v)[-100:] for k, v in errors.items()}
+    # degrade to fit: each stage strips one tier of optional detail; the
+    # last two guarantee the cap no matter how many metrics/errors exist
+    for strip in ("loss", "vs", "errors", "rows",
+                  "drop_errors", "drop_metrics"):
+        if len(json.dumps(out)) <= cap:
+            return out
+        if strip == "errors":
+            out["errors"] = {k: str(v)[-40:] for k, v in errors.items()}
+        elif strip == "rows":
+            out["metrics"] = {m: {"v": r["v"], "u": r["u"]}
+                              for m, r in rows.items()}
+        elif strip == "drop_errors":
+            out.pop("errors", None)
+        elif strip == "drop_metrics":
+            out["metrics"] = {}
+        else:
+            for r in rows.values():
+                r.pop(strip, None)
+    return out
 
 
 if __name__ == "__main__":

@@ -171,13 +171,27 @@ _COLL_RE = re.compile(
     r"((?:" + _SHAPE + r")|\((?:" + _SHAPE + r")(?:,\s*(?:" + _SHAPE +
     r"))*\))\s+"
     r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
-    r"(?:-start)?\(")
+    r"(-start)?\(")
 
 
-def _shape_bytes(shape_s: str) -> int:
-    """Total bytes of a shape or tuple-of-shapes string."""
+def _shape_bytes(shape_s: str, kind: str = "", is_start: bool = False) -> int:
+    """Total bytes of a shape or tuple-of-shapes string, counting only the
+    RESULT buffers for async '*-start' forms. Per-kind, per XLA's HLO:
+    all-gather-start and collective-permute-start carry
+    ``(operand..., result..., [u32 contexts])`` tuples (count the trailing
+    result half after dropping the dimensionless context scalars);
+    all-reduce/reduce-scatter/all-to-all '-start' shapes are already
+    results-only (count everything). The n=8 sync-HLO cross-check in this
+    experiment guards this assumption against XLA lowering drift."""
+    shapes = list(re.finditer(r"(\w+)\[([\d,]*)\]", shape_s))
+    if is_start:
+        shapes = [m for m in shapes
+                  if not (m.group(1) in ("u32", "s32") and not m.group(2))]
+        if kind in ("all-gather", "collective-permute") \
+                and len(shapes) >= 2 and len(shapes) % 2 == 0:
+            shapes = shapes[len(shapes) // 2:]
     total = 0
-    for m in re.finditer(r"(\w+)\[([\d,]*)\]", shape_s):
+    for m in shapes:
         dt, dims = m.group(1), m.group(2)
         n = 1
         for d in dims.split(","):
@@ -212,8 +226,10 @@ def parse_collectives(hlo: str, n_devices: int):
         if not m:
             continue
         shape_s, kind = m.group(1), m.group(2)
-        b = _shape_bytes(shape_s)
-        g = max(2, _group_size(line, n_devices))
+        b = _shape_bytes(shape_s, kind=kind, is_start=bool(m.group(3)))
+        g = _group_size(line, n_devices)
+        if g <= 1:                 # degenerate 1-device group moves nothing
+            continue
         if kind == "all-reduce":
             wire = 2.0 * b * (g - 1) / g
         elif kind == "reduce-scatter":

@@ -127,7 +127,13 @@ _CONV1X1_IMPL = "conv"
 
 
 def set_conv1x1_impl(impl: str) -> str:
-    """Select the 1x1-conv lowering globally; returns the previous value."""
+    """Select the 1x1-conv lowering globally; returns the previous value.
+
+    TRACE-TIME semantics: the global is read when a step is traced, and jit
+    caches do NOT key on it — any function already jitted keeps the lowering
+    it was traced with. Call this BEFORE building/jitting the step (the bench
+    children set it via ``BENCH_CONV1X1_IMPL`` at process start); toggling
+    after compilation silently has no effect on cached executables."""
     global _CONV1X1_IMPL
     assert impl in ("conv", "matmul", "pallas"), impl
     prev, _CONV1X1_IMPL = _CONV1X1_IMPL, impl

@@ -42,16 +42,15 @@ def parse_log(lines: Iterable[str], keys: Sequence[str]) -> Dict[
         if not _LINE_RE.search(line):
             continue
         kvs = dict(_KV_RE.findall(line))
-        hit = False
         for k in keys:
             if k in kvs:
                 try:
                     out[k].append((x, float(kvs[k])))
-                    hit = True
                 except ValueError:
                     pass
-        if hit:
-            x += 1
+        # x is the progress-line count, advanced on EVERY matching line so
+        # the same log plotted with different key sets shares x coordinates
+        x += 1
     return out
 
 

@@ -438,5 +438,8 @@ def test_pipeline_1f1b_gates_compute_with_conditionals(nprng):
         mesh, lambda p, a: jnp.tanh(a @ p["w"]), lambda o: jnp.sum(o ** 2))
     txt = jax.jit(f1b).lower({"w": w}, x).as_text()
     n_cond = txt.count("stablehlo.case") + txt.count("stablehlo.if")
-    assert n_cond == 2, f"expected fwd+bwd conditionals in the tick loop, " \
+    # >= 2 (fwd + bwd gates) rather than == 2: unrelated ops may also lower
+    # to conditionals across XLA versions; the numeric 1F1B oracle test is
+    # the budget/correctness check
+    assert n_cond >= 2, f"expected fwd+bwd conditionals in the tick loop, " \
                         f"found {n_cond}"
