@@ -145,7 +145,8 @@ def test_cli_trains_from_config_alone(tmp_path):
         "--learning_rate", "0.001", "--log_period", "0",
         "--checkpoint_dir", str(tmp_path / "ckpt")])
     metrics = run(flags)
-    assert metrics["accuracy"] > 0.9
+    # synthetic mnist carries 10% label noise (Bayes ceiling ~0.90)
+    assert metrics["accuracy"] > 0.8
     import os
     assert any(d.startswith("pass-") for d in os.listdir(tmp_path / "ckpt"))
 

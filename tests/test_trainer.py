@@ -51,7 +51,10 @@ def test_mnist_end_to_end_slice(tmp_path):
              checkpoint_dir=str(tmp_path / "ckpt"))
     assert seen["it"] == 6 * 8  # 512/64 batches * passes
     final = seen["passes"][-1]
-    assert final["accuracy"] > 0.95, final
+    # the synthetic set carries 10% label noise (Bayes ceiling ~0.90 without
+    # memorization); learning the structure lands in the high 0.8s in 6
+    # passes, a broken model stays near 0.1
+    assert final["accuracy"] > 0.8, final
     # checkpoints written per pass, gc'd to keep_last=3
     dirs = sorted(os.listdir(tmp_path / "ckpt"))
     assert dirs == ["pass-00003", "pass-00004", "pass-00005"]
@@ -63,7 +66,8 @@ def test_evaluate_and_test_reader():
     tr.init(jax.random.PRNGKey(0), next(iter(reader())))
     tr.train(reader, num_passes=4)
     cost, metrics = tr.evaluate(mnist_batches(n=256, split="train"))
-    assert metrics["accuracy"] > 0.9
+    # 10% label noise: Bayes ceiling ~0.90 without memorization
+    assert metrics["accuracy"] > 0.8
     assert cost < 1.0
 
 
