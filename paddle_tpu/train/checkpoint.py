@@ -20,29 +20,72 @@ import json
 import os
 import shutil
 import zlib
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
 import numpy as np
 import jax
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_pass", "pass_dir",
-           "atomic_dir", "write_manifest", "verify_manifest"]
+           "atomic_dir", "write_manifest", "verify_manifest",
+           "AsyncCheckpointer"]
 
 _MANIFEST = "manifest.json"
 
 
 @contextlib.contextmanager
 def atomic_dir(path: str):
-    """Write into ``path + '.tmp'``; atomically rename over ``path`` when the
-    block succeeds (the Go pserver's temp-file + rename recipe)."""
+    """Write into ``path + '.tmp'``; swap into place when the block
+    succeeds. CRASH-ATOMIC: the live ``path`` is renamed aside to
+    ``path + '.old'`` before the new dir renames in, so every window of a
+    crash leaves at least one COMPLETE dir — ``path`` itself, or (between
+    the two renames) both the finished ``.tmp`` and the previous ``.old``,
+    which readers resolve via ``_resolve_pass_dir``. The prior
+    ``rmtree(path); rename(tmp)`` recipe had a window with neither (the
+    Go pserver writes aside then renames over, go/pserver/service.go:346)."""
     tmp = path + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp, exist_ok=True)
     yield tmp
+    old = path + ".old"
+    if os.path.exists(old):
+        shutil.rmtree(old)
     if os.path.exists(path):
-        shutil.rmtree(path)
+        os.rename(path, old)
     os.rename(tmp, path)
+    if os.path.exists(old):
+        shutil.rmtree(old)
+
+
+def _is_pass_dir(name: str) -> bool:
+    return (name.startswith("pass-")
+            and not name.endswith((".tmp", ".old")))
+
+
+def _base_pass_id(name: str) -> Optional[int]:
+    """Pass id of a live dir OR a ``.tmp``/``.old`` crash leftover."""
+    base = name[:-4] if name.endswith((".tmp", ".old")) else name
+    if not base.startswith("pass-"):
+        return None
+    try:
+        return int(base.split("-")[1])
+    except (IndexError, ValueError):
+        return None
+
+
+def _resolve_pass_dir(root: str, pass_id: int) -> str:
+    """Directory to READ pass ``pass_id`` from: the live dir, else a
+    manifest-complete ``.tmp`` (newer) or ``.old`` left by a crash between
+    ``atomic_dir``'s two renames. Resolution is a PURE READ — no renames —
+    so concurrent readers on every host always agree and can never race an
+    in-flight writer; the writer path alone mutates the root (it rebuilds
+    or garbage-collects leftovers on its next save)."""
+    base = pass_dir(root, pass_id)
+    for d in (base, base + ".tmp", base + ".old"):
+        if os.path.exists(os.path.join(d, _MANIFEST)):
+            return d
+    return base        # fails downstream with the usual missing-file error
 
 
 def _file_crc(path: str) -> int:
@@ -148,35 +191,111 @@ def pass_dir(root: str, pass_id: int) -> str:
     return os.path.join(root, f"pass-{pass_id:05d}")
 
 
-def save_checkpoint(root: str, pass_id: int, tree: Dict[str, Any],
+def _snapshot_host(tree: Dict[str, Any]) -> Dict[str, Any]:
+    """Fetch every collection to host numpy (ONE device_get per leaf —
+    the only part of a save that must see a consistent device state)."""
+    return {coll: jax.tree_util.tree_map(lambda x: np.asarray(x), sub)
+            for coll, sub in tree.items()}
+
+
+def _write_pass_dir(root: str, pass_id: int, tree: Dict[str, Any],
                     keep_last: Optional[int] = None) -> str:
-    """Atomically write ``tree`` (a dict of collections) to pass-NNNNN/."""
-    if jax.process_index() != 0:
-        return pass_dir(root, pass_id)
+    """The disk half of a save (CRC + npz write + swap + gc). Snapshots
+    each collection to host right before writing it, so the sync path holds
+    at most ONE collection in host memory at a time; the async path passes
+    pre-snapshotted numpy (``np.asarray`` is then a no-op)."""
     final = pass_dir(root, pass_id)
     with atomic_dir(final) as tmp:
         for coll, sub in tree.items():
-            host_tree = jax.tree_util.tree_map(lambda x: np.asarray(x), sub)
-            np.savez(os.path.join(tmp, f"{coll}.npz"), **_flatten(host_tree))
+            host = jax.tree_util.tree_map(lambda x: np.asarray(x), sub)
+            np.savez(os.path.join(tmp, f"{coll}.npz"), **_flatten(host))
         write_manifest(tmp, {"pass_id": pass_id})
     if keep_last:
         _gc(root, keep_last)
     return final
 
 
+def save_checkpoint(root: str, pass_id: int, tree: Dict[str, Any],
+                    keep_last: Optional[int] = None) -> str:
+    """Atomically write ``tree`` (a dict of collections) to pass-NNNNN/."""
+    if jax.process_index() != 0:
+        return pass_dir(root, pass_id)
+    return _write_pass_dir(root, pass_id, tree, keep_last)
+
+
+class AsyncCheckpointer:
+    """Off-critical-path checkpointing (SURVEY §5 "Orbax-style async").
+
+    The reference keeps checkpoint work off the training hot path — the Go
+    pserver checkpoints on its own ticker goroutine
+    (``go/pserver/service.go:119-174``) and
+    ``ConcurrentRemoteParameterUpdater`` overlaps parameter traffic with
+    compute (``paddle/trainer/RemoteParameterUpdater.cpp:244``). Here
+    ``save()`` snapshots device arrays to host synchronously (the one part
+    that must observe a consistent training state), then hands the CRC +
+    npz write + atomic swap to a single background thread. The next
+    ``save()`` — or ``wait()`` / context exit — fences the in-flight write;
+    a background failure re-raises at that fence."""
+
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="ckpt")
+        self._pending = None
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) lands; re-raise its
+        error."""
+        if self._pending is not None:
+            fut, self._pending = self._pending, None
+            fut.result()
+
+    def save(self, root: str, pass_id: int, tree: Dict[str, Any],
+             keep_last: Optional[int] = None) -> str:
+        if jax.process_index() != 0:
+            return pass_dir(root, pass_id)
+        self.wait()                        # fence the previous save
+        host = _snapshot_host(tree)
+        self._pending = self._pool.submit(_write_pass_dir, root, pass_id,
+                                          host, keep_last)
+        return pass_dir(root, pass_id)
+
+    def close(self) -> None:
+        try:
+            self.wait()
+        finally:
+            self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
 def _gc(root: str, keep_last: int):
-    passes = sorted(d for d in os.listdir(root) if d.startswith("pass-")
-                    and not d.endswith(".tmp"))
-    for d in passes[:-keep_last]:
-        shutil.rmtree(os.path.join(root, d))
+    """Retention: keep the newest ``keep_last`` live passes, and prune
+    ``.tmp``/``.old`` crash leftovers whose pass fell out of retention —
+    otherwise a leftover could outlive (and later shadow) a pass the
+    retention policy deleted. Leftovers NEWER than every live pass (a
+    crashed latest save) are kept: they may be the only copy."""
+    live = sorted(d for d in os.listdir(root) if _is_pass_dir(d))
+    keep_ids = {_base_pass_id(d) for d in live[-keep_last:]}
+    newest = max(keep_ids, default=-1)
+    for d in os.listdir(root):
+        pid = _base_pass_id(d)
+        if pid is None:
+            continue
+        if pid not in keep_ids and pid <= newest:
+            shutil.rmtree(os.path.join(root, d))
 
 
 def latest_pass(root: str) -> Optional[int]:
     if not os.path.isdir(root):
         return None
-    ids = [int(d.split("-")[1]) for d in os.listdir(root)
-           if d.startswith("pass-") and not d.endswith(".tmp")
-           and os.path.exists(os.path.join(root, d, "manifest.json"))]
+    ids = [pid for d in os.listdir(root)
+           if (pid := _base_pass_id(d)) is not None
+           and os.path.exists(os.path.join(root, d, _MANIFEST))]
     return max(ids) if ids else None
 
 
@@ -188,7 +307,7 @@ def load_checkpoint(root: str, pass_id: Optional[int] = None,
         pass_id = latest_pass(root)
         if pass_id is None:
             raise FileNotFoundError(f"no checkpoints under {root}")
-    d = pass_dir(root, pass_id)
+    d = _resolve_pass_dir(root, pass_id)
     manifest = verify_manifest(d, verify_crc=verify_crc)
     out = {}
     for fname in manifest["files"]:

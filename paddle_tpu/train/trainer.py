@@ -242,10 +242,17 @@ class Trainer:
               test_reader: Optional[Callable] = None,
               checkpoint_dir: Optional[str] = None,
               checkpoint_keep: int = 3,
+              checkpoint_async: bool = False,
               saving_period: Optional[int] = None,
               log_period: int = 100, rng: Optional[jax.Array] = None,
               resume: bool = False) -> TrainState:
         """The pass/batch loop (v2 ``SGD.train`` surface + v1 pass checkpoints).
+
+        ``checkpoint_async=True`` moves each checkpoint's CRC + disk write
+        to a background thread (the device-state snapshot stays on the hot
+        path; see :class:`~paddle_tpu.train.checkpoint.AsyncCheckpointer`) —
+        the analog of the reference's off-critical-path checkpoint/commit
+        work. The final save is fenced before ``train`` returns.
 
         ``saving_period``: also checkpoint every N batches *within* a pass
         (the reference's ``--saving_period_by_batches``,
@@ -276,6 +283,20 @@ class Trainer:
                 else:
                     start_pass = last + 1
 
+        saver = ckpt_lib.AsyncCheckpointer() if checkpoint_async else None
+        save_fn = saver.save if saver else ckpt_lib.save_checkpoint
+        try:
+            return self._train_loop(reader, num_passes, handler, test_reader,
+                                    checkpoint_dir, checkpoint_keep,
+                                    saving_period, log_period, rng,
+                                    start_pass, skip_batches, save_fn)
+        finally:
+            if saver is not None:
+                saver.close()          # fence the in-flight write
+
+    def _train_loop(self, reader, num_passes, handler, test_reader,
+                    checkpoint_dir, checkpoint_keep, saving_period,
+                    log_period, rng, start_pass, skip_batches, save_fn):
         ts = self.train_state
         params, state, opt_state, step = (ts.params, ts.state, ts.opt_state,
                                           ts.step)
@@ -337,7 +358,7 @@ class Trainer:
                     self._log_param_stats(pass_id, batch_id)
                 if saving_period and checkpoint_dir and \
                         (batch_id + 1) % saving_period == 0:
-                    ckpt_lib.save_checkpoint(
+                    save_fn(
                         checkpoint_dir, pass_id,
                         {**self.train_state.as_dict(),
                          "iter": {"pass": pass_id, "next_batch": batch_id + 1,
@@ -354,7 +375,7 @@ class Trainer:
                 pass_metrics.update({f"test_{k}": v for k, v in tm.items()})
                 pass_metrics["test_cost"] = tc
             if checkpoint_dir:
-                ckpt_lib.save_checkpoint(
+                save_fn(
                     checkpoint_dir, pass_id,
                     {**self.train_state.as_dict(),
                      "iter": {"pass": pass_id, "next_batch": 0,
