@@ -3,4 +3,4 @@ paddle.v2.reader / paddle.v2.dataset / PyDataProvider2)."""
 
 from . import datasets, image, recordio
 from .reader import (batched, buffered, chain, compose, cycle, firstn,
-                     map_readers, prefetch, sharded, shuffle)
+                     map_readers, prefetch, sharded, shuffle, xmap)

@@ -5,8 +5,9 @@ multi-process loader ``image_multiproc.py``; v2 ``paddle.v2.image``).
 All numpy, all HWC float32 (the package's NHWC convention — the reference is
 CHW and converts at the edge). Compose transforms with :func:`pipeline` and
 lift onto a reader with ``data.map_readers``; heavy pipelines parallelize
-with the threaded prefetch reader (``data.buffered``), the analog of the
-reference's multiprocess loader.
+across worker processes with ``data.xmap`` (the analog of the reference's
+multiprocess loader — ``TrainAugment``/``EvalTransform`` are picklable for
+exactly this), or across threads with ``data.buffered`` for IO-bound work.
 """
 
 from __future__ import annotations
@@ -17,7 +18,7 @@ import numpy as np
 
 __all__ = ["resize", "center_crop", "random_crop", "random_flip",
            "normalize", "to_chw", "to_hwc", "pipeline", "train_augment",
-           "eval_transform"]
+           "eval_transform", "TrainAugment", "EvalTransform"]
 
 
 def resize(img: np.ndarray, hw: Tuple[int, int]) -> np.ndarray:
@@ -91,22 +92,75 @@ def pipeline(*fns: Callable) -> Callable:
     return run
 
 
+class TrainAugment:
+    """Train-time augmentation of ``preprocess_img.py``: resize -> random
+    crop -> random flip -> normalize.
+
+    PICKLABLE (plain attributes, no closures) so it can cross process
+    boundaries in ``data.xmap`` — the analog of the reference's
+    multi-process image loader (``utils/image_multiproc.py``). Randomness
+    is derived per SAMPLE from ``(seed, epoch, crc32(image bytes))``, so
+    the augmentation is deterministic and independent of worker count and
+    of which worker gets which sample. For fresh crops/flips each epoch,
+    call :meth:`set_epoch` before the pass (e.g. in a ``BeginPass``
+    handler); readers embedding the instance see the new value because the
+    object is shared, and ``data.xmap`` re-pickles it at each ``reader()``
+    call, so workers pick it up too."""
+
+    def __init__(self, crop_hw: Tuple[int, int], resize_hw: Tuple[int, int],
+                 mean: Sequence[float], std: Sequence[float] = (1, 1, 1),
+                 seed: int = 0):
+        self.crop_hw = tuple(crop_hw)
+        self.resize_hw = tuple(resize_hw)
+        self.mean = tuple(mean)
+        self.std = tuple(std)
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> "TrainAugment":
+        self.epoch = int(epoch)
+        return self
+
+    def _rng(self, img: np.ndarray) -> np.random.RandomState:
+        import zlib
+        h = zlib.crc32(np.ascontiguousarray(img).tobytes())
+        return np.random.RandomState(
+            (self.seed * 2654435761 + self.epoch * 40503 + h) & 0xFFFFFFFF)
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        rng = self._rng(img)
+        img = resize(img, self.resize_hw)
+        img = random_crop(img, self.crop_hw, rng)
+        img = random_flip(img, rng)
+        return normalize(img, self.mean, self.std)
+
+
+class EvalTransform:
+    """Eval-time: resize -> center crop -> normalize (picklable for
+    ``data.xmap``)."""
+
+    def __init__(self, crop_hw: Tuple[int, int], resize_hw: Tuple[int, int],
+                 mean: Sequence[float], std: Sequence[float] = (1, 1, 1)):
+        self.crop_hw = tuple(crop_hw)
+        self.resize_hw = tuple(resize_hw)
+        self.mean = tuple(mean)
+        self.std = tuple(std)
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        img = resize(img, self.resize_hw)
+        img = center_crop(img, self.crop_hw)
+        return normalize(img, self.mean, self.std)
+
+
 def train_augment(crop_hw: Tuple[int, int], resize_hw: Tuple[int, int],
                   mean: Sequence[float], std: Sequence[float] = (1, 1, 1),
                   seed: int = 0) -> Callable:
-    """The standard train-time augmentation of ``preprocess_img.py``:
-    resize -> random crop -> random flip -> normalize."""
-    rng = np.random.RandomState(seed)
-    return pipeline(lambda im: resize(im, resize_hw),
-                    lambda im: random_crop(im, crop_hw, rng),
-                    lambda im: random_flip(im, rng),
-                    lambda im: normalize(im, mean, std))
+    """See :class:`TrainAugment` (kept as the factory-style API)."""
+    return TrainAugment(crop_hw, resize_hw, mean, std, seed)
 
 
 def eval_transform(crop_hw: Tuple[int, int], resize_hw: Tuple[int, int],
                    mean: Sequence[float],
                    std: Sequence[float] = (1, 1, 1)) -> Callable:
-    """Eval-time: resize -> center crop -> normalize."""
-    return pipeline(lambda im: resize(im, resize_hw),
-                    lambda im: center_crop(im, crop_hw),
-                    lambda im: normalize(im, mean, std))
+    """See :class:`EvalTransform` (kept as the factory-style API)."""
+    return EvalTransform(crop_hw, resize_hw, mean, std)

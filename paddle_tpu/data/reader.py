@@ -12,6 +12,7 @@ a background thread (the analog of the reference's ``DoubleBuffer`` async layer,
 from __future__ import annotations
 
 import itertools
+import multiprocessing as _mp
 import queue
 import random as _random
 import threading
@@ -20,7 +21,7 @@ from typing import Any, Callable, Iterable, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["map_readers", "shuffle", "buffered", "compose", "chain", "firstn",
-           "batched", "prefetch", "cycle", "sharded"]
+           "batched", "prefetch", "cycle", "sharded", "xmap"]
 
 Reader = Callable[[], Iterable]
 
@@ -168,3 +169,118 @@ def prefetch(reader_fn: Reader, depth: int = 2) -> Reader:
     """Async host-side prefetch (DoubleBuffer analog) — overlap input pipeline
     with device compute."""
     return buffered(reader_fn, depth)
+
+
+def _xmap_worker(func, in_q, out_q):
+    """Worker-process loop for :func:`xmap` (top-level so the spawn context
+    can pickle it)."""
+    while True:
+        task = in_q.get()
+        if task is None:
+            out_q.put(("done", -1, None))
+            return
+        idx, sample = task
+        try:
+            out_q.put(("ok", idx, func(sample)))
+        except BaseException as e:  # surface in the consumer, then die
+            out_q.put(("err", idx, f"{type(e).__name__}: {e}"))
+            return
+
+
+def xmap(func: Callable, reader_fn: Reader, processes: int = 2,
+         buffer: int = 8, ordered: bool = True,
+         mp_context: str = "spawn") -> Reader:
+    """Parallel map over a reader in worker PROCESSES — real parallelism
+    for CPU-bound mappers that the GIL serializes under :func:`buffered`
+    (the reference's ``xmap_readers``, ``v2/reader/decorator.py:233-292``,
+    and its image loader ``utils/image_multiproc.py``).
+
+    ``func`` and the samples must be picklable; the default ``spawn``
+    context is used because forking after jax/XLA threads exist is unsafe.
+    Workers should not touch jax devices. ``buffer`` bounds in-flight
+    samples in each direction (backpressure — the reader is consumed at
+    the pace of the mappers, never slurped whole). ``ordered=True``
+    preserves input order at the cost of head-of-line blocking. Workers
+    shut down cleanly both when the reader is exhausted and when the
+    consumer abandons the iterator early (``break`` / ``close()``)."""
+    assert processes >= 1
+
+    def reader():
+        ctx = _mp.get_context(mp_context)
+        in_q = ctx.Queue(buffer)
+        out_q = ctx.Queue(buffer)
+        workers = [ctx.Process(target=_xmap_worker,
+                               args=(func, in_q, out_q), daemon=True)
+                   for _ in range(processes)]
+        for w in workers:
+            w.start()
+        stop = threading.Event()
+        feeder_err: List[BaseException] = []
+
+        def _put(task) -> bool:
+            while not stop.is_set():
+                try:
+                    in_q.put(task, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False                               # consumer gone
+
+        def feed():
+            try:
+                for i, s in enumerate(reader_fn()):
+                    if not _put((i, s)):
+                        return
+            except BaseException as e:     # surface in the consumer
+                feeder_err.append(e)
+            finally:
+                # ALWAYS deliver the per-worker sentinels — a source-reader
+                # error must end the workers, not strand the consumer on
+                # out_q.get() forever
+                for _ in workers:
+                    if not _put(None):
+                        return
+
+        feeder = threading.Thread(target=feed, daemon=True)
+        feeder.start()
+        try:
+            done, pending, nxt = 0, {}, 0
+            while done < len(workers):
+                kind, idx, payload = out_q.get()
+                if kind == "done":
+                    done += 1
+                elif kind == "err":
+                    raise RuntimeError(f"xmap worker failed: {payload}")
+                elif not ordered:
+                    yield payload
+                else:
+                    pending[idx] = payload
+                    while nxt in pending:
+                        yield pending.pop(nxt)
+                        nxt += 1
+            if feeder_err:
+                raise feeder_err[0]
+        finally:
+            stop.set()
+            # fast shutdown without SIGTERM: clear pending tasks, hand every
+            # worker a sentinel, and free any worker blocked on a full out_q
+            try:
+                while True:
+                    in_q.get_nowait()
+            except queue.Empty:
+                pass
+            for _ in workers:
+                try:
+                    in_q.put_nowait(None)
+                except queue.Full:
+                    break
+            try:
+                while True:
+                    out_q.get_nowait()
+            except queue.Empty:
+                pass
+            for w in workers:
+                w.join(timeout=2.0)
+                if w.is_alive():
+                    w.terminate()
+    return reader

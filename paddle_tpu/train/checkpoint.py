@@ -45,7 +45,19 @@ def atomic_dir(path: str):
     Go pserver writes aside then renames over, go/pserver/service.go:346)."""
     tmp = path + ".tmp"
     if os.path.exists(tmp):
-        shutil.rmtree(tmp)
+        # A manifest-complete .tmp with NO live dir is the only surviving
+        # copy of this pass (crash between the two renames; readers are
+        # resolving it right now) — demote it to .old instead of deleting,
+        # keeping the at-least-one-complete-copy invariant through the
+        # rewrite. Anything else is half-written garbage.
+        if (not os.path.exists(path)
+                and os.path.exists(os.path.join(tmp, _MANIFEST))):
+            old = path + ".old"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.rename(tmp, old)
+        else:
+            shutil.rmtree(tmp)
     os.makedirs(tmp, exist_ok=True)
     yield tmp
     old = path + ".old"
@@ -274,19 +286,23 @@ class AsyncCheckpointer:
 
 
 def _gc(root: str, keep_last: int):
-    """Retention: keep the newest ``keep_last`` live passes, and prune
-    ``.tmp``/``.old`` crash leftovers whose pass fell out of retention —
-    otherwise a leftover could outlive (and later shadow) a pass the
-    retention policy deleted. Leftovers NEWER than every live pass (a
-    crashed latest save) are kept: they may be the only copy."""
-    live = sorted(d for d in os.listdir(root) if _is_pass_dir(d))
-    keep_ids = {_base_pass_id(d) for d in live[-keep_last:]}
-    newest = max(keep_ids, default=-1)
+    """Retention: keep the newest ``keep_last`` READABLE passes — live dirs
+    and manifest-complete crash leftovers count equally (a crashed latest
+    save may be the only copy of its pass and must survive) — then delete
+    every pass-* entry (live, ``.tmp`` or ``.old``) whose pass id fell out
+    of that set, so a stale leftover can never outlive and later shadow a
+    pass the retention policy deleted. Entries with unparsable ids are
+    left alone."""
+    readable = set()
     for d in os.listdir(root):
         pid = _base_pass_id(d)
-        if pid is None:
-            continue
-        if pid not in keep_ids and pid <= newest:
+        if pid is not None and \
+                os.path.exists(os.path.join(root, d, _MANIFEST)):
+            readable.add(pid)
+    keep = set(sorted(readable)[-keep_last:])
+    for d in os.listdir(root):
+        pid = _base_pass_id(d)
+        if pid is not None and pid not in keep:
             shutil.rmtree(os.path.join(root, d))
 
 

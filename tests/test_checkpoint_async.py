@@ -151,13 +151,40 @@ def test_gc_prunes_stale_siblings_keeps_crashed_latest(tmp_path):
     ckpt._write_pass_dir(root, 5, {"params": {"w": np.full((2,), 5.0)}})
     os.rename(os.path.join(root, "pass-00005"),
               os.path.join(root, "pass-00005.tmp"))
+    # stray non-numeric dir must neither crash _gc nor be deleted
+    os.makedirs(os.path.join(root, "pass-backup"))
     ckpt._gc(root, keep_last=2)
     left = sorted(d for d in os.listdir(root) if d.startswith("pass-"))
-    # pass 0's stale .old is gone with its pass; crashed latest survives
-    assert left == ["pass-00002", "pass-00003", "pass-00005.tmp"]
+    # newest 2 READABLE passes survive — the crashed latest (.tmp) counts
+    # as a real pass; pass 0's stale .old went with its pass
+    assert left == ["pass-00003", "pass-00005.tmp", "pass-backup"]
     assert ckpt.latest_pass(root) == 5
     out = ckpt.load_checkpoint(root)
     np.testing.assert_allclose(out["params"]["w"], np.full((2,), 5.0))
+
+
+def test_rewrite_of_crash_surviving_tmp_keeps_a_complete_copy(tmp_path,
+                                                              monkeypatch):
+    """If a pass survives ONLY as .tmp (crash between renames) and is then
+    re-saved, the rewrite must not destroy the sole copy: atomic_dir
+    demotes the complete .tmp to .old, and a crash during the rewrite
+    still leaves a loadable pass."""
+    root = str(tmp_path)
+    ckpt._write_pass_dir(root, 0, {"params": {"w": np.full((2,), 1.0)}})
+    os.rename(os.path.join(root, "pass-00000"),
+              os.path.join(root, "pass-00000.tmp"))
+    assert ckpt.latest_pass(root) == 0          # readable via .tmp
+
+    # crash the re-save before ANY rename lands (np.savez blows up)
+    def boom(*a, **k):
+        raise RuntimeError("simulated crash mid-write")
+    monkeypatch.setattr(ckpt.np, "savez", boom)
+    with pytest.raises(RuntimeError, match="mid-write"):
+        ckpt.save_checkpoint(root, 0, {"params": {"w": np.full((2,), 2.0)}})
+    monkeypatch.undo()
+
+    out = ckpt.load_checkpoint(root, 0)         # v1 survived as .old
+    np.testing.assert_allclose(out["params"]["w"], np.full((2,), 1.0))
 
 
 def test_async_overlaps_with_training_thread(tmp_path):

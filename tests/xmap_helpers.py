@@ -1,0 +1,32 @@
+"""Top-level picklable mappers for test_xmap: spawn workers unpickle these
+by importing THIS module, which deliberately avoids jax so worker startup
+stays cheap on the 1-core bench host."""
+
+import time
+
+import numpy as np
+
+
+def square(x):
+    return x * x
+
+
+def slow_square(x):
+    # jitter completion order so ordered/unordered behavior is observable
+    time.sleep(0.05 if (x % 3) == 0 else 0.0)
+    return x * x
+
+
+def boom_on_3(x):
+    if x == 3:
+        raise ValueError("sample 3 is poison")
+    return x
+
+
+def burn(x):
+    """~CPU-bound mapper for the (multi-core-only) speedup check."""
+    a = np.random.RandomState(x).rand(120, 120)
+    for _ in range(3):
+        a = a @ a.T
+        a /= np.abs(a).max()
+    return float(a[0, 0])
