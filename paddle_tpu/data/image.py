@@ -155,7 +155,15 @@ class EvalTransform:
 def train_augment(crop_hw: Tuple[int, int], resize_hw: Tuple[int, int],
                   mean: Sequence[float], std: Sequence[float] = (1, 1, 1),
                   seed: int = 0) -> Callable:
-    """See :class:`TrainAugment` (kept as the factory-style API)."""
+    """See :class:`TrainAugment` (kept as the factory-style API).
+
+    SEMANTICS CHANGE vs the pre-xmap closure: randomness is now a pure
+    function of ``(seed, epoch, image bytes)`` — reproducible and
+    worker-assignment-independent — so repeated passes re-apply IDENTICAL
+    crops/flips unless you call ``.set_epoch(pass_id)`` between passes
+    (e.g. from a ``BeginPass`` event handler). The old version drew from
+    one advancing RandomState and varied per epoch but was irreproducible
+    under multi-process mapping."""
     return TrainAugment(crop_hw, resize_hw, mean, std, seed)
 
 

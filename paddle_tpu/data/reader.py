@@ -246,7 +246,18 @@ def xmap(func: Callable, reader_fn: Reader, processes: int = 2,
         try:
             done, pending, nxt = 0, {}, 0
             while done < len(workers):
-                kind, idx, payload = out_q.get()
+                try:
+                    kind, idx, payload = out_q.get(timeout=1.0)
+                except queue.Empty:
+                    # a worker killed by SIGKILL/segfault/OOM never posts
+                    # its sentinel — detect the corpse instead of hanging
+                    dead = [w for w in workers
+                            if w.exitcode not in (None, 0)]
+                    if dead:
+                        raise RuntimeError(
+                            f"xmap worker died with exitcode "
+                            f"{dead[0].exitcode} (segfault/OOM-kill?)")
+                    continue
                 if kind == "done":
                     done += 1
                 elif kind == "err":
@@ -270,10 +281,17 @@ def xmap(func: Callable, reader_fn: Reader, processes: int = 2,
             except queue.Empty:
                 pass
             for _ in workers:
-                try:
-                    in_q.put_nowait(None)
-                except queue.Full:
-                    break
+                # with buffer < processes the queue can refill faster than
+                # one drain: make room per sentinel rather than giving up
+                for _attempt in range(2):
+                    try:
+                        in_q.put_nowait(None)
+                        break
+                    except queue.Full:
+                        try:
+                            in_q.get_nowait()
+                        except queue.Empty:
+                            pass
             try:
                 while True:
                     out_q.get_nowait()

@@ -49,6 +49,13 @@ class SparseLR(Module):
 
     ``ids [B, F]`` carry field-local ids; each field f gets its own row range
     ``[f*vocab, (f+1)*vocab)`` of one big weight table. Returns logits [B].
+
+    ``weights [B, F]`` (optional) makes the input a *sparse float-value*
+    vector — ``x[id_f] = w_f`` instead of 1.0 — the PyDataProvider2
+    ``sparse_float_vector`` slot (reference:
+    ``python/paddle/trainer/PyDataProvider2.py:116-248`` converter,
+    input-type system ``:365``); the logit is then exactly the dense
+    matmul ``x @ W`` of that weighted multi-hot vector.
     """
 
     def __init__(self, num_fields: int, vocab_per_field: int, name=None):
@@ -58,9 +65,12 @@ class SparseLR(Module):
         self.wide = nn.Embedding(num_fields * vocab_per_field, 1,
                                  name="wide")
 
-    def forward(self, ids, train=False):
+    def forward(self, ids, weights=None, train=False):
         g = _global_field_ids(ids, self.num_fields, self.vocab)
-        logit = self.wide(g)[..., 0].sum(-1)            # [B]
+        per_field = self.wide(g)[..., 0]                # [B, F]
+        if weights is not None:
+            per_field = per_field * weights
+        logit = per_field.sum(-1)                       # [B]
         b = self.param("b", lambda r, s, d: jnp.zeros(s, d), ())
         return logit + b
 
@@ -86,10 +96,17 @@ class WideDeepCTR(Module):
               for i, h in enumerate(hidden)],
             nn.Linear(1, name="out"), name="mlp")
 
-    def forward(self, ids, train=False):
+    def forward(self, ids, weights=None, train=False):
+        """``weights [B, F]`` (optional) = sparse float-value slot: both the
+        wide term and the deep field embeddings scale by the id's value
+        (the dense equivalent feeds the weighted multi-hot vector)."""
         g = _global_field_ids(ids, self.num_fields, self.vocab)
-        wide_logit = self.wide(g)[..., 0].sum(-1)                   # [B]
+        wide_per_field = self.wide(g)[..., 0]                       # [B, F]
         e = self.deep(g)                                            # [B,F,D]
+        if weights is not None:
+            wide_per_field = wide_per_field * weights
+            e = e * weights[..., None]
+        wide_logit = wide_per_field.sum(-1)                         # [B]
         flat = e.reshape(e.shape[0], self.num_fields * self.emb_dim)
         deep_logit = self.mlp(flat)[:, 0]                           # [B]
         return wide_logit + deep_logit
@@ -123,12 +140,16 @@ class SparseRowsWideDeepCTR(Module):
         return _global_field_ids(ids, self.num_fields, self.vocab)
 
     def forward(self, ids, wide_rows, wide_gather, deep_rows, deep_gather,
-                train=False):
+                weights=None, train=False):
         """``*_rows`` [U, D] gathered table rows; ``*_gather`` [B, F] index
-        of each field's row within them (padding already zeroed in rows)."""
+        of each field's row within them (padding already zeroed in rows).
+        ``weights [B, F]`` (optional) = sparse float-value slot."""
         valid = (ids >= 0)[..., None]
         wide_e = jnp.where(valid, wide_rows[wide_gather], 0.0)     # [B,F,1]
         deep_e = jnp.where(valid, deep_rows[deep_gather], 0.0)     # [B,F,D]
+        if weights is not None:
+            wide_e = wide_e * weights[..., None]
+            deep_e = deep_e * weights[..., None]
         wide_logit = wide_e[..., 0].sum(-1)
         flat = deep_e.reshape(deep_e.shape[0], self.num_fields * self.emb_dim)
         return wide_logit + self.mlp(flat)[:, 0]
@@ -151,6 +172,7 @@ def make_sparse_ctr_step(model: "SparseRowsWideDeepCTR", dense_optimizer,
 
     def step_fn(params, opt_state, wide_tbl, deep_tbl, step_no, batch):
         ids = batch["ids"]
+        weights = batch.get("weights")      # sparse float-value slot
         g = model.global_ids(ids)
         wide_pre = sp.sparse_prefetch(wide_tbl, g, step_no, catchup=catchup)
         deep_pre = sp.sparse_prefetch(deep_tbl, g, step_no, catchup=catchup)
@@ -158,7 +180,7 @@ def make_sparse_ctr_step(model: "SparseRowsWideDeepCTR", dense_optimizer,
         def compute_loss(p, wide_rows, deep_rows):
             out = model.apply(
                 {"params": p}, ids, wide_rows, wide_pre.gather_idx,
-                deep_rows, deep_pre.gather_idx, train=True)
+                deep_rows, deep_pre.gather_idx, weights=weights, train=True)
             return loss_fn(out, batch)
 
         (loss), grads = jax.value_and_grad(compute_loss, argnums=(0, 1, 2))(

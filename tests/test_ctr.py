@@ -87,3 +87,71 @@ def test_sharded_table_matches_replicated(rng):
     # and the tables really are row-sharded
     root = next(iter(p_sh))
     assert tuple(p_sh[root]["deep"]["w"].sharding.spec) == ("model", None)
+
+
+def test_sparse_float_slot_matches_dense_matmul(rng):
+    """(ids, weights) is PyDataProvider2's sparse_float_vector slot
+    (reference: PyDataProvider2.py:116-248): the wide logit must equal the
+    dense matmul of the weighted multi-hot vector, and omitting weights
+    must equal weights=1 (the sparse_binary_vector special case)."""
+    import jax.numpy as jnp
+    nprng = np.random.RandomState(3)
+    B = 16
+    ids = nprng.randint(0, VOCAB, (B, FIELDS)).astype(np.int32)
+    ids[0, 2] = -1                                     # padding slot
+    w = nprng.normal(size=(B, FIELDS)).astype(np.float32)
+
+    m = SparseLR(FIELDS, VOCAB, name="lr")
+    variables = m.init(rng, ids)
+    table = np.asarray(variables["params"]["lr"]["wide"]["w"])   # [F*V, 1]
+    bias = float(np.asarray(variables["params"]["lr"]["b"]))
+
+    got = np.asarray(m.apply(variables, ids, weights=jnp.asarray(w)))
+    dense_x = np.zeros((B, FIELDS * VOCAB), np.float32)
+    for b in range(B):
+        for f in range(FIELDS):
+            if ids[b, f] >= 0:
+                dense_x[b, f * VOCAB + ids[b, f]] += w[b, f]
+    oracle = dense_x @ table[:, 0] + bias
+    np.testing.assert_allclose(got, oracle, rtol=1e-5, atol=1e-6)
+
+    # binary case: no weights == all-ones weights
+    ones = np.asarray(m.apply(variables, ids,
+                              weights=jnp.ones_like(jnp.asarray(w))))
+    none = np.asarray(m.apply(variables, ids))
+    np.testing.assert_allclose(none, ones, rtol=1e-6)
+
+
+def test_wide_deep_sparse_float_slot(rng):
+    """WideDeepCTR's weighted lookup == manual weighted gather from its
+    own tables (deep fields scale by the value, the dense-matmul view of
+    the sparse_float slot feeding an embedding layer)."""
+    import jax.numpy as jnp
+    nprng = np.random.RandomState(4)
+    B, D = 8, 4
+    ids = nprng.randint(0, VOCAB, (B, FIELDS)).astype(np.int32)
+    ids[1, 0] = -1
+    w = nprng.normal(size=(B, FIELDS)).astype(np.float32)
+    m = WideDeepCTR(FIELDS, VOCAB, emb_dim=D, hidden=(8,), name="wd")
+    variables = m.init(rng, ids)
+    p = variables["params"]["wd"]
+
+    got = np.asarray(m.apply(variables, ids, weights=jnp.asarray(w)))
+
+    wide_t = np.asarray(p["wide"]["w"])                # [F*V, 1]
+    deep_t = np.asarray(p["deep"]["w"])                # [F*V, D]
+    wide_logit = np.zeros(B, np.float32)
+    flat = np.zeros((B, FIELDS * D), np.float32)
+    for b in range(B):
+        for f in range(FIELDS):
+            if ids[b, f] >= 0:
+                gidx = f * VOCAB + ids[b, f]
+                wide_logit[b] += w[b, f] * wide_t[gidx, 0]
+                flat[b, f * D:(f + 1) * D] = w[b, f] * deep_t[gidx]
+    # deep head: run the model's own mlp on the oracle-weighted features
+    h = np.maximum(flat @ np.asarray(p["mlp"]["fc0"]["w"])
+                   + np.asarray(p["mlp"]["fc0"]["b"]), 0.0)
+    deep_logit = (h @ np.asarray(p["mlp"]["out"]["w"])
+                  + np.asarray(p["mlp"]["out"]["b"]))[:, 0]
+    np.testing.assert_allclose(got, wide_logit + deep_logit,
+                               rtol=1e-4, atol=1e-5)

@@ -31,13 +31,17 @@ def nprng():
     return np.random.RandomState(0)
 
 
-def _batches(nprng, n_steps, batch=16):
+def _batches(nprng, n_steps, batch=16, weighted=False):
     out = []
     for _ in range(n_steps):
         ids = nprng.randint(0, VOCAB, size=(batch, FIELDS)).astype(np.int32)
         ids[nprng.rand(*ids.shape) < 0.1] = -1          # padding
         y = (nprng.rand(batch) < 0.4).astype(np.int32)
-        out.append({"ids": jnp.asarray(ids), "label": jnp.asarray(y)})
+        b = {"ids": jnp.asarray(ids), "label": jnp.asarray(y)}
+        if weighted:                                     # sparse float slot
+            b["weights"] = jnp.asarray(
+                nprng.normal(size=ids.shape).astype(np.float32))
+        out.append(b)
     return out
 
 
@@ -66,7 +70,8 @@ def _run_dense(dense, dparams, optimizer, batches):
     params = dparams
     for i, b in enumerate(batches):
         def loss_fn(p):
-            return _loss(dense.apply({"params": p}, b["ids"]), b)
+            return _loss(dense.apply({"params": p}, b["ids"],
+                                     weights=b.get("weights")), b)
         _, g = jax.value_and_grad(loss_fn)(params)
         upd, opt_state = optimizer.update(g, opt_state, params,
                                           jnp.asarray(i))
@@ -342,3 +347,21 @@ def test_host_offloaded_lazy_catchup(nprng):
     uniq, gidx, rows, slots = tbl.prefetch(ids, 3)
     want = v_after0 * (1 - lr * decay) ** 2
     np.testing.assert_allclose(np.asarray(rows)[0], want, rtol=1e-6)
+
+
+def test_sparse_float_slot_sparse_path_matches_dense(nprng):
+    """The weighted (sparse float-value) slot trains identically through
+    the sparse-rows tier and the dense path — weights scale the row
+    gradients, so this also pins the weighted scatter-add."""
+    batches = _batches(nprng, 5, weighted=True)
+    dense, sparse, dparams, sparams, wide_w, deep_w = _init_pair(nprng)
+    dfinal = _run_dense(dense, dparams, optim.sgd(0.1), batches)
+    sfinal, wide_tbl, deep_tbl, _ = _run_sparse(
+        sparse, sparams, wide_w, deep_w, optim.sgd(0.1), optim.sgd(0.1),
+        batches)
+    np.testing.assert_allclose(np.asarray(wide_tbl.rows),
+                               np.asarray(dfinal["ctr"]["wide"]["w"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(deep_tbl.rows),
+                               np.asarray(dfinal["ctr"]["deep"]["w"]),
+                               rtol=1e-5, atol=1e-6)

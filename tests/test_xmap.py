@@ -38,6 +38,13 @@ def test_xmap_worker_error_propagates():
         list(data.xmap(H.boom_on_3, _ints(8), processes=2)())
 
 
+def test_xmap_dead_worker_raises_instead_of_hanging():
+    """A worker killed without cleanup (segfault/OOM-kill analog) must be
+    detected as a corpse, not waited on forever."""
+    with pytest.raises(RuntimeError, match="died with exitcode"):
+        list(data.xmap(H.die_hard, _ints(8), processes=1)())
+
+
 def test_xmap_source_reader_error_propagates_no_hang():
     """A source reader that raises mid-iteration must surface the error
     after the mapped results — never strand the consumer on a queue."""

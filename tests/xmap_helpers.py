@@ -24,9 +24,20 @@ def boom_on_3(x):
 
 
 def burn(x):
-    """~CPU-bound mapper for the (multi-core-only) speedup check."""
-    a = np.random.RandomState(x).rand(120, 120)
-    for _ in range(3):
+    """CPU-bound mapper (~100 ms/call) for the multi-core-only speedup
+    check — heavy enough that 48 calls (~5 s serial) amortize the
+    spawn-context worker startup."""
+    a = np.random.RandomState(x).rand(600, 600)
+    for _ in range(20):
         a = a @ a.T
         a /= np.abs(a).max()
     return float(a[0, 0])
+
+
+def die_hard(x):
+    """Simulate a segfault/OOM-kill: the worker dies without posting any
+    sentinel (os._exit skips all cleanup)."""
+    import os
+    if x == 2:
+        os._exit(11)
+    return x
