@@ -243,8 +243,16 @@ def xmap(func: Callable, reader_fn: Reader, processes: int = 2,
 
         feeder = threading.Thread(target=feed, daemon=True)
         feeder.start()
+
+        def _drain(q):
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+
         try:
-            done, pending, nxt = 0, {}, 0
+            done, pending, nxt, silent = 0, {}, 0, 0
             while done < len(workers):
                 try:
                     kind, idx, payload = out_q.get(timeout=1.0)
@@ -257,7 +265,19 @@ def xmap(func: Callable, reader_fn: Reader, processes: int = 2,
                         raise RuntimeError(
                             f"xmap worker died with exitcode "
                             f"{dead[0].exitcode} (segfault/OOM-kill?)")
+                    # exit-code-0 corpse (func called os._exit(0)): all
+                    # workers gone, queue stayed empty across TWO timeouts
+                    # (margin for an in-flight pipe flush), sentinels short
+                    if all(not w.is_alive() for w in workers):
+                        silent += 1
+                        if silent >= 2:
+                            raise RuntimeError(
+                                "xmap workers exited without completing "
+                                "(mapped func called os._exit?)")
+                    else:
+                        silent = 0
                     continue
+                silent = 0
                 if kind == "done":
                     done += 1
                 elif kind == "err":
@@ -273,30 +293,18 @@ def xmap(func: Callable, reader_fn: Reader, processes: int = 2,
                 raise feeder_err[0]
         finally:
             stop.set()
-            # fast shutdown without SIGTERM: clear pending tasks, hand every
-            # worker a sentinel, and free any worker blocked on a full out_q
-            try:
-                while True:
-                    in_q.get_nowait()
-            except queue.Empty:
-                pass
+            # fast shutdown without SIGTERM: free workers blocked on a full
+            # out_q, clear pending tasks, then hand every worker a sentinel
+            # with a short blocking put (a get_nowait-to-make-room scheme
+            # can evict sentinels it just placed when buffer < processes)
+            _drain(out_q)
+            _drain(in_q)
             for _ in workers:
-                # with buffer < processes the queue can refill faster than
-                # one drain: make room per sentinel rather than giving up
-                for _attempt in range(2):
-                    try:
-                        in_q.put_nowait(None)
-                        break
-                    except queue.Full:
-                        try:
-                            in_q.get_nowait()
-                        except queue.Empty:
-                            pass
-            try:
-                while True:
-                    out_q.get_nowait()
-            except queue.Empty:
-                pass
+                try:
+                    in_q.put(None, timeout=0.2)
+                except queue.Full:
+                    break
+            _drain(out_q)
             for w in workers:
                 w.join(timeout=2.0)
                 if w.is_alive():

@@ -67,10 +67,20 @@ class Seq2SeqAttention(Module):
         dec0 = self.boot(back_first)
         return enc, mask, dec0
 
-    def _dec_step(self, state, y_emb, enc, enc_mask, enc_proj):
+    def _dec_cell_step(self, state, y_emb, enc, enc_mask, enc_proj):
+        """One decoder step WITHOUT the vocab readout — the readout is 83%
+        of decoder FLOPs (2*h*V per token) and, run per scan step as a tiny
+        [B, h] @ [h, V] matmul, dominated the step at single-digit MXU
+        efficiency (experiments/PERF.md "Round 5: seq2seq"); training
+        hoists it out of the scan and applies it once over [B, T, h]."""
         ctx, _ = self.att(state, enc, enc_mask, enc_proj=enc_proj)
         x = jnp.concatenate([y_emb, ctx], axis=-1)
         new_state, out = self.dec_cell.step(state, x)
+        return new_state, out
+
+    def _dec_step(self, state, y_emb, enc, enc_mask, enc_proj):
+        new_state, out = self._dec_cell_step(state, y_emb, enc, enc_mask,
+                                             enc_proj)
         logits = self.readout(out)
         return new_state, logits
 
@@ -92,12 +102,14 @@ class Seq2SeqAttention(Module):
         _ = self._dec_step(dec0, y_embs[:, 0], enc, enc_mask, enc_proj)
 
         def body(state, y_emb_t):
-            new_state, logits = self._dec_step(state, y_emb_t, enc, enc_mask,
-                                               enc_proj)
-            return new_state, logits
+            new_state, out = self._dec_cell_step(state, y_emb_t, enc,
+                                                 enc_mask, enc_proj)
+            return new_state, out
 
-        _, logits = lax.scan(body, dec0, jnp.swapaxes(y_embs, 0, 1))
-        logits = jnp.swapaxes(logits, 0, 1)                 # [B, Tt-1, V]
+        _, outs = lax.scan(body, dec0, jnp.swapaxes(y_embs, 0, 1))
+        # one big [B*(Tt-1), h] @ [h, V] readout instead of Tt-1 tiny ones
+        # inside the scan: same math, MXU-shaped (PERF.md "Round 5")
+        logits = self.readout(jnp.swapaxes(outs, 0, 1))      # [B, Tt-1, V]
         losses = nn.costs.softmax_cross_entropy(logits, tgt_out)
         out_mask = length_mask(jnp.maximum(tgt_len - 1, 0), tgt_out.shape[1])
         return (losses * out_mask).sum(-1)

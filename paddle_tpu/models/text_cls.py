@@ -24,6 +24,9 @@ class LSTMTextClassifier(Module):
                  num_classes: int = 2, name=None):
         super().__init__(name=name)
         self.emb = nn.Embedding(vocab, hidden)
+        # unroll measured NEUTRAL-to-worse under the bench's
+        # steps-per-call fori_loop (XLA pipelines the rolled loop better);
+        # see experiments/PERF.md "Round 5"
         self.layers = [RNN(LSTMCell(hidden), name=f"lstm{i}")
                        for i in range(num_layers)]
         self.fc = nn.Linear(num_classes, name="fc")

@@ -49,13 +49,37 @@ class LSTMCell(Module):
         with self.scope():
             return self._step(state, x)
 
+    def input_proj(self, x):
+        """Input-to-hidden half of the gates for a WHOLE sequence
+        [..., T, D] in one MXU-shaped matmul — hoisted out of the scan by
+        :class:`RNN` (pair with :meth:`step_proj`, which adds the serial
+        hidden-to-hidden half). Declares every cell param in the same order
+        as :meth:`step`, so init is identical whichever path runs first."""
+        with self.scope():
+            hd = self.hidden
+            wx = self.param("wx", I.xavier_uniform, (x.shape[-1], 4 * hd))
+            self.param("wh", I.orthogonal(), (hd, 4 * hd))
+            b = self.param("b", I.zeros, (4 * hd,))
+            return x @ wx + b
+
+    def step_proj(self, state, zx):
+        """One step from a precomputed input projection (see input_proj)."""
+        with self.scope():
+            h_prev, c_prev = state
+            hd = self.hidden
+            wh = self.param("wh", I.orthogonal(), (hd, 4 * hd))
+            return self._gates(h_prev, c_prev, zx + h_prev @ wh)
+
     def _step(self, state, x):
         h_prev, c_prev = state
         hd = self.hidden
         wx = self.param("wx", I.xavier_uniform, (x.shape[-1], 4 * hd))
         wh = self.param("wh", I.orthogonal(), (hd, 4 * hd))
         b = self.param("b", I.zeros, (4 * hd,))
-        z = x @ wx + h_prev @ wh + b
+        return self._gates(h_prev, c_prev, x @ wx + h_prev @ wh + b)
+
+    def _gates(self, h_prev, c_prev, z):
+        hd = self.hidden
         zi, zf, zg, zo = jnp.split(z, 4, axis=-1)
         if self.use_peepholes:
             w_ic = self.param("w_ic", I.zeros, (hd,))
@@ -93,14 +117,33 @@ class GRUCell(Module):
         with self.scope():
             return self._step(state, x)
 
+    def input_proj(self, x):
+        """Input half of the gates for a whole sequence (see
+        ``LSTMCell.input_proj``); declares params in :meth:`step`'s order."""
+        with self.scope():
+            hd = self.hidden
+            wx = self.param("wx", I.xavier_uniform, (x.shape[-1], 3 * hd))
+            self.param("wh", I.orthogonal(), (hd, 2 * hd))
+            self.param("wc", I.orthogonal(), (hd, hd))
+            b = self.param("b", I.zeros, (3 * hd,))
+            return x @ wx + b
+
+    def step_proj(self, state, zx):
+        with self.scope():
+            hd = self.hidden
+            wh = self.param("wh", I.orthogonal(), (hd, 2 * hd))
+            wc = self.param("wc", I.orthogonal(), (hd, hd))
+            return self._gates(state, zx, wh, wc)
+
     def _step(self, state, x):
-        h_prev = state
         hd = self.hidden
         wx = self.param("wx", I.xavier_uniform, (x.shape[-1], 3 * hd))
         wh = self.param("wh", I.orthogonal(), (hd, 2 * hd))
         wc = self.param("wc", I.orthogonal(), (hd, hd))
         b = self.param("b", I.zeros, (3 * hd,))
-        zx = x @ wx + b
+        return self._gates(state, x @ wx + b, wh, wc)
+
+    def _gates(self, h_prev, zx, wh, wc):
         zu, zr, zc = jnp.split(zx, 3, axis=-1)
         hu, hr = jnp.split(h_prev @ wh, 2, axis=-1)
         u = self.gate_act(zu + hu)
@@ -128,6 +171,21 @@ class SimpleRNNCell(Module):
         with self.scope():
             return self._step(state, x)
 
+    def input_proj(self, x):
+        with self.scope():
+            wx = self.param("wx", I.xavier_uniform,
+                            (x.shape[-1], self.hidden))
+            self.param("wh", I.orthogonal(), (self.hidden, self.hidden))
+            b = self.param("b", I.zeros, (self.hidden,))
+            return x @ wx + b
+
+    def step_proj(self, state, zx):
+        with self.scope():
+            wh = self.param("wh", I.orthogonal(),
+                            (self.hidden, self.hidden))
+            h = self.act(zx + state @ wh)
+            return h, h
+
     def _step(self, state, x):
         wx = self.param("wx", I.xavier_uniform, (x.shape[-1], self.hidden))
         wh = self.param("wh", I.orthogonal(), (self.hidden, self.hidden))
@@ -151,10 +209,16 @@ class RNN(Module):
     Returns ``(outputs [B, T, H], final_state)``.
     """
 
-    def __init__(self, cell, reverse: bool = False, name=None):
+    def __init__(self, cell, reverse: bool = False, unroll: int = 1,
+                 name=None):
         super().__init__(name=name)
         self.cell = cell
         self.reverse = reverse
+        # lax.scan unroll factor: an RNN step is a SMALL matmul, so the
+        # while-loop iteration overhead (~10 us on TPU) can dominate;
+        # unrolling amortizes it and lets XLA fuse across steps at the cost
+        # of compile time (measured in experiments/PERF.md "Round 5")
+        self.unroll = unroll
 
     def forward(self, x, mask=None, segment_starts=None, initial_state=None):
         b, t = x.shape[0], x.shape[1]
@@ -165,6 +229,16 @@ class RNN(Module):
         # fixed path; scan then reuses them via closure.
         cell = self.cell
 
+        # Input-projection hoist: cells exposing input_proj/step_proj get
+        # their input-to-hidden gate matmul computed for the WHOLE sequence
+        # in one MXU-shaped [B*T, D] @ [D, G] before the scan; only the
+        # serial hidden-to-hidden half stays inside (halves LSTM scan FLOPs
+        # — experiments/PERF.md "Round 5").
+        use_proj = hasattr(cell, "input_proj")
+        if use_proj:
+            x = cell.input_proj(x)
+        cell_step = cell.step_proj if use_proj else cell.step
+
         def one_step(state, inputs):
             xt, mt, st = inputs
             if st is not None:
@@ -172,7 +246,7 @@ class RNN(Module):
                 state = jax.tree_util.tree_map(
                     lambda s0, s: jnp.where(st[:, None] > 0, s0, s),
                     state0, state)
-            new_state, out = cell.step(state, xt)
+            new_state, out = cell_step(state, xt)
             if mt is not None:
                 keep = mt[:, None]
                 new_state = jax.tree_util.tree_map(
@@ -223,7 +297,8 @@ class RNN(Module):
             inputs = (xs, ss)
         else:
             inputs = (xs, ms, ss)
-        final, outs = lax.scan(scan_body, state0, inputs)
+        final, outs = lax.scan(scan_body, state0, inputs,
+                               unroll=self.unroll)
         outs = jnp.swapaxes(outs, 0, 1)                 # [B, T, H]
         if self.reverse:
             outs = outs[:, ::-1]
@@ -234,10 +309,10 @@ class BiRNN(Module):
     """Bidirectional wrapper (reference: ``networks.py bidirectional_lstm``):
     concat of forward and reverse passes with independent cells."""
 
-    def __init__(self, fwd_cell, bwd_cell, name=None):
+    def __init__(self, fwd_cell, bwd_cell, unroll: int = 1, name=None):
         super().__init__(name=name)
-        self.fwd = RNN(fwd_cell, reverse=False, name="fwd")
-        self.bwd = RNN(bwd_cell, reverse=True, name="bwd")
+        self.fwd = RNN(fwd_cell, reverse=False, unroll=unroll, name="fwd")
+        self.bwd = RNN(bwd_cell, reverse=True, unroll=unroll, name="bwd")
 
     def forward(self, x, mask=None, segment_starts=None):
         of, _ = self.fwd(x, mask=mask, segment_starts=segment_starts)
