@@ -233,3 +233,32 @@ def test_sequence_softmax():
     out = np.asarray(activations.sequence_softmax(x, lengths=jnp.array([3])))
     np.testing.assert_allclose(out[0, :3], 1 / 3, rtol=1e-5)
     assert out[0, 3] == 0
+
+
+def test_stem_s2d_lowering_matches_direct_conv(rng):
+    """The 7x7/2 SAME tiny-C_in stem lowers through the exact
+    space-to-depth rewrite (layers.py Conv2D.forward); it must match the
+    direct lax conv to float roundoff on odd AND even-channel inputs and
+    non-224 (even) sizes."""
+    from jax import lax
+    for hw, cin in ((56, 3), (48, 4)):
+        m = nn.Conv2D(16, kernel=7, stride=2, padding="SAME",
+                      use_bias=False)
+        x = jax.random.normal(jax.random.fold_in(rng, hw),
+                              (2, hw, hw, cin), jnp.float32)
+        v = m.init(rng, x)
+        got = m.apply(v, x)
+        w = v["params"]["Conv2D_0"]["w"]
+        want = lax.conv_general_dilated(
+            x, w, (2, 2), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+        # the numeric check alone is vacuous (both branches compute the
+        # same function): assert the s2d lowering actually FIRED — the
+        # program must carry the stride-1 pad-(1,2) conv, not the 7x7/2
+        hlo = jax.jit(lambda xx: m.apply(v, xx)).lower(x).as_text()
+        assert "pad = [[1, 2], [1, 2]]" in hlo, \
+            "s2d stem lowering did not fire"
+        assert "stride = [2, 2]" not in hlo, \
+            "direct 7x7/2 conv still present"
