@@ -30,8 +30,17 @@ class TransformerBlock(Module):
     def __init__(self, dim: int, num_heads: int, ffn_hidden: int,
                  use_flash: bool = False, moe_experts: int = 0,
                  dropout: float = 0.0, attention_impl=None, seq_mesh=None,
-                 seq_axis: str = "seq", batch_axis=None, name=None):
+                 seq_axis: str = "seq", batch_axis=None,
+                 residual_sharding=None, name=None):
         super().__init__(name=name)
+        # Optional ``x -> x`` callable (typically a with_sharding_constraint
+        # closure) applied to the residual stream after each sublayer add.
+        # Constraining residuals to a seq-sharded spec (e.g.
+        # P("data", "model", None)) turns Megatron tensor-parallel's
+        # activation all-reduces into reduce-scatter/all-gather pairs —
+        # sequence-parallel residuals, halving tp wire bytes
+        # (experiments/scaling_projection.py quantifies it).
+        self.residual_sharding = residual_sharding
         self.ln1 = LayerNorm()
         self.attn = MultiHeadAttention(num_heads, use_flash=use_flash,
                                        attention_impl=attention_impl,
@@ -49,13 +58,18 @@ class TransformerBlock(Module):
     def forward(self, x, train: bool = False, segments=None):
         h = x + self._maybe_drop(
             self.attn(self.ln1(x), causal=True, segments=segments), train)
+        if self.residual_sharding is not None:
+            h = self.residual_sharding(h)
         z = self.ln2(h)
         if self.moe_experts > 0:
             y, aux = self.ffn(z, return_aux=True)
         else:
             y = self.ffn2(self.ffn1(z))
             aux = jnp.zeros((), jnp.float32)
-        return h + self._maybe_drop(y, train), aux
+        out = h + self._maybe_drop(y, train)
+        if self.residual_sharding is not None:
+            out = self.residual_sharding(out)
+        return out, aux
 
     def _maybe_drop(self, x, train):
         if self.dropout is not None and train:
@@ -73,9 +87,11 @@ class TransformerLM(Module):
                  max_len: int = 512, use_flash: bool = False,
                  moe_experts: int = 0, dropout: float = 0.0,
                  attention_impl=None, seq_mesh=None, seq_axis: str = "seq",
-                 batch_axis=None, name="transformer_lm"):
+                 batch_axis=None, residual_sharding=None,
+                 name="transformer_lm"):
         super().__init__(name=name)
         self.max_len = max_len
+        self.residual_sharding = residual_sharding
         self.emb = Embedding(vocab, dim)
         self.pos = Embedding(max_len, dim,
                              w_init=I.normal(0.02), name="pos")
@@ -84,6 +100,7 @@ class TransformerLM(Module):
                                         attention_impl=attention_impl,
                                         seq_mesh=seq_mesh, seq_axis=seq_axis,
                                         batch_axis=batch_axis,
+                                        residual_sharding=residual_sharding,
                                         name=f"block{i}")
                        for i in range(num_layers)]
         self.ln_f = LayerNorm()
@@ -109,6 +126,8 @@ class TransformerLM(Module):
         assert T <= self.max_len, f"T={T} exceeds max_len={self.max_len}"
         pos = jnp.arange(T)[None] if positions is None else positions
         x = self.emb(ids) + self.pos(pos)
+        if self.residual_sharding is not None:
+            x = self.residual_sharding(x)
         aux_total = jnp.zeros((), jnp.float32)
         for blk in self.blocks:
             x, aux = blk(x, train=train, segments=segments)

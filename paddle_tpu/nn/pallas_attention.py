@@ -124,11 +124,16 @@ def _attn_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segs):
 
     @pl.when(needed)
     def _():
-        q = q_ref[:].astype(jnp.float32) * scale
-        ks = k_ref[:].astype(jnp.float32)
-        vs = v_ref[:].astype(jnp.float32)
-        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        # Matmuls take the operands in their NATIVE dtype with an f32
+        # accumulator: for bf16 inputs the MXU multiplies bf16 pairs into
+        # f32 at full rate (upcasting first halves throughput and changes
+        # nothing numerically — bf16 values are exact in f32). The scale is
+        # applied to the f32 scores instead of the q operand for the same
+        # reason. The probability tile is cast back to the value dtype
+        # before the PV matmul (the standard flash recipe; softmax stats
+        # m/l/LSE stay f32).
+        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = jnp.where(_causal_mask(qi, bq, ki, bk), s, _NEG)
         if segs:
@@ -139,7 +144,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segs):
         corr = jnp.exp(m - m_new)
         l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
-            p, vs, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_s[:] = m_new
 
@@ -172,24 +177,22 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(needed)
     def _():
-        q = q_ref[:].astype(jnp.float32) * scale
-        ks = k_ref[:].astype(jnp.float32)
-        vs = v_ref[:].astype(jnp.float32)
-        do = do_ref[:].astype(jnp.float32)
+        # Native-dtype matmul operands + f32 accumulate (see _attn_kernel);
+        # ds is cast to the k dtype before the dq matmul.
         lse = lse_ref[:]
         delta = delta_ref[:]
-        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = jnp.where(_causal_mask(qi, bq, ki, bk), s, _NEG)
         if segs:
             s = jnp.where(_seg_block_mask(sq_ref[:], sk_ref[:]), s, _NEG)
         p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_s[:] = dq_s[:] + jax.lax.dot_general(
-            ds, ks, (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[:], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nkb - 1)
@@ -220,33 +223,31 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(needed)
     def _():
-        ks = k_ref[:].astype(jnp.float32)
-        vs = v_ref[:].astype(jnp.float32)
-        q = q_ref[:].astype(jnp.float32) * scale
-        do = do_ref[:].astype(jnp.float32)
+        # Native-dtype matmul operands + f32 accumulate (see _attn_kernel).
+        # dk accumulates against the UNSCALED q; the scale lands once at
+        # the final write.
         lse = lse_ref[:]
         delta = delta_ref[:]
-        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = jnp.where(_causal_mask(qi, bq, ki, bk), s, _NEG)
         if segs:
             s = jnp.where(_seg_block_mask(sq_ref[:], sk_ref[:]), s, _NEG)
         p = jnp.exp(s - lse)
         dv_s[:] = dv_s[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[:], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        # accumulated against q*scale, so the scale is already applied
         dk_s[:] = dk_s[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[:], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == nqb - 1)
     def _():
-        dk_ref[:] = dk_s[:].astype(dk_ref.dtype)
+        dk_ref[:] = (dk_s[:] * scale).astype(dk_ref.dtype)
         dv_ref[:] = dv_s[:].astype(dv_ref.dtype)
 
 
