@@ -278,11 +278,19 @@ def prep_lstm(batch_size=64, seq_len=100, hidden=512, vocab=30000):
 
 
 def prep_transformer(batch_size=8, seq_len=2048, dim=512, layers=6,
-                     heads=8, vocab=32000):
+                     heads=4, vocab=32000):
     """Long-context transformer LM through the Pallas flash-attention path
     (no reference anchor — the 2017 reference predates transformers). The
     default dim-512 point is latency-bound (kept for record continuity);
-    ``prep_transformer_big`` is the compute-bound config."""
+    ``prep_transformer_big`` is the compute-bound config.
+
+    Head geometry: dh=128 (d512 H4 / d1024 H8) as of round 5 — at dh=64
+    both flash matmuls run half-width MXU tiles (contraction / output dim
+    64 vs the 128x128 array): measured 14.49 -> 6.96 ms per d1024 layer
+    fwd+bwd, full step 436 -> 339 ms (34.4 -> 44.2% MFU). Same dim/layers/
+    FLOPs — heads never enter ``transformer_train_flops``; dh=128 is the
+    TPU-canonical choice (pallas guide; PaLM/LLaMA-class models).
+    PROF_HEADS=16 experiments/profile_transformer.py --only=dh128 (the probe needs the dh=64 start point), PERF.md r5."""
     from paddle_tpu import optim
     from paddle_tpu.core.dtypes import bfloat16_compute, use_policy
     from paddle_tpu.models import TransformerLM
@@ -344,7 +352,7 @@ def prep_transformer(batch_size=8, seq_len=2048, dim=512, layers=6,
 
 
 def prep_transformer_big(batch_size=16, seq_len=2048, dim=1024, layers=8,
-                         heads=16, vocab=32000):
+                         heads=8, vocab=32000):
     """Compute-bound transformer config (VERDICT r3 item 3: dim >= 1024 at
     seq 2048, so the modern-flagship number measures the MXU, not dispatch
     latency)."""
@@ -676,7 +684,7 @@ def probe_environment(budget=600):
 # ---------------------------------------------------------------------------
 # scaling probe (unchanged protocol: virtual-CPU-mesh proxy, run explicitly;
 # the analytic ICI projection lives in experiments/scaling_projection.py and
-# SCALING_r04.json)
+# SCALING_r05.json)
 # ---------------------------------------------------------------------------
 
 def bench_scaling(per_device_batch=32, iters=2, steps_per_call=4):
@@ -765,7 +773,7 @@ def bench_scaling(per_device_batch=32, iters=2, steps_per_call=4):
 # Default plan: every north-star metric. The scaling probe is NOT in the
 # default plan: with one real chip it runs on the virtual-CPU mesh and its
 # CPU compiles cost ~20 min — run it explicitly (`--metric scaling`); the
-# committed artifacts are SCALING_r04.json (proxy + analytic projection).
+# committed artifacts are SCALING_r05.json (proxy + analytic projection).
 DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_big",
                 "lstm", "lstm_h256", "lstm_h1280"]
 

@@ -1,0 +1,65 @@
+"""Shared interleaved-differential timing for experiment scripts.
+
+bench.py's ``run_timed_child`` is the CANONICAL implementation of the
+protocol (warmup fence, degenerate-sample sentinel, fallback labelling) —
+protocol fixes land there first. This module is the experiment-side
+k-parameterized form so the profiling scripts stop carrying divergent
+inline copies (code-review r5: conv1x1_backward / profile_transformer /
+conv3x3_shapes each had one).
+"""
+
+import time
+
+import jax
+from jax import lax
+
+
+def fence_state(state):
+    """Block until the device work producing ``state`` is done (fetch one
+    scalar — never fetch big buffers inside a timed region)."""
+    float(jax.device_get(jax.tree_util.tree_leaves(state)[0].ravel()[0]))
+
+
+def diff_time(make_body, state, k=8, reps=2, use_fori=False):
+    """Interleaved differential of a state->state body: median ms/pass.
+
+    Times regions of k and 3k passes back to back and reports
+    ``(t_3k - t_k) / 2k`` — per-call dispatch and the closing fetch cancel.
+
+    ``use_fori=False`` dispatches the jitted body k / 3k times per region
+    (the proven bench-child pattern — the remote compile service
+    reproducibly breaks on fori-wrapped FULL-model programs, while k=1
+    programs and fori-wrapped small ops compile fine). Use
+    ``use_fori=True`` only for cheap ops where the ~5 ms/call dispatch
+    would swamp the signal."""
+    if use_fori:
+        stepc = jax.jit(lambda s: lax.fori_loop(
+            0, k, lambda i, t: make_body(t), s), donate_argnums=0)
+        stepc3 = jax.jit(lambda s: lax.fori_loop(
+            0, 3 * k, lambda i, t: make_body(t), s), donate_argnums=0)
+
+        def region(which, state):
+            t0 = time.perf_counter()
+            state = (stepc if which == 0 else stepc3)(state)
+            fence_state(state)
+            return time.perf_counter() - t0, state
+    else:
+        stepc1 = jax.jit(make_body, donate_argnums=0)
+
+        def region(which, state):
+            ncalls = k if which == 0 else 3 * k
+            t0 = time.perf_counter()
+            for _ in range(ncalls):
+                state = stepc1(state)
+            fence_state(state)
+            return time.perf_counter() - t0, state
+
+    _, state = region(0, state)          # compile + warm both variants
+    _, state = region(1, state)
+    fence_state(state)
+    samples = []
+    for _ in range(reps):
+        ta, state = region(0, state)
+        tb, state = region(1, state)
+        samples.append((tb - ta) / (2 * k))
+    return sorted(samples)[len(samples) // 2] * 1e3

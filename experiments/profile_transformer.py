@@ -20,69 +20,27 @@ Usage: PYTHONPATH=/root/repo:/root/.axon_site python
 import json
 import os
 import sys
-import time
-from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax
 
-B, T, D, L, H, V = 16, 2048, 1024, 8, 16, 32000
+from _timing import diff_time
+
+# PROF_* env overrides re-point the script at other transformer shapes
+# (d512 bench config, d2048 scaling anchor). PROF_HEADS at fixed D is the
+# dh=128 vs dh=64 MXU geometry experiment; attention FLOPs are
+# H-independent.
+B = int(os.environ.get("PROF_BS", "16"))
+T = 2048
+D = int(os.environ.get("PROF_DIM", "1024"))
+L = int(os.environ.get("PROF_LAYERS", "8"))
+H = int(os.environ.get("PROF_HEADS", "8"))
+V = 32000
 FFN = 4 * D
 PEAK = 197e12
-
-
-def _fence_state(state):
-    float(jax.device_get(jax.tree_util.tree_leaves(state)[0].ravel()[0]))
-
-
-def diff_time(make_body, state, k=8, reps=2, use_fori=False):
-    """Interleaved differential of a state->state body: median ms/pass.
-
-    NOTE: bench.py's run_timed_child is the CANONICAL implementation of
-    this protocol (warmup fence, degenerate-sample sentinel, fallback
-    labelling); protocol fixes land there first — keep this experiment
-    copy in sync when touching either.
-
-    use_fori=False dispatches the jitted body k / 3k times per region (the
-    proven bench-child pattern — the remote compile service reproducibly
-    breaks on fori-wrapped FULL-transformer programs, while k=1 programs
-    and fori-wrapped small ops compile fine). Use use_fori=True only for
-    cheap ops where the ~5 ms/call dispatch would swamp the signal."""
-    if use_fori:
-        stepc = jax.jit(lambda s: lax.fori_loop(
-            0, k, lambda i, t: make_body(t), s), donate_argnums=0)
-        stepc3 = jax.jit(lambda s: lax.fori_loop(
-            0, 3 * k, lambda i, t: make_body(t), s), donate_argnums=0)
-
-        def region(which, state):
-            t0 = time.perf_counter()
-            state = (stepc if which == 0 else stepc3)(state)
-            _fence_state(state)
-            return time.perf_counter() - t0, state
-    else:
-        stepc1 = jax.jit(make_body, donate_argnums=0)
-
-        def region(which, state):
-            ncalls = k if which == 0 else 3 * k
-            t0 = time.perf_counter()
-            for _ in range(ncalls):
-                state = stepc1(state)
-            _fence_state(state)
-            return time.perf_counter() - t0, state
-
-    _, state = region(0, state)          # compile + warm both variants
-    _, state = region(1, state)
-    _fence_state(state)
-    samples = []
-    for _ in range(reps):
-        ta, state = region(0, state)
-        tb, state = region(1, state)
-        samples.append((tb - ta) / (2 * k))
-    return sorted(samples)[len(samples) // 2] * 1e3
 
 
 def main():
@@ -95,6 +53,17 @@ def main():
     from paddle_tpu.optim.optimizers import apply_updates
 
     quick = "--quick" in sys.argv
+    # --only fwd,att,ref,gemm,grad,full,dh128 runs a subset (crash recovery:
+    # the remote tunnel can RESOURCE_EXHAUST mid-script; rerun the rest in a
+    # fresh process)
+    only = None
+    for a in sys.argv[1:]:
+        if a.startswith("--only="):
+            only = set(a.split("=", 1)[1].split(","))
+
+    def want(sec):
+        return only is None or sec in only
+
     out = {"config": f"d{D} L{L} bs{B} seq{T} bf16"}
     rng = np.random.RandomState(0)
 
@@ -119,7 +88,7 @@ def main():
         # fine. Each section re-inits its own (donated) copy.
 
         # -- forward only ----------------------------------------------------
-        def fwd_body(s):
+        def fwd_body(s):  # noqa: E306
             # folding 1e-20*loss into the params keeps them loop-variant
             # (no cross-call caching games) at far-below-bf16 resolution
             p, acc = s
@@ -128,10 +97,11 @@ def main():
                 lambda a: a + (l * 1e-20).astype(a.dtype), p)
             return (p2, acc + l)
 
-        out["fwd_only_ms"] = round(
-            diff_time(fwd_body, (params, jnp.zeros((), jnp.float32)),
-                      k=4), 1)
-        print("partial:", json.dumps(out), file=sys.stderr, flush=True)
+        if want("fwd"):
+            out["fwd_only_ms"] = round(
+                diff_time(fwd_body, (params, jnp.zeros((), jnp.float32)),
+                          k=4), 1)
+            print("partial:", json.dumps(out), file=sys.stderr, flush=True)
 
         # -- attention isolated ---------------------------------------------
         q_host = rng.normal(size=(B, H, T, D // H))
@@ -155,19 +125,55 @@ def main():
                 return (qq + 1e-6 * o, acc + jnp.sum(o.astype(jnp.float32)))
             return body
 
-        grid = [(128, 128)] if quick else [(128, 128), (256, 256),
-                                           (512, 512), (256, 1024),
-                                           (512, 1024), (1024, 1024)]
-        att = {}
-        for bq, bk in grid:
-            att[f"fwd_bq{bq}_bk{bk}"] = round(
-                diff_time(att_cfg(bq, bk, False), fresh_q(), k=30,
-                          use_fori=True), 2)
-            att[f"fwdbwd_bq{bq}_bk{bk}"] = round(
-                diff_time(att_cfg(bq, bk, True), fresh_q(), k=30,
-                          use_fori=True), 2)
-        out["attention_per_layer_ms"] = att
-        print("partial:", json.dumps(out), file=sys.stderr, flush=True)
+        if want("att"):
+            grid = [(128, 128)] if quick else [(128, 128), (256, 256),
+                                               (512, 512), (256, 1024),
+                                               (512, 1024), (1024, 1024)]
+            att = {}
+            for bq, bk in grid:
+                att[f"fwd_bq{bq}_bk{bk}"] = round(
+                    diff_time(att_cfg(bq, bk, False), fresh_q(), k=30,
+                              use_fori=True), 2)
+                att[f"fwdbwd_bq{bq}_bk{bk}"] = round(
+                    diff_time(att_cfg(bq, bk, True), fresh_q(), k=30,
+                              use_fori=True), 2)
+            out["attention_per_layer_ms"] = att
+            print("partial:", json.dumps(out), file=sys.stderr, flush=True)
+
+        # -- dh=128 head-geometry probe (same total D = H*dh, same FLOPs):
+        # at dh=64 both attention matmuls run half-width MXU tiles
+        # (contraction / output dim 64 vs the 128x128 array) --------------
+        if want("dh128") and D // H == 64:
+            # only meaningful from the dh=64 geometry (PROF_HEADS=16 at
+            # d1024); from the dh=128 default it would probe dh=256
+            q128 = rng.normal(size=(B, H // 2, T, 2 * (D // H)))
+            dh = {}
+            for bq, bk in [(512, 1024), (1024, 1024)]:
+                def cfg(with_bwd, bq=bq, bk=bk):
+                    def body(s):
+                        qq, acc = s
+                        if with_bwd:
+                            def f(qq):
+                                o = flash_attention(qq, qq, qq, causal=True,
+                                                    block_q=bq, block_k=bk)
+                                return jnp.sum(o.astype(jnp.float32) ** 2)
+                            l, dq = jax.value_and_grad(f)(qq)
+                            return (qq + 1e-6 * dq.astype(qq.dtype), acc + l)
+                        o = flash_attention(qq, qq, qq, causal=True,
+                                            block_q=bq, block_k=bk)
+                        return (qq + 1e-6 * o,
+                                acc + jnp.sum(o.astype(jnp.float32)))
+                    return body
+                st = (jnp.asarray(q128, jnp.bfloat16),
+                      jnp.zeros((), jnp.float32))
+                dh[f"fwd_bq{bq}_bk{bk}"] = round(
+                    diff_time(cfg(False), st, k=30, use_fori=True), 2)
+                st = (jnp.asarray(q128, jnp.bfloat16),
+                      jnp.zeros((), jnp.float32))
+                dh[f"fwdbwd_bq{bq}_bk{bk}"] = round(
+                    diff_time(cfg(True), st, k=30, use_fori=True), 2)
+            out["attention_dh128_per_layer_ms"] = dh
+            print("partial:", json.dumps(out), file=sys.stderr, flush=True)
 
         # dense reference attention (materialises [T,T]) for context
         def ref_body(s):
@@ -177,7 +183,7 @@ def main():
                 qq.astype(jnp.float32), causal=True)
             return (qq + 1e-6 * o.astype(qq.dtype),
                     acc + jnp.sum(o))
-        if not quick:
+        if not quick and want("ref"):
             out["attention_ref_fwd_ms"] = round(
                 diff_time(ref_body, fresh_q(), k=6,
                           use_fori=True), 2)
@@ -203,15 +209,16 @@ def main():
             return (x + 1e-6 * h, acc + jnp.sum(lg.astype(jnp.float32)),
                     wq, wo, w1, w2, wh)
 
-        out["gemm_fwd_floor_ms"] = round(
-            diff_time(gemm_body,
-                      (x2, jnp.zeros((), jnp.float32), wq, wo, w1, w2, wh),
-                      k=10, use_fori=True), 1)
+        if want("gemm"):
+            out["gemm_fwd_floor_ms"] = round(
+                diff_time(gemm_body,
+                          (x2, jnp.zeros((), jnp.float32), wq, wo, w1, w2,
+                           wh),
+                          k=10, use_fori=True), 1)
+            print("partial:", json.dumps(out), file=sys.stderr, flush=True)
 
         # -- grad only (fresh params, donated; SGD-like fold keeps every
         # grad leaf live) -----------------------------------------------------
-        params = model.init(jax.random.PRNGKey(0), inp)["params"]
-
         def grad_body(s):
             p, acc = s
             l, g = jax.value_and_grad(loss_of)(p)
@@ -219,27 +226,32 @@ def main():
                 lambda a, b: a - 1e-12 * b.astype(a.dtype), p, g)
             return (p2, acc + l)
 
-        out["grad_only_ms"] = round(
-            diff_time(grad_body, (params, jnp.zeros((), jnp.float32)),
-                      k=4), 1)
+        if want("grad"):
+            params = model.init(jax.random.PRNGKey(0), inp)["params"]
+            out["grad_only_ms"] = round(
+                diff_time(grad_body, (params, jnp.zeros((), jnp.float32)),
+                          k=4), 1)
+            print("partial:", json.dumps(out), file=sys.stderr, flush=True)
 
         # -- full step (params were donated above: fresh init) ---------------
-        params = model.init(jax.random.PRNGKey(0), inp)["params"]
-        opt_state = opt.init(params)
-
         def full_body(s):
             p, o, i, _ = s
             l, g = jax.value_and_grad(loss_of)(p)
             u, o2 = opt.update(g, o, p, i)
             return (apply_updates(p, u), o2, i + 1, l)
 
-        st = (params, opt_state, jnp.zeros((), jnp.int32),
-              jnp.zeros((), jnp.float32))
-        out["full_step_ms"] = round(diff_time(full_body, st, k=4), 1)
+        if want("full"):
+            params = model.init(jax.random.PRNGKey(0), inp)["params"]
+            opt_state = opt.init(params)
+            st = (params, opt_state, jnp.zeros((), jnp.int32),
+                  jnp.zeros((), jnp.float32))
+            out["full_step_ms"] = round(diff_time(full_body, st, k=4), 1)
 
-        flops = 29.53e12
-        out["mfu_from_full_step"] = round(
-            100 * flops / (out["full_step_ms"] / 1e3) / PEAK, 1)
+            import bench
+            flops = bench.transformer_train_flops(B, T, D, L, V, FFN)
+            out["flops_per_step"] = flops
+            out["mfu_from_full_step"] = round(
+                100 * flops / (out["full_step_ms"] / 1e3) / PEAK, 1)
     print(json.dumps(out, indent=1))
 
 

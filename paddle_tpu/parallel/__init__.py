@@ -22,13 +22,16 @@ from .ulysses import ulysses_attention, make_ulysses_attention
 from .multihost import (initialize, is_initialized,
                         host_sharded_reader, multihost_mesh)
 from .pipeline import (pipeline_apply, make_pipeline,
+                       pipeline_loss_apply, make_pipeline_loss,
                        pipeline_grads_1f1b, make_pipeline_1f1b)
+from .megatron import megatron_sp_rules, make_megatron_sp_lm_apply
 
 __all__ = [
     "ShardingRules", "spec_tree", "named_shardings", "shard_tree",
     "sharded_init", "ring_attention", "make_ring_attention",
     "ulysses_attention", "make_ulysses_attention", "initialize",
     "pipeline_apply", "make_pipeline", "pipeline_grads_1f1b",
-    "make_pipeline_1f1b",
+    "make_pipeline_1f1b", "pipeline_loss_apply", "make_pipeline_loss",
+    "megatron_sp_rules", "make_megatron_sp_lm_apply",
     "is_initialized", "host_sharded_reader", "multihost_mesh",
 ]

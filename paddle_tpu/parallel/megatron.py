@@ -1,0 +1,236 @@
+"""Explicit Megatron-style tensor parallelism with SEQUENCE-PARALLEL
+residuals for :class:`~paddle_tpu.models.transformer.TransformerLM`.
+
+Two ways to run Megatron tp in this framework:
+
+1. **Compiler-chosen** (``ShardingRules`` + pjit): shard the qkv/ffn
+   weights column/row-wise and let XLA's SPMD partitioner insert the
+   activation syncs. Simple, but the partitioner keeps the residual
+   stream replicated and pays a full all-reduce per sublayer — 2B wire
+   bytes each — and constraining the residuals seq-sharded
+   (``TransformerLM(residual_sharding=...)``) does not reliably lower to
+   reduce-scatter (measured on the CPU backend: the reshard splits into
+   all-reduce + all-gather, WORSE than plain tp — 3.1 vs 2.0 GB/device
+   per d512 step, experiments/scaling_projection.py r5 notes).
+
+2. **Explicit** (this module): shard_map the whole LM and write the
+   Megatron-SP collectives by hand — ``all_gather`` the LayerNorm'd
+   seq-shard into each sublayer, ``psum_scatter`` the row-parallel
+   partial sums back to seq-shards. The AG+RS pair moves the same wire
+   bytes as the all-reduce it replaces (AR == RS+AG); the win is that the
+   residual stream, LayerNorms, embeddings, and their gradients compute
+   and LIVE on T/tp rows per device — activation memory and the
+   unshardable-under-pjit elementwise work drop by the tp factor,
+   which is what unlocks long sequences at large tp. This is the public
+   Megatron-LM sequence-parallel recipe (Korthikanti et al. 2022)
+   realized with XLA collectives; the transpose rules
+   (all_gather <-> psum_scatter) make the backward the mirrored recipe
+   automatically.
+
+The function consumes a STANDARD ``TransformerLM`` variables tree (same
+names, same math — the oracle test pins logits and grads against the
+unsharded model) so checkpoints move freely between the pjit and explicit
+paths. Requires dense FFN blocks (no MoE — expert parallelism is its own
+axis, ``nn/moe.py``), no dropout, and ``num_heads % tp == 0``,
+``T % tp == 0``, ``ffn_hidden % tp == 0``.
+
+Reference lineage: the 2017 reference's model-parallel story is per-layer
+device placement (``ParallelNeuralNetwork.h:36``); intra-layer tensor
+parallelism postdates it — this is an "exceeds" item on the same axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .sharding import ShardingRules
+
+__all__ = ["megatron_sp_rules", "make_megatron_sp_lm_apply"]
+
+
+def megatron_sp_rules() -> ShardingRules:
+    """The param-tree layout both tp paths share: qkv/ffn1 column-parallel,
+    wo/ffn2 row-parallel, everything else (LN, embeddings, biases of
+    row-parallel layers) replicated."""
+    return ShardingRules([
+        ("*/attn/wq", P(None, "model")), ("*/attn/wk", P(None, "model")),
+        ("*/attn/wv", P(None, "model")), ("*/attn/wo", P("model", None)),
+        ("*/ffn1/w", P(None, "model")), ("*/ffn1/b", P("model")),
+        ("*/ffn2/w", P("model", None)),
+    ])
+
+
+def _layernorm(x, p, eps=1e-6):
+    """Mirror of nn.layers.LayerNorm.forward (f32 stats, cast back)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(dtype)
+
+
+def make_megatron_sp_lm_apply(model, mesh: Mesh, data_axis: str = "data",
+                              model_axis: str = "model",
+                              use_flash: bool = False,
+                              with_loss: bool = False,
+                              comm_dtype=None):
+    """Build ``apply_fn(variables, ids) -> logits`` running ``model`` (a
+    dense ``TransformerLM``) as explicit tp+sp over ``mesh``.
+
+    ``variables`` is the standard tree, its leaves laid out per
+    :func:`megatron_sp_rules` (use ``parallel.shard_tree``); ``ids`` is the
+    global [B, T] batch sharded ``P(data_axis, None)``. Returns global
+    logits [B, T, vocab] in seq-sharded layout ``P(data, model, None)``.
+
+    ``with_loss=True`` returns ``loss_fn(variables, ids, targets) ->
+    scalar`` computing the mean next-token cross-entropy INSIDE the
+    shard_map (per-shard sums + psum). Use this form for training: it
+    keeps every [*, vocab] tensor seq-sharded — emitting global logits
+    from the shard_map makes XLA assemble them with a [B, T, vocab]
+    all-gather, which at d512/V32k is 2.1 GB/step of pure waste
+    (measured, experiments/scaling_projection.py r5).
+
+    ``comm_dtype`` (e.g. ``jnp.bfloat16``) casts the tensors crossing the
+    AG/RS collectives, halving tp activation wire vs the f32 the policy's
+    accumulate-in-f32 Linears otherwise put on it — the standard Megatron
+    practice (activations are bf16-precision products anyway; local math
+    stays in the original dtype). Default ``None`` = exact."""
+    try:
+        from jax import shard_map
+    except ImportError:                      # older jax
+        from jax.experimental.shard_map import shard_map
+
+    from ..nn import activations
+    gelu = activations.get("gelu")
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp = sizes[model_axis]
+    H = model.blocks[0].attn.num_heads
+    D = model.emb.dim
+    hd = D // H
+    L = len(model.blocks)
+    assert H % tp == 0, f"num_heads {H} must divide by tp {tp}"
+    assert model.blocks[0].moe_experts == 0, "MoE blocks: use the ep axis"
+    root_name = model._name
+    scale = 1.0 / float(hd) ** 0.5
+
+    def _ag(z):
+        """Sequence all-gather, optionally compressing the wire dtype.
+        The optimization_barrier pins the downcast to the operand side —
+        XLA's simplifier otherwise reorders convert across the collective
+        and cancels the pair, silently restoring f32 wire (observed on
+        the CPU backend)."""
+        if comm_dtype is None:
+            return lax.all_gather(z, model_axis, axis=1, tiled=True)
+        zb = lax.optimization_barrier(z.astype(comm_dtype))
+        return lax.all_gather(zb, model_axis, axis=1,
+                              tiled=True).astype(z.dtype)
+
+    def _rs(part):
+        """Sequence reduce-scatter of row-parallel partial sums."""
+        if comm_dtype is None:
+            return lax.psum_scatter(part, model_axis,
+                                    scatter_dimension=1, tiled=True)
+        pb = lax.optimization_barrier(part.astype(comm_dtype))
+        return lax.psum_scatter(pb, model_axis, scatter_dimension=1,
+                                tiled=True).astype(part.dtype)
+
+    def _attend_local(q, k, v):
+        """Causal self-attention on this device's head group; q/k/v
+        [B, T, h_local, hd]."""
+        if use_flash:
+            from ..nn.pallas_attention import flash_attention
+            ctx = flash_attention(jnp.moveaxis(q, 2, 1),
+                                  jnp.moveaxis(k, 2, 1),
+                                  jnp.moveaxis(v, 2, 1), None, True)
+            return jnp.moveaxis(ctx, 1, 2)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        logits = logits.astype(jnp.float32)
+        T = q.shape[1]
+        cm = jnp.tril(jnp.ones((T, T), bool))
+        logits = jnp.where(cm[None, None], logits, -1e9)
+        w = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+    def _forward_local(params, ids):
+        """Per-device body. ``params``: this device's shards (column/row
+        slices per megatron_sp_rules); ``ids``: [B_local, T] (full seq).
+        Returns this device's seq-shard of the logits [B_l, T/tp, V]."""
+        root = params[root_name]
+        midx = lax.axis_index(model_axis)
+        T = ids.shape[1]
+        assert T % tp == 0, f"seq len {T} must divide by tp {tp}"
+        Tl = T // tp
+        # ---- embed: each device embeds only ITS seq slice (sp) ----------
+        sl = lax.dynamic_slice_in_dim(ids, midx * Tl, Tl, axis=1)
+        emb_w = root["emb"]["w"]
+        pos_w = root["pos"]["w"]
+        valid = (sl >= 0) & (sl < emb_w.shape[0])    # Embedding.forward's
+        x = jnp.take(emb_w, jnp.clip(sl, 0, emb_w.shape[0] - 1), axis=0)
+        x = x * valid[..., None].astype(x.dtype)     # zero-for-padding rule
+        x = x + jnp.take(pos_w, jnp.arange(Tl) + midx * Tl, axis=0)[None]
+        compute_dtype = root["block0"]["attn"]["wq"].dtype
+        x = x.astype(compute_dtype)
+        # ---- blocks ------------------------------------------------------
+        for i in range(L):
+            bp = root[f"block{i}"]
+            # attention sublayer: AG(seq) -> column qkv -> row wo -> RS(seq)
+            z = _layernorm(x, bp["ln1"])
+            zg = _ag(z)
+            hl = H // tp
+            q = (zg @ bp["attn"]["wq"]).reshape(*zg.shape[:2], hl, hd)
+            k = (zg @ bp["attn"]["wk"]).reshape(*zg.shape[:2], hl, hd)
+            v = (zg @ bp["attn"]["wv"]).reshape(*zg.shape[:2], hl, hd)
+            ctx = _attend_local(q, k, v).reshape(*zg.shape[:2], hl * hd)
+            part = ctx @ bp["attn"]["wo"]          # partial over model
+            x = x + _rs(part)
+            # FFN sublayer: AG(seq) -> column ffn1 -> row ffn2 -> RS(seq)
+            z = _layernorm(x, bp["ln2"])
+            zg = _ag(z)
+            h1 = gelu(zg @ bp["ffn1"]["w"] + bp["ffn1"]["b"])
+            part = h1 @ bp["ffn2"]["w"]
+            x = x + _rs(part) + bp["ffn2"]["b"]
+        # ---- head: final LN + tied readout on the local seq rows --------
+        z = _layernorm(x, root["ln_f"])
+        return z @ emb_w.T.astype(z.dtype)
+
+    rules = megatron_sp_rules()
+
+    if with_loss:
+        def loss_kernel(params, ids, targets):
+            lg = _forward_local(params, ids)             # [B_l, Tl, V]
+            midx = lax.axis_index(model_axis)
+            Tl = lg.shape[1]
+            tl = lax.dynamic_slice_in_dim(targets, midx * Tl, Tl, axis=1)
+            lg32 = lg.astype(jnp.float32)
+            lse = jax.nn.logsumexp(lg32, axis=-1)
+            picked = jnp.take_along_axis(lg32, tl[..., None],
+                                         axis=-1)[..., 0]
+            local_sum = jnp.sum(lse - picked)
+            local_cnt = jnp.asarray(tl.size, jnp.float32)
+            total = lax.psum(local_sum, (data_axis, model_axis))
+            cnt = lax.psum(local_cnt, (data_axis, model_axis))
+            return total / cnt
+
+        def loss_fn(variables, ids, targets):
+            params = variables["params"]
+            in_specs = (rules(params), P(data_axis, None),
+                        P(data_axis, None))
+            fn = shard_map(loss_kernel, mesh=mesh, in_specs=in_specs,
+                           out_specs=P())
+            return fn(params, ids, targets)
+
+        return loss_fn
+
+    def apply_fn(variables, ids):
+        params = variables["params"]
+        in_specs = (rules(params), P(data_axis, None))
+        fn = shard_map(_forward_local, mesh=mesh, in_specs=in_specs,
+                       out_specs=P(data_axis, model_axis, None))
+        return fn(params, ids)
+
+    return apply_fn

@@ -23,23 +23,24 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_apply", "make_pipeline", "pipeline_grads_1f1b",
+__all__ = ["pipeline_apply", "make_pipeline", "pipeline_loss_apply",
+           "make_pipeline_loss", "pipeline_grads_1f1b",
            "make_pipeline_1f1b"]
 
 
-def pipeline_apply(stage_fn: Callable, stage_params, x, axis_name: str,
-                   axis_size: int):
-    """Run the S-stage pipeline — call INSIDE shard_map.
-
-    ``stage_params``: THIS device's stage parameters (the [S, ...] stack
-    sharded over ``axis_name``, leading axis squeezed). ``x``: the full
-    microbatch stack [M, mb, ...], replicated (only stage 0 reads it).
-    Returns the final outputs [M, mb, ...] (replicated via a psum
-    broadcast from the last stage).
-    """
+def _wavefront(stage_fn: Callable, stage_params, x, axis_name: str,
+               axis_size: int, comm_dtype=None):
+    """The shared GPipe M + S - 1-tick wavefront — call INSIDE shard_map.
+    Runs microbatches through the stage ring (ppermute hops) and returns
+    the last stage's [M, ...] output buffer (meaningful ONLY on stage
+    S-1; other devices hold zeros/garbage). ``comm_dtype`` (e.g. bf16)
+    compresses the hop wire; local compute and the output buffer keep the
+    stage dtype."""
     S = axis_size
     stage = lax.axis_index(axis_name)
     M = x.shape[0]
+    if comm_dtype is not None:
+        x = x.astype(comm_dtype)
 
     def body(t, carry):
         act, outbuf = carry
@@ -48,8 +49,12 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, axis_name: str,
                            jnp.zeros_like(x[0]))
         act_in = jnp.where(stage == 0, inject, act)
         y = stage_fn(stage_params, act_in)
-        # hop to the next stage around the ring
-        act_next = lax.ppermute(y, axis_name,
+        # hop to the next stage around the ring; optimization_barrier
+        # pins the downcast to the send side (XLA otherwise reorders
+        # convert across the collective and cancels it)
+        send = y if comm_dtype is None \
+            else lax.optimization_barrier(y.astype(comm_dtype))
+        act_next = lax.ppermute(send, axis_name,
                                 [(i, (i + 1) % S) for i in range(S)])
         # the last stage finishes microbatch m = t - (S - 1)
         m = t - (S - 1)
@@ -64,11 +69,28 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, axis_name: str,
     # would trip shard_map's carry check (same trick as ring.py's
     # accumulators).
     y0 = stage_fn(stage_params, x[0])      # shape probe for buffers
-    act0 = y0 * 0.0
+    act0 = y0 * 0.0 if comm_dtype is None else (y0 * 0.0).astype(comm_dtype)
     outbuf0 = jnp.broadcast_to((y0 * 0.0)[None], (M,) + y0.shape)
     _, outbuf = lax.fori_loop(0, M + S - 1, body, (act0, outbuf0))
+    return outbuf
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, axis_name: str,
+                   axis_size: int):
+    """Run the S-stage pipeline — call INSIDE shard_map.
+
+    ``stage_params``: THIS device's stage parameters (the [S, ...] stack
+    sharded over ``axis_name``, leading axis squeezed). ``x``: the full
+    microbatch stack [M, mb, ...], replicated (only stage 0 reads it).
+    Returns the final outputs [M, mb, ...] (replicated via a psum
+    broadcast from the last stage — a full-tensor sync; for TRAINING use
+    :func:`pipeline_loss_apply`, which closes the loss on the last stage
+    and syncs only a scalar).
+    """
+    outbuf = _wavefront(stage_fn, stage_params, x, axis_name, axis_size)
+    stage = lax.axis_index(axis_name)
     # broadcast the last stage's buffer to every device
-    mask = (stage == S - 1).astype(outbuf.dtype)
+    mask = (stage == axis_size - 1).astype(outbuf.dtype)
     return lax.psum(outbuf * mask, axis_name)
 
 
@@ -99,6 +121,72 @@ def make_pipeline(mesh: Mesh, stage_fn: Callable, pipe_axis: str = "pipe"):
     return shard_map(
         inner, mesh=mesh,
         in_specs=(P(pipe_axis), P()),
+        out_specs=P())
+
+
+def pipeline_loss_apply(stage_fn: Callable, stage_params, x,
+                        final_fn: Callable, final_params, extras,
+                        axis_name: str, axis_size: int, reduce_axes=(),
+                        comm_dtype=None):
+    """GPipe wavefront + ON-LAST-STAGE loss — call INSIDE shard_map.
+
+    Same wavefront as :func:`pipeline_apply`, but instead of broadcasting
+    the completed [M, mb, ...] output stack to every stage (a full-tensor
+    ``psum`` over the pipe axis — measured 1.07 GB/step of wire for a
+    d1024 LM, experiments/scaling_projection.py r5), the loss closes on
+    the last stage: ``final_fn(final_params, outbuf, *extras)`` maps the
+    stack to a scalar, non-last stages contribute zero, and only the
+    SCALAR crosses the wire. Every device traces ``final_fn`` (bubble
+    devices run it on zeros — wasted FLOPs that overlap the bubble, no
+    wire); grads for ``stage_params``, ``final_params``, and ``x`` all
+    flow (the masked psum routes the cotangent to the last stage)."""
+    outbuf = _wavefront(stage_fn, stage_params, x, axis_name, axis_size,
+                        comm_dtype=comm_dtype)
+    stage = lax.axis_index(axis_name)
+    S = axis_size
+    val = final_fn(final_params, outbuf, *extras)
+    mask = (stage == S - 1).astype(val.dtype)
+    # reduce_axes: batch-sharding axes of x/extras (dp x pp) whose partial
+    # losses must also sum into the global scalar
+    return lax.psum(val * mask, (axis_name,) + tuple(reduce_axes))
+
+
+def make_pipeline_loss(mesh: Mesh, stage_fn: Callable, final_fn: Callable,
+                       pipe_axis: str = "pipe", x_spec: P = P(),
+                       extra_specs=(), reduce_axes=(), comm_dtype=None):
+    """Wrap :func:`pipeline_loss_apply` in shard_map over ``mesh``.
+
+    Returns ``fn(stage_params, final_params, x, *extras) -> scalar``.
+    ``stage_params``: [S, ...] stacks sharded over ``pipe_axis``;
+    ``final_params``: replicated pytree consumed by the last-stage loss
+    (head weights, tied embeddings — its grads psum over the mesh);
+    ``x``: the [M, mb, ...] microbatch stack (``x_spec`` may shard mb over
+    a data axis for dp x pp — name that axis in ``reduce_axes`` so the
+    per-group partial losses sum into the global scalar); ``extras``:
+    per-microbatch aux arrays (targets, masks) with specs
+    ``extra_specs``."""
+    try:
+        from jax import shard_map
+    except ImportError:            # older jax
+        from jax.experimental.shard_map import shard_map
+
+    S = dict(zip(mesh.axis_names, mesh.devices.shape))[pipe_axis]
+
+    def inner(stage_params, final_params, x, *extras):
+        def squeeze(a):
+            assert a.shape[0] == 1, (
+                f"stage stack must have exactly {S} stages (the pipe-axis "
+                f"size); got a shard of {a.shape[0]} stages per device")
+            return a[0]
+        squeezed = jax.tree_util.tree_map(squeeze, stage_params)
+        return pipeline_loss_apply(stage_fn, squeezed, x, final_fn,
+                                   final_params, extras, pipe_axis, S,
+                                   reduce_axes=reduce_axes,
+                                   comm_dtype=comm_dtype)
+
+    return shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(pipe_axis), P(), x_spec) + tuple(extra_specs),
         out_specs=P())
 
 
