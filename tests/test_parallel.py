@@ -683,3 +683,30 @@ def test_pipeline_loss_bf16_comm_close_to_exact(nprng):
     le = jax.jit(exact)({"w": w}, {"wh": wh}, x, y)
     lc = jax.jit(comp)({"w": w}, {"wh": wh}, x, y)
     np.testing.assert_allclose(float(lc), float(le), rtol=3e-2)
+
+
+def test_megatron_sp_flash_matches_unsharded_lm(nprng, rng):
+    """The megatron-SP kernel's use_flash=True path (per-device Pallas
+    flash attention on the local head group, interpreter mode off-TPU)
+    must match the unsharded model like the einsum path does."""
+    from jax.sharding import NamedSharding
+
+    from paddle_tpu.models import TransformerLM
+
+    mesh = pt.make_mesh({"data": 2, "model": 4})
+    V, D, T, B, H = 64, 32, 16, 4, 4
+    model = TransformerLM(vocab=V, dim=D, num_layers=2, num_heads=H,
+                          ffn_hidden=64, max_len=T)
+    ids = jnp.asarray(nprng.randint(0, V, (B, T)), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    ref = model.apply(variables, ids)
+
+    params = parallel.shard_tree(mesh, variables["params"],
+                                 parallel.megatron_sp_rules()(
+                                     variables["params"]))
+    inp = jax.device_put(ids, NamedSharding(mesh, P("data", None)))
+    apply_fn = parallel.make_megatron_sp_lm_apply(model, mesh,
+                                                  use_flash=True)
+    got = jax.jit(lambda p, i: apply_fn({"params": p}, i))(params, inp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
