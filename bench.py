@@ -360,6 +360,67 @@ def prep_transformer_big(batch_size=16, seq_len=2048, dim=1024, layers=8,
                             layers=layers, heads=heads, vocab=vocab)
 
 
+def prep_transformer_fused(batch_size=8, seq_len=2048, dim=512, layers=6,
+                           heads=4, vocab=32000, k_steps=8):
+    """Trainer-level fused dispatch (steps_per_call=K): ONE device call runs
+    K optimizer steps as a donated lax.scan over K stacked batches. Against
+    the same-shape `transformer` metric this is the fused-vs-plain
+    per-step differential — it isolates the multi-step dispatch
+    amortisation (the ~5 ms/call tunnel constant, experiments/PERF.md
+    exp 2) from the compute, through the REAL Trainer pipeline rather than
+    the harness's own fori_loop."""
+    from paddle_tpu import optim
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.nn import costs
+    from paddle_tpu.train import Trainer
+
+    ffn = 4 * dim
+    model = TransformerLM(vocab=vocab, dim=dim, num_layers=layers,
+                          num_heads=heads, ffn_hidden=ffn,
+                          max_len=seq_len, use_flash=True)
+    # identical conflicting-pair task to prep_transformer (same floor)
+    rng = np.random.RandomState(0)
+    half = batch_size // 2
+    inp_u = rng.randint(0, vocab, (half, seq_len))
+    inp = np.concatenate([inp_u, inp_u], axis=0).astype(np.int32)
+    tgt_np = rng.randint(0, vocab, (batch_size, seq_len)).astype(np.int32)
+    conflict_frac = float(np.mean(tgt_np[:half] != tgt_np[half:]))
+    host_batch = {"x": inp, "y": tgt_np}
+
+    trainer = Trainer(
+        model=model,
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(
+            out.reshape(-1, vocab), b["y"].reshape(-1)),
+        optimizer=optim.adam(1e-4), steps_per_call=k_steps)
+    trainer.init(jax.random.PRNGKey(0), host_batch)
+    fused_step, batches = trainer.compile_fused([host_batch] * k_steps)
+    key = jax.random.PRNGKey(1)
+    ts = trainer.train_state
+    state0 = (ts.params, ts.state, ts.opt_state, ts.step,
+              jnp.zeros((), jnp.float32))
+
+    def step_body(s):
+        params, st, opt_state, stepno, _ = s
+        params, st, opt_state, stepno, losses, _ = fused_step(
+            params, st, opt_state, stepno, batches, key)
+        return (params, st, opt_state, stepno, losses[-1])
+
+    meta = {
+        "metric": f"transformer_lm_fused_k{k_steps}_train_tokens_per_sec",
+        "unit": "tokens/sec",
+        # one step_body call = k_steps real optimizer steps
+        "units_per_step": k_steps * batch_size * seq_len,
+        "flops_per_step": k_steps * transformer_train_flops(
+            batch_size, seq_len, dim, layers, vocab, ffn),
+        "seq_len": seq_len, "dim": dim, "layers": layers,
+        "batch_size": batch_size, "k_steps": k_steps,
+        "n_devices": int(trainer.mesh.devices.size),
+        "baseline": None, "baseline_kind": "higher",
+        "loss_floor": round(conflict_frac * math.log(2.0), 4),
+    }
+    return step_body, state0, meta
+
+
 def prep_seq2seq(batch_size=64, src_len=30, tgt_len=30, vocab=30000,
                  hidden=512):
     """Attention seq2seq training tokens/s. The reference never published a
@@ -447,6 +508,7 @@ PREPS = {
     "seq2seq": prep_seq2seq,
     "transformer": prep_transformer,
     "transformer_big": prep_transformer_big,
+    "transformer_fused": prep_transformer_fused,
 }
 
 # per-metric timed-step counts (N; the pair is N and 3N) and inner-loop k.
@@ -463,6 +525,9 @@ PLANS = {
     "seq2seq":         dict(n=300, k=10, budget=1800),
     "transformer":     dict(n=60,  k=2,  budget=2400),
     "transformer_big": dict(n=30,  k=1,  budget=2400),
+    # one step_body call = 8 fused optimizer steps; k stays 1 (the fusion
+    # under test is the Trainer's, not the harness fori_loop's)
+    "transformer_fused": dict(n=8, k=1, budget=2400),
 }
 
 
@@ -616,6 +681,87 @@ def bench_differential(name, n=None, k=None, budget=None):
         if key in meta:
             out[key] = meta[key]
     return out
+
+
+# ---------------------------------------------------------------------------
+# CPU smoke gate: fused-vs-plain differential (ISSUE 1; runs in CI tier-1)
+# ---------------------------------------------------------------------------
+
+def run_smoke(K=4, M=2, timing_passes=3):
+    """Tiny-model fused-vs-plain gate, CPU-sized for CI: train the SAME
+    batch stream through ``Trainer(steps_per_call=K, grad_accum=M)`` (one
+    dispatch per K steps, with the remat scan-over-layers block stack) and
+    through the unfused ``Trainer(grad_accum=M)`` (one dispatch per step),
+    assert bit-identical f32 params and per-step losses, then time both hot
+    loops post-compile and print ONE JSON line with the per-optimizer-step
+    differential. Non-equal params exit non-zero — the fused path cannot
+    silently rot."""
+    import jax.numpy as jnp   # noqa: F811 (module-level import is fine too)
+    from paddle_tpu import optim
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.nn import costs
+    from paddle_tpu.train import Trainer, events as ev
+
+    V, T, bs, n_batches = 64, 16, 8, K * M * 2
+    rng = np.random.RandomState(0)
+    batches = [{"x": rng.randint(0, V, (bs, T)).astype(np.int32),
+                "y": rng.randint(0, V, (bs, T)).astype(np.int32)}
+               for _ in range(n_batches)]
+
+    def make(k_steps):
+        tr = Trainer(
+            model=TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
+                                ffn_hidden=64, max_len=T, remat="dots"),
+            loss_fn=lambda out, b: costs.softmax_cross_entropy(
+                out.reshape(-1, V), b["y"].reshape(-1)),
+            optimizer=optim.adam(1e-3), steps_per_call=k_steps,
+            grad_accum=M)
+        tr.init(jax.random.PRNGKey(0), batches[0])
+        return tr
+
+    def run(tr):
+        losses = []
+
+        def handler(e):
+            if isinstance(e, ev.EndIteration):
+                losses.append(e.cost)
+
+        tr.train(lambda: iter(batches), num_passes=1, event_handler=handler,
+                 log_period=0)
+        return losses
+
+    def timed(tr):
+        t0 = time.perf_counter()
+        for _ in range(timing_passes):
+            tr.train(lambda: iter(batches), num_passes=1, log_period=0)
+        steps = timing_passes * (n_batches // M)
+        return (time.perf_counter() - t0) / steps
+
+    tr_fused, tr_plain = make(K), make(1)
+    l_fused, l_plain = run(tr_fused), run(tr_plain)
+    eq_losses = l_fused == l_plain
+    eq_params = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(
+                tr_fused.train_state.params)),
+            jax.tree_util.tree_leaves(jax.device_get(
+                tr_plain.train_state.params))))
+    fused_ms = timed(tr_fused) * 1e3      # post-compile hot-loop timing
+    plain_ms = timed(tr_plain) * 1e3
+    out = {
+        "metric": "fused_vs_plain_smoke",
+        "equal": bool(eq_params and eq_losses),
+        "params_equal": bool(eq_params), "losses_equal": bool(eq_losses),
+        "K": K, "M": M, "opt_steps": len(l_fused),
+        "fused_ms_per_opt_step": round(fused_ms, 3),
+        "plain_ms_per_opt_step": round(plain_ms, 3),
+        "fused_vs_plain_speedup": round(plain_ms / fused_ms, 3),
+        "final_loss": round(l_fused[-1], 4) if l_fused else None,
+        "device": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out))
+    return 0 if out["equal"] else 1
 
 
 # ---------------------------------------------------------------------------
@@ -774,12 +920,12 @@ def bench_scaling(per_device_batch=32, iters=2, steps_per_call=4):
 # default plan: with one real chip it runs on the virtual-CPU mesh and its
 # CPU compiles cost ~20 min — run it explicitly (`--metric scaling`); the
 # committed artifacts are SCALING_r05.json (proxy + analytic projection).
-DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_big",
-                "lstm", "lstm_h256", "lstm_h1280"]
+DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_fused",
+                "transformer_big", "lstm", "lstm_h256", "lstm_h1280"]
 
 
 _KNOWN_FLAGS = ("--metric", "--child", "--probe", "--n", "--k",
-                "--timed-steps", "--steps-per-call")
+                "--timed-steps", "--steps-per-call", "--smoke")
 
 
 def main():
@@ -800,6 +946,22 @@ def main():
         print(json.dumps({"error": f"unknown flags {unknown}; "
                                    f"known: {list(_KNOWN_FLAGS)}"}))
         sys.exit(2)
+
+    if "--smoke" in args or flag("--smoke", cast=int):
+        # CPU mode: the gate must be deterministic and CI-runnable — on any
+        # other backend re-launch pinned to CPU (JAX_PLATFORMS must be set
+        # before jax initializes, hence the subprocess).
+        if jax.default_backend() != "cpu":
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            repo = os.path.dirname(os.path.abspath(__file__))
+            res = subprocess.run(
+                [sys.executable, os.path.join(repo, "bench.py"), "--smoke"],
+                cwd=repo, env=env, capture_output=True, text=True,
+                timeout=900)
+            sys.stdout.write(res.stdout.strip().splitlines()[-1] + "\n"
+                             if res.stdout.strip() else res.stderr[-500:])
+            sys.exit(res.returncode)
+        sys.exit(run_smoke())
 
     if flag("--probe", cast=int):
         run_probe_child()

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 
 from . import initializers as init_lib
 
-__all__ = ["Module", "Sequential", "current_rng", "no_params"]
+__all__ = ["Module", "Sequential", "current_rng", "no_params",
+           "is_initializing"]
 
 
 class ModuleError(Exception):
@@ -75,6 +76,15 @@ def _get_node(tree: dict, path: Sequence[str], create: bool) -> dict:
             node[p] = {}
         node = node[p]
     return node
+
+
+def is_initializing() -> bool:
+    """True while tracing under ``Module.init`` (parameter creation), False
+    under ``apply`` or outside any module frame. Lets a forward() choose a
+    trace-only fast path (e.g. the rematerialized scan-over-layers in
+    ``models/transformer.py``) that cannot create parameters itself."""
+    fr = getattr(_tls, "frame", None)
+    return fr is not None and fr.mode == "init"
 
 
 def current_rng(kind: str = "dropout") -> jax.Array:
@@ -195,6 +205,17 @@ class Module:
         if name not in node:
             raise ModuleError(f"missing state {'/'.join(fr.path + [name])}")
         return node[name]
+
+    def subtree(self, collection: str = "params"):
+        """This submodule's raw ``collection`` subtree — callable from the
+        PARENT's forward, without entering the submodule's scope. The scan/
+        remat paths use it to stack homogeneous sibling submodules' params
+        ([L, ...] leading layer axis) and re-apply one submodule over the
+        stack (``models/transformer.py``)."""
+        fr = _frame()
+        name = self._ensure_name(fr)
+        return _get_node(fr.variables.get(collection, {}),
+                         list(fr.path) + [name], create=False)
 
     def update_state(self, name: str, value: jax.Array) -> None:
         """Write a state variable. No-op outside init unless 'state' is mutable."""

@@ -11,15 +11,34 @@ trains under the standard Trainer.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from paddle_tpu.core import initializers as I
-from paddle_tpu.core.module import Module
+from paddle_tpu.core.module import Module, is_initializing
 from paddle_tpu.nn.attention import MultiHeadAttention
 from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm, Linear
 from paddle_tpu.nn.moe import MoEFFN
 
-__all__ = ["TransformerBlock", "TransformerLM"]
+__all__ = ["TransformerBlock", "TransformerLM", "remat_policy"]
+
+
+def remat_policy(name):
+    """Map a remat knob value to a ``jax.checkpoint`` policy.
+
+    - ``"dots"`` (or ``True``): save matmul outputs, rematerialize the
+      cheap elementwise/norm tail (``dots_saveable`` — the standard
+      transformer trade: activation memory drops to the dot products while
+      the backward recompute stays a small fraction of step FLOPs).
+    - ``"full"``: save nothing between layer boundaries — maximum memory
+      saving, one extra full forward in the backward.
+    """
+    if name in (True, "dots"):
+        return jax.checkpoint_policies.dots_saveable
+    if name == "full":
+        return None
+    raise ValueError(f"remat must be None, 'dots', or 'full'; got {name!r}")
 
 
 class TransformerBlock(Module):
@@ -87,11 +106,25 @@ class TransformerLM(Module):
                  max_len: int = 512, use_flash: bool = False,
                  moe_experts: int = 0, dropout: float = 0.0,
                  attention_impl=None, seq_mesh=None, seq_axis: str = "seq",
-                 batch_axis=None, residual_sharding=None,
+                 batch_axis=None, residual_sharding=None, remat=None,
                  name="transformer_lm"):
         super().__init__(name=name)
         self.max_len = max_len
         self.residual_sharding = residual_sharding
+        # remat: None (off), "dots"/True, or "full" — runs the block stack
+        # as ONE lax.scan over stacked per-layer params with jax.checkpoint
+        # around the body: layer-boundary activations are the only thing
+        # saved across the stack (policy-dependent within a layer), turning
+        # activation memory from O(L * T * D * blowup) into
+        # O(L boundaries + one layer's working set) — the standard
+        # scan-over-layers + rematerialization recipe. Requires homogeneous
+        # blocks and dropout == 0; the variables tree is UNCHANGED
+        # (per-block subtrees are stacked at trace time), so checkpoints
+        # move freely between remat and plain configs.
+        if remat is not None:
+            remat_policy(remat)          # validate eagerly
+        self.remat = remat
+        self.dropout_rate = dropout
         self.emb = Embedding(vocab, dim)
         self.pos = Embedding(max_len, dim,
                              w_init=I.normal(0.02), name="pos")
@@ -128,15 +161,45 @@ class TransformerLM(Module):
         x = self.emb(ids) + self.pos(pos)
         if self.residual_sharding is not None:
             x = self.residual_sharding(x)
-        aux_total = jnp.zeros((), jnp.float32)
-        for blk in self.blocks:
-            x, aux = blk(x, train=train, segments=segments)
-            aux_total = aux_total + aux
+        if self.remat is not None and not is_initializing():
+            # init must trace the plain loop so every block creates its
+            # params; apply takes the scanned/rematerialized stack.
+            x, aux_total = self._scan_blocks(x, train, segments)
+        else:
+            aux_total = jnp.zeros((), jnp.float32)
+            for blk in self.blocks:
+                x, aux = blk(x, train=train, segments=segments)
+                aux_total = aux_total + aux
         x = self.ln_f(x)
         logits = self.emb.attend(x)          # tied softmax weights
         if return_aux:
             return logits, aux_total
         return logits
+
+    def _scan_blocks(self, x, train, segments):
+        """The rematerialized stack: stack the (homogeneous) per-block param
+        subtrees onto a leading [L, ...] layer axis and run ONE
+        ``jax.checkpoint``-wrapped block as a ``lax.scan`` over it. Grads
+        flow back through the stack's transpose (unstack) onto the
+        per-block leaves, so the optimizer/checkpoint view of the params is
+        unchanged."""
+        assert not (train and self.dropout_rate > 0), \
+            "remat scan-over-layers requires dropout == 0 (rngs do not " \
+            "thread through the stacked block)"
+        block0 = self.blocks[0]
+        subs = [blk.subtree() for blk in self.blocks]
+        stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *subs)
+
+        def body(carry, bp):
+            h, aux = carry
+            y, a = block0.apply({"params": {block0._name: bp}}, h,
+                                train=train, segments=segments)
+            return (y, aux + a), None
+
+        body = jax.checkpoint(body, policy=remat_policy(self.remat))
+        (x, aux_total), _ = lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), stacked)
+        return x, aux_total
 
 
 def make_pipeline_lm_apply(model: "TransformerLM", mesh, microbatches: int,

@@ -77,7 +77,7 @@ def make_megatron_sp_lm_apply(model, mesh: Mesh, data_axis: str = "data",
                               model_axis: str = "model",
                               use_flash: bool = False,
                               with_loss: bool = False,
-                              comm_dtype=None):
+                              comm_dtype=None, remat=None):
     """Build ``apply_fn(variables, ids) -> logits`` running ``model`` (a
     dense ``TransformerLM``) as explicit tp+sp over ``mesh``.
 
@@ -98,7 +98,21 @@ def make_megatron_sp_lm_apply(model, mesh: Mesh, data_axis: str = "data",
     AG/RS collectives, halving tp activation wire vs the f32 the policy's
     accumulate-in-f32 Linears otherwise put on it — the standard Megatron
     practice (activations are bf16-precision products anyway; local math
-    stays in the original dtype). Default ``None`` = exact."""
+    stays in the original dtype). Default ``None`` = exact.
+
+    Local math follows the ACTIVE dtype policy at trace time
+    (``core.dtypes.current_policy()``), exactly as the pjit path's Linears
+    do: matmul operands are ``cast_compute``'d and accumulate in
+    ``accum_dtype`` (``preferred_element_type``), so under
+    ``use_policy(bfloat16_compute)`` the explicit path reproduces the pjit
+    numerics instead of silently running f32.
+
+    ``remat`` (None | "dots" | "full", see
+    :func:`paddle_tpu.models.transformer.remat_policy`) runs the layer loop
+    as ONE ``jax.checkpoint``-wrapped ``lax.scan`` over the stacked
+    per-layer shard params — layer-boundary seq-shards are all that's saved
+    across the stack, composing sequence-parallel activation memory with
+    rematerialization for long-context training."""
     try:
         from jax import shard_map as _shard_map
     except ImportError:                      # older jax
@@ -150,6 +164,18 @@ def make_megatron_sp_lm_apply(model, mesh: Mesh, data_axis: str = "data",
         return lax.psum_scatter(pb, model_axis, scatter_dimension=1,
                                 tiled=True).astype(part.dtype)
 
+    from ..core.dtypes import current_policy
+
+    def _dot(a, b):
+        """Policy-cast matmul — the pjit path's Linear/MHA projection math
+        (``cast_compute`` operands, accumulate in ``accum_dtype``). The
+        policy is read HERE, at trace time, exactly as nn.layers.Linear
+        reads it in forward() — a policy activated after this factory ran
+        (build at setup, trace under ``use_policy``) still applies."""
+        pol = current_policy()
+        return jnp.dot(pol.cast_compute(a), pol.cast_compute(b),
+                       preferred_element_type=pol.accum_dtype)
+
     def _attend_local(q, k, v):
         """Causal self-attention on this device's head group; q/k/v
         [B, T, h_local, hd]."""
@@ -164,8 +190,32 @@ def make_megatron_sp_lm_apply(model, mesh: Mesh, data_axis: str = "data",
         T = q.shape[1]
         cm = jnp.tril(jnp.ones((T, T), bool))
         logits = jnp.where(cm[None, None], logits, -1e9)
-        w = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        # softmax weights drop to the policy's compute dtype, mirroring
+        # MultiHeadAttention's xla path (the context einsum re-promotes
+        # against the f32-accumulated v operand); trace-time policy read
+        w = jax.nn.softmax(logits, axis=-1).astype(
+            current_policy().compute_dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+    def _block_local(x, bp):
+        """One transformer block on this device's shards — the Megatron-SP
+        AG -> column -> row -> RS recipe for both sublayers."""
+        # attention sublayer: AG(seq) -> column qkv -> row wo -> RS(seq)
+        z = _layernorm(x, bp["ln1"])
+        zg = _ag(z)
+        hl = H // tp
+        q = _dot(zg, bp["attn"]["wq"]).reshape(*zg.shape[:2], hl, hd)
+        k = _dot(zg, bp["attn"]["wk"]).reshape(*zg.shape[:2], hl, hd)
+        v = _dot(zg, bp["attn"]["wv"]).reshape(*zg.shape[:2], hl, hd)
+        ctx = _attend_local(q, k, v).reshape(*zg.shape[:2], hl * hd)
+        part = _dot(ctx, bp["attn"]["wo"])     # partial over model
+        x = x + _rs(part)
+        # FFN sublayer: AG(seq) -> column ffn1 -> row ffn2 -> RS(seq)
+        z = _layernorm(x, bp["ln2"])
+        zg = _ag(z)
+        h1 = gelu(_dot(zg, bp["ffn1"]["w"]) + bp["ffn1"]["b"])
+        part = _dot(h1, bp["ffn2"]["w"])
+        return x + _rs(part) + bp["ffn2"]["b"]
 
     def _forward_local(params, ids):
         """Per-device body. ``params``: this device's shards (column/row
@@ -184,27 +234,24 @@ def make_megatron_sp_lm_apply(model, mesh: Mesh, data_axis: str = "data",
         x = jnp.take(emb_w, jnp.clip(sl, 0, emb_w.shape[0] - 1), axis=0)
         x = x * valid[..., None].astype(x.dtype)     # zero-for-padding rule
         x = x + jnp.take(pos_w, jnp.arange(Tl) + midx * Tl, axis=0)[None]
-        compute_dtype = root["block0"]["attn"]["wq"].dtype
-        x = x.astype(compute_dtype)
+        # (the residual stream stays in the embedding-table dtype — the
+        # pjit path never casts it; only matmul operands drop to the
+        # policy's compute dtype inside _dot)
         # ---- blocks ------------------------------------------------------
-        for i in range(L):
-            bp = root[f"block{i}"]
-            # attention sublayer: AG(seq) -> column qkv -> row wo -> RS(seq)
-            z = _layernorm(x, bp["ln1"])
-            zg = _ag(z)
-            hl = H // tp
-            q = (zg @ bp["attn"]["wq"]).reshape(*zg.shape[:2], hl, hd)
-            k = (zg @ bp["attn"]["wk"]).reshape(*zg.shape[:2], hl, hd)
-            v = (zg @ bp["attn"]["wv"]).reshape(*zg.shape[:2], hl, hd)
-            ctx = _attend_local(q, k, v).reshape(*zg.shape[:2], hl * hd)
-            part = ctx @ bp["attn"]["wo"]          # partial over model
-            x = x + _rs(part)
-            # FFN sublayer: AG(seq) -> column ffn1 -> row ffn2 -> RS(seq)
-            z = _layernorm(x, bp["ln2"])
-            zg = _ag(z)
-            h1 = gelu(zg @ bp["ffn1"]["w"] + bp["ffn1"]["b"])
-            part = h1 @ bp["ffn2"]["w"]
-            x = x + _rs(part) + bp["ffn2"]["b"]
+        if remat is None:
+            for i in range(L):
+                x = _block_local(x, root[f"block{i}"])
+        else:
+            from ..models.transformer import remat_policy
+            stacked = jax.tree_util.tree_map(
+                lambda *ls: jnp.stack(ls),
+                *[root[f"block{i}"] for i in range(L)])
+
+            def body(h, bp):
+                return _block_local(h, bp), None
+
+            body = jax.checkpoint(body, policy=remat_policy(remat))
+            x, _ = lax.scan(body, x, stacked)
         # ---- head: final LN + tied readout on the local seq rows --------
         z = _layernorm(x, root["ln_f"])
         return z @ emb_w.T.astype(z.dtype)

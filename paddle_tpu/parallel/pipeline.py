@@ -89,9 +89,11 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, axis_name: str,
     """
     outbuf = _wavefront(stage_fn, stage_params, x, axis_name, axis_size)
     stage = lax.axis_index(axis_name)
-    # broadcast the last stage's buffer to every device
-    mask = (stage == axis_size - 1).astype(outbuf.dtype)
-    return lax.psum(outbuf * mask, axis_name)
+    # broadcast the last stage's buffer to every device. jnp.where, not a
+    # multiplicative mask: non-finite values in a bubble device's buffer
+    # would poison the psum through NaN * 0 == NaN.
+    sel = jnp.where(stage == axis_size - 1, outbuf, jnp.zeros_like(outbuf))
+    return lax.psum(sel, axis_name)
 
 
 def make_pipeline(mesh: Mesh, stage_fn: Callable, pipe_axis: str = "pipe"):
@@ -144,11 +146,20 @@ def pipeline_loss_apply(stage_fn: Callable, stage_params, x,
                         comm_dtype=comm_dtype)
     stage = lax.axis_index(axis_name)
     S = axis_size
-    val = final_fn(final_params, outbuf, *extras)
-    mask = (stage == S - 1).astype(val.dtype)
+    # Double-where, not val * mask: bubble devices would run final_fn on a
+    # zero buffer, and a non-finite val there (0/0 counts, log 0, ...)
+    # poisons the psum through NaN * 0 == NaN — and even with an outer
+    # where, the BACKWARD multiplies the zeroed cotangent into final_fn's
+    # inf/NaN partials (0 * inf == NaN again). So bubble devices evaluate
+    # final_fn on a safe all-ones buffer (finite value AND finite partials
+    # for the 0/0-normalisation class), and the outer where discards it.
+    is_last = stage == S - 1
+    safe = jnp.where(is_last, outbuf, jnp.ones_like(outbuf))
+    val = final_fn(final_params, safe, *extras)
+    sel = jnp.where(is_last, val, jnp.zeros_like(val))
     # reduce_axes: batch-sharding axes of x/extras (dp x pp) whose partial
     # losses must also sum into the global scalar
-    return lax.psum(val * mask, (axis_name,) + tuple(reduce_axes))
+    return lax.psum(sel, (axis_name,) + tuple(reduce_axes))
 
 
 def make_pipeline_loss(mesh: Mesh, stage_fn: Callable, final_fn: Callable,

@@ -26,6 +26,7 @@ _log = logging.getLogger("paddle_tpu.trainer")
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import mesh as mesh_lib
@@ -50,6 +51,12 @@ def _batch_fingerprint(host_batch) -> int:
         arr = np.ascontiguousarray(np.asarray(leaf))
         crc = zlib.crc32(arr.tobytes(), crc)
     return crc
+
+
+def _batch_shapes(host_batch):
+    """Leaf shapes of a host batch — fused groups must stack uniformly."""
+    return tuple(np.shape(leaf)
+                 for leaf in jax.tree_util.tree_leaves(host_batch))
 
 
 class TrainState:
@@ -88,13 +95,32 @@ class Trainer:
         ``optimizer.init`` on committed params — eager zeros_like
         propagates sharding); XLA inserts the collectives. Default fully
         replicated.
+      steps_per_call: K > 1 fuses K optimizer steps into ONE device dispatch
+        (a donated ``lax.scan`` over K pre-stacked host batches) — amortizes
+        the per-call Python->device dispatch (~5 ms/call on the remote-TPU
+        tunnel, experiments/PERF.md exp 2). The compiled program returns the
+        stacked per-step losses/evaluator stats; host events, logging, and
+        ``saving_period`` checkpoints replay per step after each call (so
+        BeginIteration/EndIteration both fire post-dispatch, and mid-pass
+        saves land on call boundaries). Numerically identical to K plain
+        steps (same traced step body).
+      grad_accum: M > 1 accumulates gradients over M consecutive host
+        batches (microbatches) per optimizer step, in a donated-accumulator
+        inner ``lax.scan`` — large effective batches beyond what HBM fits in
+        one forward/backward. Loss/grads are the mean over the M microbatch
+        means, each microbatch weight-normalized by its own ``weight`` field
+        (mean-of-means; mask/weight-correct within each microbatch). The
+        optimizer update — and with ``param_sharding`` the gradient
+        all-reduce the partitioner hoists out of the accumulation loop —
+        fires once per accumulated step, not per microbatch.
     """
 
     def __init__(self, model: Module, loss_fn: Callable, optimizer: Optimizer,
                  mesh=None, forward: Optional[Callable] = None,
                  evaluator=None, param_sharding=None, donate: bool = True,
                  nan_check: bool = False,
-                 param_stats_period: Optional[int] = None):
+                 param_stats_period: Optional[int] = None,
+                 steps_per_call: int = 1, grad_accum: int = 1):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -114,6 +140,11 @@ class Trainer:
         # param_stats_period: per-param scale telemetry every N batches (the
         # reference's --show_parameter_stats_period, TrainerInternal.cpp:81).
         self._param_stats_period = param_stats_period
+        if steps_per_call < 1 or grad_accum < 1:
+            raise ValueError("steps_per_call and grad_accum must be >= 1")
+        self.steps_per_call = int(steps_per_call)
+        self.grad_accum = int(grad_accum)
+        self._fused_step = None
         self.train_state: Optional[TrainState] = None
         self._last_iter_state: Optional[Dict[str, Any]] = None
 
@@ -170,41 +201,87 @@ class Trainer:
 
     # -- compiled steps ------------------------------------------------------
 
-    def _build_train_step(self):
-        mesh = self.mesh
+    def _make_step_fn(self, accum_axis: bool):
+        """Build the one-optimizer-step function shared by the plain and
+        fused paths.
+
+        ``accum_axis=False``: ``batch`` is a single microbatch pytree — the
+        plain step body, math unchanged from the single-dispatch trainer.
+
+        ``accum_axis=True``: ``batch`` leaves carry a leading ``[M, ...]``
+        microbatch axis. ``M == 1`` squeezes the axis and runs the identical
+        plain body (so ``steps_per_call``-only fusion is bit-for-bit the
+        plain step). ``M > 1`` runs a donated-accumulator ``lax.scan`` over
+        the M microbatches: each microbatch's loss is its own weight-
+        normalized mean, loss/grads are the mean of the M microbatch means
+        (mean-of-means — mask/weight-correct within each microbatch), the
+        module state threads sequentially, and the optimizer update fires
+        once on the accumulated gradient."""
         opt = self.optimizer
         model = self.model
         loss_fn = self.loss_fn
         forward = self._forward
         evaluator = self.evaluator
 
-        def step_fn(params, state, opt_state, step, batch, rng):
-            rngs = {"dropout": jax.random.fold_in(rng, step)}
-
+        def microbatch_grads(params, state, mb, rngs):
             def compute_loss(p):
                 out, new_state = forward(model, {"params": p, "state": state},
-                                         batch, True, rngs)
-                per_ex = loss_fn(out, batch)
-                w = batch.get("weight")
+                                         mb, True, rngs)
+                per_ex = loss_fn(out, mb)
+                w = mb.get("weight")
                 if w is not None:
                     loss = jnp.sum(per_ex * w) / jnp.maximum(jnp.sum(w), 1e-9)
                 else:
                     loss = jnp.mean(per_ex)
                 return loss, (new_state, out)
 
-            (loss, (new_state, out)), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(params)
+            return jax.value_and_grad(compute_loss, has_aux=True)(params)
+
+        def step_fn(params, state, opt_state, step, batch, rng):
+            M = (jax.tree_util.tree_leaves(batch)[0].shape[0]
+                 if accum_axis else 1)
+            if accum_axis and M == 1:
+                batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+            if M == 1:
+                rngs = {"dropout": jax.random.fold_in(rng, step)}
+                (loss, (new_state, out)), grads = microbatch_grads(
+                    params, state, batch, rngs)
+                stats = (evaluator.batch_stats(out, batch)
+                         if evaluator is not None else {})
+            else:
+                step_key = jax.random.fold_in(rng, step)
+
+                def micro(carry, xs):
+                    st, gacc, lacc = carry
+                    mb, midx = xs
+                    rngs = {"dropout": jax.random.fold_in(step_key, midx)}
+                    (l, (new_st, out)), g = microbatch_grads(
+                        params, st, mb, rngs)
+                    s = (evaluator.batch_stats(out, mb)
+                         if evaluator is not None else {})
+                    gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
+                    return (new_st, gacc, lacc + l), s
+
+                g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+                (new_state, gacc, lacc), stats = lax.scan(
+                    micro, (state, g0, jnp.zeros((), jnp.float32)),
+                    (batch, jnp.arange(M)))
+                grads = jax.tree_util.tree_map(lambda g: g / M, gacc)
+                loss = lacc / M
             updates, new_opt = opt.update(grads, opt_state, params, step)
             new_params = apply_updates(params, updates)
-            stats = (evaluator.batch_stats(out, batch)
-                     if evaluator is not None else {})
             return new_params, new_state, new_opt, step + 1, loss, stats
 
+        return step_fn
+
+    def _build_train_step(self):
+        step_fn = self._make_step_fn(accum_axis=False)
         # Shardings: batch sharded over the data axis, params replicated
         # (default) or committed to the user's model-parallel layout at
         # init — in that case shardings are taken from the committed inputs
         # and SPMD propagation lays out the rest. XLA inserts the gradient
         # all-reduce over ICI — the entire pserver tier collapses here.
+        mesh = self.mesh
         donate = (0, 1, 2) if self._donate else ()
         if self._param_sharding is None:
             repl = NamedSharding(mesh, P())
@@ -215,6 +292,40 @@ class Trainer:
                 donate_argnums=donate)
         else:
             self._train_step = jax.jit(step_fn, donate_argnums=donate)
+
+    def _build_fused_step(self, sample_batches):
+        """The fused hot loop: ONE jit-compiled dispatch = a donated
+        ``lax.scan`` over K optimizer steps (each itself scanning M
+        microbatches when ``grad_accum > 1``). ``sample_batches`` (host
+        leaves ``[K, M, batch, ...]``) fixes the batch-tree structure for the
+        per-leaf data shardings; distinct (K, M) tail shapes retrace through
+        the same jit cache. Returns the stacked per-step losses ``[K]`` and
+        evaluator stats with leading ``[K, M]`` (``[K]`` when the microbatch
+        axis was squeezed)."""
+        step_fn = self._make_step_fn(accum_axis=True)
+        mesh = self.mesh
+
+        def fused_fn(params, state, opt_state, step, batches, rng):
+            def body(carry, kbatch):
+                p, st, o, s = carry
+                p, st, o, s, loss, stats = step_fn(p, st, o, s, kbatch, rng)
+                return (p, st, o, s), (loss, stats)
+
+            (params, state, opt_state, step), (losses, stats) = lax.scan(
+                body, (params, state, opt_state, step), batches)
+            return params, state, opt_state, step, losses, stats
+
+        donate = (0, 1, 2) if self._donate else ()
+        if self._param_sharding is None:
+            repl = NamedSharding(mesh, P())
+            bshard = jax.tree_util.tree_map(self._fused_leaf_sharding,
+                                            sample_batches)
+            self._fused_step = jax.jit(
+                fused_fn,
+                in_shardings=(repl, repl, repl, repl, bshard, repl),
+                donate_argnums=donate)
+        else:
+            self._fused_step = jax.jit(fused_fn, donate_argnums=donate)
 
     def _build_eval_step(self):
         model = self.model
@@ -266,8 +377,9 @@ class Trainer:
         pass's metrics cover only its remaining batches.
         """
         assert self.train_state is not None, "call init() first"
-        if self._train_step is None:
-            self._build_train_step()
+        fused = self.steps_per_call > 1 or self.grad_accum > 1
+        if not fused and self._train_step is None:
+            self._build_train_step()    # fused step builds lazily per group
         handler = event_handler or (lambda e: None)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
@@ -298,6 +410,8 @@ class Trainer:
                     checkpoint_dir, checkpoint_keep, saving_period,
                     log_period, rng, start_pass, skip_batches, save_fn):
         ts = self.train_state
+        fused = self.steps_per_call > 1 or self.grad_accum > 1
+        group = self.steps_per_call * self.grad_accum
         params, state, opt_state, step = (ts.params, ts.state, ts.opt_state,
                                           ts.step)
         for pass_id in range(start_pass, num_passes):
@@ -305,6 +419,7 @@ class Trainer:
             if self.evaluator is not None:
                 self.evaluator.reset()
             costs = []
+            buf, buf_start = [], 0
             for batch_id, host_batch in enumerate(reader()):
                 if pass_id == start_pass and batch_id < skip_batches:
                     # Deterministic replay skip on resume. On the last
@@ -323,6 +438,28 @@ class Trainer:
                                 "buffered?); the resumed pass trains on a "
                                 "different batch remainder than the "
                                 "interrupted run", batch_id)
+                    continue
+                if fused:
+                    # Buffer K*M host batches, then ONE device dispatch for
+                    # K optimizer steps; host bookkeeping replays after. A
+                    # shape change mid-group (ragged final reader batch)
+                    # flushes the buffer early — groups must stack.
+                    if buf and _batch_shapes(host_batch) != \
+                            _batch_shapes(buf[0]):
+                        self._run_fused_group(
+                            buf, buf_start, pass_id, rng, handler, costs,
+                            log_period, saving_period, checkpoint_dir,
+                            checkpoint_keep, save_fn)
+                        buf = []
+                    if not buf:
+                        buf_start = batch_id
+                    buf.append(host_batch)
+                    if len(buf) == group:
+                        self._run_fused_group(
+                            buf, buf_start, pass_id, rng, handler, costs,
+                            log_period, saving_period, checkpoint_dir,
+                            checkpoint_keep, save_fn)
+                        buf = []
                     continue
                 handler(ev.BeginIteration(pass_id, batch_id))
                 with self.stats.time("shard_batch"):
@@ -367,6 +504,19 @@ class Trainer:
                         keep_last=checkpoint_keep)
                 handler(ev.EndIteration(pass_id, batch_id, int(step), cost,
                                         metrics))
+            if fused and buf:
+                # Pass tail smaller than K*M: flush what's buffered (the
+                # final optimizer step may accumulate < M microbatches;
+                # its loss/grads average over the actual count).
+                self._run_fused_group(
+                    buf, buf_start, pass_id, rng, handler, costs,
+                    log_period, saving_period, checkpoint_dir,
+                    checkpoint_keep, save_fn)
+                buf = []
+            if fused:
+                ts = self.train_state
+                params, state, opt_state, step = (ts.params, ts.state,
+                                                  ts.opt_state, ts.step)
             pass_metrics = (self.evaluator.result()
                             if self.evaluator is not None else {})
             pass_metrics["mean_cost"] = float(np.mean(costs)) if costs else 0.0
@@ -383,6 +533,167 @@ class Trainer:
                     keep_last=checkpoint_keep)
             handler(ev.EndPass(pass_id, pass_metrics))
         return self.train_state
+
+    # -- fused dispatch ------------------------------------------------------
+
+    def _stack_group(self, sub, k: int, m: int):
+        """Stack k*m host batches into one pytree with leaves
+        ``[k, m, batch, ...]`` (the compiled fused step's input layout)."""
+        hosts = [jax.tree_util.tree_map(np.asarray, b) for b in sub]
+
+        def stack(*xs):
+            arr = np.stack(xs)
+            return arr.reshape((k, m) + arr.shape[1:])
+
+        return jax.tree_util.tree_map(stack, *hosts)
+
+    def compile_fused(self, host_batches):
+        """Public harness hook: stack ``steps_per_call * grad_accum`` host
+        batches into the fused [K, M, batch, ...] group, build the compiled
+        fused step if needed, and return ``(fused_step, device_batches)``.
+
+        ``fused_step(params, state, opt_state, step, device_batches, rng)``
+        returns ``(params, state, opt_state, step, losses[K], stats)`` —
+        the stable surface benchmarks drive for repeated dispatch of one
+        resident group (bench.py's ``transformer_fused`` metric) without
+        depending on the Trainer's private stacking/sharding layout."""
+        K, M = self.steps_per_call, self.grad_accum
+        if len(host_batches) != K * M:
+            raise ValueError(
+                f"compile_fused needs steps_per_call*grad_accum = {K * M} "
+                f"host batches, got {len(host_batches)}")
+        stacked = self._stack_group(host_batches, K, M)
+        if self._fused_step is None:
+            self._build_fused_step(stacked)
+        return self._fused_step, self._shard_fused(stacked)
+
+    def _fused_leaf_sharding(self, x):
+        """The ONE per-leaf layout rule for stacked [K, M, batch, ...] group
+        leaves — shared by the compiled step's in_shardings and the host
+        device_put so the dispatch never resharding-copies its input:
+        microbatch dim sharded over the data axis, [K, M] leading dims (and
+        per-batch scalars) replicated."""
+        if np.ndim(x) <= 2:               # [K, M] scalars: replicated
+            return NamedSharding(self.mesh, P())
+        return NamedSharding(self.mesh, P(None, None, mesh_lib.DATA_AXIS))
+
+    def _shard_fused(self, stacked):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self._fused_leaf_sharding(x)),
+            stacked)
+
+    def _dispatch_fused(self, stacked, rng):
+        """One fused device call; refreshes train_state (donation invalidates
+        the previous buffers). Returns (losses [K], stats [K(, M), ...])."""
+        if self._fused_step is None:
+            self._build_fused_step(stacked)
+        with self.stats.time("shard_batch"):
+            batches = self._shard_fused(stacked)
+        ts = self.train_state
+        with self.stats.time("train_step"):
+            params, state, opt_state, step, losses, stats = self._fused_step(
+                ts.params, ts.state, ts.opt_state, ts.step, batches, rng)
+        self.train_state = TrainState(params, state, opt_state, step)
+        return losses, stats
+
+    def _run_fused_group(self, buf, buf_start, pass_id, rng, handler, costs,
+                         log_period, saving_period, checkpoint_dir,
+                         checkpoint_keep, save_fn):
+        """Dispatch a buffered host-batch group as fused device calls, then
+        replay the per-optimizer-step host bookkeeping (events, costs,
+        evaluator updates, logging) and checkpoint at the call boundary.
+
+        Events fire with ``batch_id`` = the index of the step's LAST host
+        batch, so host-batch-denominated periods (``log_period``,
+        ``saving_period``) keep their plain-mode meaning. Because the K
+        steps run inside one dispatch, Begin/EndIteration both fire after
+        the call, and mid-pass checkpoints land on call boundaries (a
+        ``saving_period`` crossed mid-call saves once, at the boundary, with
+        the true ``next_batch`` position — so resume replay stays aligned
+        with the fused grouping)."""
+        M = self.grad_accum
+        done, results = 0, []
+        while done < len(buf):
+            rem = len(buf) - done
+            take = (rem // M) * M or rem        # full KxM part, then the tail
+            m_eff = M if take >= M else take
+            stacked = self._stack_group(buf[done:done + take],
+                                        take // m_eff, m_eff)
+            losses, stats = self._dispatch_fused(stacked, rng)
+            # record THIS dispatch's post-call step count: a group split
+            # into several dispatches (tail not a multiple of M) must not
+            # number earlier dispatches' steps off the later ones' state
+            results.append((buf_start + done, m_eff, losses, stats,
+                            int(self.train_state.step)))
+            done += take
+        # The boundary checkpoint lands BEFORE the replayed events, matching
+        # the plain loop's save-then-EndIteration order (handlers that kill
+        # training after a period save — the kill/resume pattern — observe
+        # the same sequence). With nan_check on, a non-finite loss anywhere
+        # in the group SKIPS the save (plain mode raises before reaching its
+        # save) — never persist a poisoned train_state that resume would
+        # restore.
+        end = buf_start + len(buf)
+        group_finite = (not self._nan_check) or all(
+            np.isfinite(np.asarray(jax.device_get(losses))).all()
+            for _, _, losses, _, _ in results)
+        if saving_period and checkpoint_dir and group_finite and \
+                (end // saving_period) > (buf_start // saving_period):
+            save_fn(
+                checkpoint_dir, pass_id,
+                {**self.train_state.as_dict(),
+                 "iter": {"pass": pass_id, "next_batch": end,
+                          "completed": 0,
+                          "batch_crc": _batch_fingerprint(buf[-1])}},
+                keep_last=checkpoint_keep)
+        for start, m_eff, losses, stats, step_after in results:
+            self._post_fused(pass_id, start, m_eff, losses, stats,
+                             step_after, handler, costs, log_period)
+
+    def _post_fused(self, pass_id, start_index, m_eff, losses, stats,
+                    step_after, handler, costs, log_period):
+        """Replay one dispatch's host bookkeeping; ``step_after`` is the
+        global optimizer-step count right after THAT dispatch."""
+        losses_np = np.asarray(jax.device_get(losses))
+        stats_np = (jax.device_get(stats)
+                    if self.evaluator is not None else None)
+        K = int(losses_np.shape[0])
+        for k in range(K):
+            last_id = start_index + (k + 1) * m_eff - 1
+            handler(ev.BeginIteration(pass_id, last_id))
+            cost = float(losses_np[k])
+            if self._nan_check and not np.isfinite(cost):
+                from ..utils import debug as dbg
+                ts = self.train_state
+                bad = dbg.nonfinite_leaves(
+                    {"params": ts.params, "state": ts.state})
+                raise FloatingPointError(
+                    f"non-finite loss {cost} at pass {pass_id} batch "
+                    f"{last_id} (step {step_after - (K - 1 - k)}); "
+                    f"non-finite leaves (post-call state): "
+                    f"{bad[:8] or 'none (loss only)'}")
+            costs.append(cost)
+            metrics = {}
+            if self.evaluator is not None:
+                for m in range(m_eff):
+                    self.evaluator.update(jax.tree_util.tree_map(
+                        lambda x: x[k] if m_eff == 1 else x[k][m], stats_np))
+                metrics = self.evaluator.result()
+            # Period checks use boundary CROSSING, not exact modulo: a step
+            # consumes m_eff host batches, so (last_id + 1) only lands on
+            # multiples of m_eff and an exact-modulo period not divisible
+            # by grad_accum would (mostly) never fire.
+            step_first = start_index + k * m_eff
+            if log_period and \
+                    (last_id + 1) // log_period > step_first // log_period:
+                msg = " ".join(f"{k_}={v:.4f}" for k_, v in metrics.items())
+                _log.info("pass %d batch %d cost=%.4f %s",
+                          pass_id, last_id + 1, cost, msg)
+            psp = self._param_stats_period
+            if psp and (last_id + 1) // psp > step_first // psp:
+                self._log_param_stats(pass_id, last_id)
+            handler(ev.EndIteration(pass_id, last_id,
+                                    step_after - (K - 1 - k), cost, metrics))
 
     def _log_param_stats(self, pass_id: int, batch_id: int):
         """Per-parameter scale telemetry (``--show_parameter_stats_period``:

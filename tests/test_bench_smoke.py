@@ -1,0 +1,50 @@
+"""CI gate for the fused train-step pipeline: ``bench.py --smoke`` must run
+green on CPU and report the fused-vs-plain differential (ISSUE 1 satellite:
+the fused path cannot rot without tier-1 noticing)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_cpu_green_and_equal():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)          # plain single-device CPU
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr[-800:]
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "fused_vs_plain_smoke"
+    assert out["equal"] is True
+    assert out["params_equal"] is True and out["losses_equal"] is True
+    assert out["K"] == 4 and out["M"] == 2
+    # the differential is the point: both per-step times present and sane
+    assert out["fused_ms_per_opt_step"] > 0
+    assert out["plain_ms_per_opt_step"] > 0
+    assert np.isfinite(out["final_loss"])
+
+
+def test_bench_prep_transformer_fused_builds():
+    """The device-bench fused metric prep wires Trainer's fused dispatch
+    into the harness step protocol; one tiny-config call must run and
+    advance K optimizer steps."""
+    sys.path.insert(0, REPO)
+    import jax
+    import bench
+    from paddle_tpu.core.dtypes import bfloat16_compute, use_policy
+
+    with use_policy(bfloat16_compute):
+        # batch divides the conftest 8-device data mesh
+        step_body, state0, meta = bench.prep_transformer_fused(
+            batch_size=8, seq_len=16, dim=32, layers=2, heads=2, vocab=64,
+            k_steps=3)
+        state = jax.jit(step_body)(state0)
+    assert int(state[3]) == 3                    # K steps per call
+    assert np.isfinite(float(state[-1]))
+    assert meta["units_per_step"] == 3 * 8 * 16

@@ -1,0 +1,359 @@
+"""Fused train-step hot loop equivalence suite (ISSUE 1 tentpole).
+
+The contract under test: ``Trainer(steps_per_call=K, grad_accum=M)`` runs
+K optimizer steps per device dispatch, each accumulating M host-batch
+microbatches (mean-of-means, weight-correct) — and reproduces K*M PLAIN
+dispatches (one jitted grad call per microbatch + one jitted update per
+optimizer step) bit-for-bit in f32: params, per-step losses, and evaluator
+stats, with and without ``param_sharding`` and with weighted batches.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu import optim, parallel
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import costs
+from paddle_tpu.optim.optimizers import apply_updates
+from paddle_tpu.train import Trainer, ClassificationError, events as ev
+
+
+class MLP(Module):
+    def __init__(self, hidden=32, classes=8):
+        super().__init__()
+        self.hidden = nn.Linear(hidden, act="relu", name="hidden")
+        self.out = nn.Linear(classes, name="out")
+
+    def forward(self, x, train=False):
+        return self.out(self.hidden(x))
+
+
+MLP_RULES = parallel.ShardingRules([
+    ("*/hidden/w", P(None, "model")),
+    ("*/hidden/b", P("model")),
+    ("*/out/w", P("model", None)),
+])
+
+
+def _batches(n=8, bs=32, d=16, classes=8, seed=0, weighted=False):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        b = {"x": rng.normal(size=(bs, d)).astype(np.float32),
+             "label": rng.randint(0, classes, bs).astype(np.int32)}
+        if weighted:
+            # includes zero weights: the mask-correctness case
+            b["weight"] = rng.randint(0, 3, bs).astype(np.float32)
+        out.append(b)
+    return out
+
+
+def _make_trainer(K, M, batches, mesh=None, param_sharding=None,
+                  evaluator=None, optimizer=None, donate=True):
+    tr = Trainer(
+        model=MLP(),
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
+        optimizer=optimizer or optim.adam(1e-3),
+        mesh=mesh, param_sharding=param_sharding, evaluator=evaluator,
+        donate=donate, steps_per_call=K, grad_accum=M)
+    tr.init(jax.random.PRNGKey(0), batches[0])
+    return tr
+
+
+def _run(tr, batches, num_passes=1, **kw):
+    losses, metrics = [], []
+
+    def handler(e):
+        if isinstance(e, ev.EndIteration):
+            losses.append(e.cost)
+            metrics.append(dict(e.metrics))
+
+    tr.train(lambda: iter(batches), num_passes=num_passes,
+             event_handler=handler, log_period=0, **kw)
+    return jax.device_get(tr.train_state.params), losses, metrics
+
+
+def _assert_trees_equal(a, b):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def _plain_dispatch_reference(trainer_params, opt, batches, M, mesh=None,
+                              shard=None):
+    """K*M PLAIN steps: one jitted value_and_grad dispatch per microbatch,
+    gradients accumulated in microbatch order, mean over M, one jitted
+    optimizer update per accumulated step — the unfused execution of the
+    fused pipeline's exact math."""
+    model = MLP()
+
+    def micro_loss(p, b):
+        out = model.apply({"params": p}, b["x"])
+        per_ex = costs.softmax_cross_entropy(out, b["label"])
+        w = b.get("weight")
+        if w is not None:
+            return jnp.sum(per_ex * w) / jnp.maximum(jnp.sum(w), 1e-9)
+        return jnp.mean(per_ex)
+
+    vg = jax.jit(jax.value_and_grad(micro_loss))
+
+    @jax.jit
+    def update(grads, opt_state, params, step):
+        updates, new_opt = opt.update(grads, opt_state, params, step)
+        return apply_updates(params, updates), new_opt
+
+    params = jax.tree_util.tree_map(jnp.asarray, trainer_params)
+    opt_state = opt.init(params)
+    step = jnp.zeros((), jnp.int32)
+    losses = []
+    for i in range(0, len(batches), M):
+        group = batches[i:i + M]
+        gacc = jax.tree_util.tree_map(jnp.zeros_like, params)
+        lacc = jnp.zeros((), jnp.float32)
+        for hb in group:
+            b = (pt.core.mesh.shard_batch(mesh, hb) if shard
+                 else jax.tree_util.tree_map(jnp.asarray, hb))
+            loss, g = vg(params, b)
+            gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
+            lacc = lacc + loss
+        grads = jax.tree_util.tree_map(lambda g: g / len(group), gacc)
+        losses.append(float(lacc / len(group)))
+        params, opt_state = update(grads, opt_state, params, step)
+        step = step + 1
+    return jax.device_get(params), losses
+
+
+def test_steps_per_call_matches_plain_bitexact():
+    """K-fused dispatch == K plain Trainer dispatches, bit for bit in f32:
+    params, loss trajectory, and evaluator stats."""
+    batches = _batches(8)
+    p1, l1, m1 = _run(_make_trainer(1, 1, batches,
+                                    evaluator=ClassificationError()),
+                      batches)
+    p4, l4, m4 = _run(_make_trainer(4, 1, batches,
+                                    evaluator=ClassificationError()),
+                      batches)
+    assert l1 == l4
+    assert m1 == m4                 # per-step evaluator results identical
+    _assert_trees_equal(p1, p4)
+
+
+def test_grad_accum_matches_plain_dispatch_reference():
+    """Trainer(steps_per_call=K, grad_accum=M) reproduces K*M plain steps
+    (params + per-step losses) bit-for-bit in f32 — with WEIGHTED batches
+    (zero weights included), so the mean-of-means accumulation is
+    mask/weight-correct, not just unweighted-mean-correct."""
+    batches = _batches(8, weighted=True)
+    opt = optim.adam(1e-3)
+    tr = _make_trainer(2, 2, batches, optimizer=opt)
+    p0 = jax.device_get(tr.train_state.params)
+    # the reference consumes batches sharded over the SAME data-parallel
+    # mesh (bit-exactness holds per layout; cross-device-count reduction
+    # order differs, which is what test_single_vs_multichip tolerates)
+    fused_p, fused_l, _ = _run(tr, batches)
+    ref_p, ref_l = _plain_dispatch_reference(p0, opt, batches, M=2,
+                                             mesh=tr.mesh, shard=True)
+    assert fused_l == ref_l
+    _assert_trees_equal(fused_p, ref_p)
+    assert int(tr.train_state.step) == 4        # 8 batches / M=2 steps
+
+
+def test_fused_matches_plain_dispatch_with_param_sharding():
+    """The same bit-exact contract with model-parallel ``param_sharding``
+    set: the fused accumulation composes with the sharded layout (grad
+    collectives per accumulated step inside the compiled scan) and the
+    layout survives the fused dispatch."""
+    batches = _batches(8, weighted=True)
+    mesh = pt.make_mesh({"data": 2, "model": 4})
+    opt = optim.adam(1e-3)
+    tr = _make_trainer(2, 2, batches, mesh=mesh, param_sharding=MLP_RULES,
+                       optimizer=opt)
+    p0 = jax.device_get(tr.train_state.params)
+
+    # reference params must live in the SAME committed sharded layout
+    ref_tr = _make_trainer(1, 1, batches, mesh=mesh,
+                           param_sharding=MLP_RULES, optimizer=opt)
+    fused_p, fused_l, _ = _run(tr, batches)
+    ref_p, ref_l = _plain_dispatch_reference(
+        ref_tr.train_state.params, opt, batches, M=2, mesh=mesh, shard=True)
+    assert fused_l == ref_l
+    _assert_trees_equal(fused_p, ref_p)
+    root = next(iter(tr.train_state.params))
+    w = tr.train_state.params[root]["hidden"]["w"]
+    assert tuple(w.sharding.spec) == (None, "model")
+
+
+def test_fused_donation_safety():
+    """donate=True (default): event handlers may read trainer.train_state
+    after every fused call — the refreshed buffers must be live (donation
+    invalidated the previous ones), across multiple passes."""
+    batches = _batches(8)
+    tr = _make_trainer(2, 2, batches, donate=True)
+    norms = []
+
+    def handler(e):
+        if isinstance(e, ev.EndIteration):
+            norms.append(float(jax.device_get(
+                optim.global_norm(tr.train_state.params))))
+
+    tr.train(lambda: iter(batches), num_passes=2, event_handler=handler,
+             log_period=0)
+    # 2 passes x (8 batches / M=2) = 8 optimizer steps
+    assert len(norms) == 8 and all(np.isfinite(n) for n in norms)
+    assert int(tr.train_state.step) == 8
+
+
+def test_fused_tail_smaller_than_group():
+    """A pass whose batch count doesn't divide K*M flushes the tail: full
+    K x M dispatch, then the leftovers (the final step averaging over < M
+    microbatches). 7 batches at K=2, M=2 -> steps of 2+2, 2, 1
+    microbatches = 4 optimizer steps — and EndIteration step numbers stay
+    monotonic 1..4 even though the flush splits into several dispatches."""
+    batches = _batches(7)
+    tr = _make_trainer(2, 2, batches)
+    steps = []
+
+    def handler(e):
+        if isinstance(e, ev.EndIteration):
+            steps.append(e.step)
+
+    tr.train(lambda: iter(batches), num_passes=1, event_handler=handler,
+             log_period=0)
+    assert steps == [1, 2, 3, 4]
+    assert int(tr.train_state.step) == 4
+
+
+def test_fused_resume_mid_pass_reproduces_uninterrupted(tmp_path):
+    """Kill mid-pass after a fused-call-boundary checkpoint, resume with the
+    same (K, M): the replayed grouping realigns and the final params equal
+    the uninterrupted fused run's, bit for bit."""
+    batches = _batches(16)
+
+    def make():
+        return _make_trainer(2, 2, batches)
+
+    tr_a = make()
+    p_want, _, _ = _run(tr_a, batches, num_passes=2)
+    want_step = int(tr_a.train_state.step)
+
+    class Killed(Exception):
+        pass
+
+    def killer(e):
+        # dies after the second fused call of pass 1 (batch 8 = a
+        # saving_period=8 checkpoint boundary)
+        if isinstance(e, ev.EndIteration) and e.pass_id == 1 \
+                and e.batch_id == 7:
+            raise Killed()
+
+    tr_b = make()
+    with pytest.raises(Killed):
+        tr_b.train(lambda: iter(batches), num_passes=2,
+                   checkpoint_dir=str(tmp_path), saving_period=8,
+                   log_period=0, event_handler=killer)
+
+    tr_c = _make_trainer(2, 2, batches)   # fresh trainer, same config
+    tr_c.train(lambda: iter(batches), num_passes=2,
+               checkpoint_dir=str(tmp_path), saving_period=8,
+               log_period=0, resume=True)
+    assert int(tr_c.train_state.step) == want_step
+    _assert_trees_equal(p_want, jax.device_get(tr_c.train_state.params))
+
+
+def test_fused_evaluator_counts_match_plain():
+    """ClassificationError accumulated through the stacked [K, M] stats
+    equals the plain per-batch accumulation (stats ride the compiled scan
+    and replay on host in order)."""
+    batches = _batches(8)
+    ev1 = ClassificationError()
+    ev2 = ClassificationError()
+    _run(_make_trainer(1, 1, batches, evaluator=ev1), batches)
+    _run(_make_trainer(4, 2, batches, evaluator=ev2), batches)
+    assert ev1._total == ev2._total
+    # different step grouping -> different trajectories, but pass totals
+    # count every example exactly once
+    assert ev1._total == 8 * 32
+
+
+# ---------------------------------------------------------------- remat
+
+def test_transformer_remat_scan_matches_plain():
+    """TransformerLM(remat=...) — the block stack as jax.checkpoint'd
+    lax.scan over stacked layer params — matches the plain unrolled stack
+    on the SAME variables tree (logits and grads; scan/remat appear in the
+    jaxpr). Bit-exactness is not required across the scan boundary (XLA
+    refuses nothing, but fusion differs); 1e-5 absolute on unit-scale
+    logits is last-bits."""
+    V, D, T, B = 64, 32, 16, 4
+    kw = dict(vocab=V, dim=D, num_layers=3, num_heads=4, ffn_hidden=64,
+              max_len=T)
+    from paddle_tpu.models import TransformerLM
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, V, (B, T)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(0, V, (B, T)), jnp.int32)
+    base = TransformerLM(**kw)
+    variables = base.init(jax.random.PRNGKey(0), ids)
+
+    for policy in ("dots", "full"):
+        rem = TransformerLM(**kw, remat=policy)
+        lg0 = np.asarray(base.apply(variables, ids))
+        lg1 = np.asarray(rem.apply(variables, ids))
+        np.testing.assert_allclose(lg0, lg1, rtol=1e-5, atol=1e-5)
+
+        def loss(m):
+            def f(p):
+                lg = m.apply({"params": p}, ids)
+                return jnp.mean(costs.softmax_cross_entropy(
+                    lg.reshape(-1, V), tgt.reshape(-1)))
+            return f
+
+        g0 = jax.jit(jax.grad(loss(base)))(variables["params"])
+        g1 = jax.jit(jax.grad(loss(rem)))(variables["params"])
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    rem = TransformerLM(**kw, remat="dots")
+    jaxpr = str(jax.make_jaxpr(
+        lambda p: rem.apply({"params": p}, ids))(variables["params"]))
+    assert "scan[" in jaxpr, "remat path must run the stack as lax.scan"
+    assert "remat" in jaxpr or "checkpoint" in jaxpr, \
+        "remat path must wrap the scan body in jax.checkpoint"
+    # init under the remat config builds the IDENTICAL per-block tree
+    # (checkpoints move freely between remat and plain configs)
+    v2 = TransformerLM(**kw, remat="dots").init(jax.random.PRNGKey(0), ids)
+    _assert_trees_equal(jax.device_get(variables), jax.device_get(v2))
+
+
+def test_remat_model_trains_under_fused_trainer():
+    """The full composition: remat scan-over-layers model + steps_per_call
+    + grad_accum in one compiled pipeline, vs the same model unfused —
+    identical final params (tight f32 tolerance; the remat scan body
+    compiles once per K-step scan so the math matches exactly across K)."""
+    from paddle_tpu.models import TransformerLM
+    V, T, bs = 64, 16, 8
+    rng = np.random.RandomState(0)
+    batches = [{"x": rng.randint(0, V, (bs, T)).astype(np.int32),
+                "y": rng.randint(0, V, (bs, T)).astype(np.int32)}
+               for _ in range(8)]
+
+    def make(K, M):
+        tr = Trainer(
+            model=TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
+                                ffn_hidden=64, max_len=T, remat="dots"),
+            loss_fn=lambda out, b: costs.softmax_cross_entropy(
+                out.reshape(-1, V), b["y"].reshape(-1)),
+            optimizer=optim.adam(1e-3), steps_per_call=K, grad_accum=M)
+        tr.init(jax.random.PRNGKey(0), batches[0])
+        return tr
+
+    p_fused, l_fused, _ = _run(make(4, 2), batches)
+    p_plain, l_plain, _ = _run(make(1, 2), batches)
+    assert l_fused == l_plain
+    _assert_trees_equal(p_fused, p_plain)

@@ -549,17 +549,30 @@ def test_megatron_sp_matches_unsharded_lm(nprng, rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-5)
 
+    def count_ar(hlo):
+        return hlo.count(" all-reduce(") + hlo.count(" all-reduce-start(")
+
+    # The TRAINING path's only all-reduces are the loss/count psums — the
+    # activation syncs are hand-written AG/RS. Compare RELATIVELY against
+    # the tp-only pjit lowering of the same loss on the same sharded
+    # params, which pays per-sublayer activation all-reduces (the sibling
+    # residual-sharding test uses the same relative form): an absolute
+    # budget pins XLA's exact op count and rots across versions.
     hlo = jax.jit(loss_sp).lower(params, inp).compile().as_text()
-    n_ar = hlo.count(" all-reduce(") + hlo.count(" all-reduce-start(")
-    # the TRAINING path's only all-reduces are the loss/count psums and the
-    # (variadic) grad syncs — a handful. Reintroduced activation
-    # all-reduces would add 4 per layer (8+ here), so a small budget
-    # separates the regimes without pinning XLA's exact op count.
     assert "reduce-scatter" in hlo, \
         "explicit Megatron-SP training must carry reduce-scatter syncs"
-    assert n_ar <= 6, \
-        f"loss path should carry only loss/grad psums, found {n_ar} " \
-        "all-reduces (activation ARs reintroduced?)"
+
+    def loss_tp(p, i):
+        lg = model.apply({"params": p}, i)
+        return jnp.mean(costs.softmax_cross_entropy(
+            lg.reshape(-1, V), tgt.reshape(-1)))
+
+    n_sp = count_ar(hlo)
+    n_tp = count_ar(jax.jit(loss_tp).lower(params, inp).compile().as_text())
+    assert n_sp < n_tp, \
+        f"explicit SP loss path should carry fewer all-reduces than the " \
+        f"tp-only pjit lowering (activation ARs reintroduced?): " \
+        f"{n_sp} vs {n_tp}"
     fwd_hlo = jax.jit(lambda p, i: apply_fn({"params": p}, i)).lower(
         params, inp).compile().as_text()
     assert "all-gather" in fwd_hlo and "reduce-scatter" in fwd_hlo, \
@@ -710,3 +723,138 @@ def test_megatron_sp_flash_matches_unsharded_lm(nprng, rng):
     got = jax.jit(lambda p, i: apply_fn({"params": p}, i))(params, inp)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_megatron_sp_bf16_policy_matches_pjit(nprng, rng):
+    """Mixed-precision parity (ISSUE 1 satellite 1): under
+    ``use_policy(bfloat16_compute)`` the explicit Megatron-SP path must
+    apply the SAME policy casts as the pjit path's Linears (cast_compute
+    operands, accumulate in accum_dtype) — the two lowerings of one model
+    must agree to bf16 tolerance, not silently diverge because the explicit
+    kernel ran f32."""
+    from jax.sharding import NamedSharding
+
+    from paddle_tpu.core.dtypes import bfloat16_compute, use_policy
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.nn import costs
+
+    mesh = pt.make_mesh({"data": 2, "model": 4})
+    V, D, T, B, H = 64, 32, 16, 4, 4
+    model = TransformerLM(vocab=V, dim=D, num_layers=2, num_heads=H,
+                          ffn_hidden=64, max_len=T)
+    ids = jnp.asarray(nprng.randint(0, V, (B, T)), jnp.int32)
+    tgt = jnp.asarray(nprng.randint(0, V, (B, T)), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    params = parallel.shard_tree(mesh, variables["params"],
+                                 parallel.megatron_sp_rules()(
+                                     variables["params"]))
+    inp = jax.device_put(ids, NamedSharding(mesh, P("data", None)))
+    tgt_s = jax.device_put(tgt, NamedSharding(mesh, P("data", None)))
+
+    # build the factory OUTSIDE the policy context, trace INSIDE: the
+    # policy must be read at trace time (as nn.layers.Linear reads it),
+    # not captured when the factory ran
+    loss_fn = parallel.make_megatron_sp_lm_apply(model, mesh,
+                                                 with_loss=True)
+    with use_policy(bfloat16_compute):
+        got = float(jax.jit(loss_fn)({"params": params}, inp, tgt_s))
+
+        def pjit_loss(p):
+            lg = model.apply({"params": p}, ids)
+            return jnp.mean(costs.softmax_cross_entropy(
+                lg.reshape(-1, V).astype(jnp.float32), tgt.reshape(-1)))
+
+        want = float(jax.jit(pjit_loss)(variables["params"]))
+    # both paths multiply bf16 operands with f32 accumulation; residual
+    # collectives reorder sums, so policy tolerance, not bit equality
+    np.testing.assert_allclose(got, want, rtol=5e-3)
+    # sanity: the bf16-policy loss must differ from an f32 trace by MORE
+    # than f32 roundoff (i.e. the casts actually happened)
+    f32_loss = parallel.make_megatron_sp_lm_apply(model, mesh,
+                                                  with_loss=True)
+    exact = float(jax.jit(f32_loss)({"params": params}, inp, tgt_s))
+    assert got != exact, "bf16 policy had no effect on the explicit path"
+
+
+def test_megatron_sp_remat_matches(nprng, rng):
+    """remat="dots" on the explicit Megatron-SP path (layer loop as a
+    jax.checkpoint'd lax.scan over stacked shard params) reproduces the
+    unrolled loop's loss and grads."""
+    from jax.sharding import NamedSharding
+
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.nn import costs
+
+    mesh = pt.make_mesh({"data": 2, "model": 4})
+    V, D, T, B, H = 64, 32, 16, 4, 4
+    model = TransformerLM(vocab=V, dim=D, num_layers=3, num_heads=H,
+                          ffn_hidden=64, max_len=T)
+    ids = jnp.asarray(nprng.randint(0, V, (B, T)), jnp.int32)
+    tgt = jnp.asarray(nprng.randint(0, V, (B, T)), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    params = parallel.shard_tree(mesh, variables["params"],
+                                 parallel.megatron_sp_rules()(
+                                     variables["params"]))
+    inp = jax.device_put(ids, NamedSharding(mesh, P("data", None)))
+    tgt_s = jax.device_put(tgt, NamedSharding(mesh, P("data", None)))
+
+    plain = parallel.make_megatron_sp_lm_apply(model, mesh, with_loss=True)
+    remat = parallel.make_megatron_sp_lm_apply(model, mesh, with_loss=True,
+                                               remat="dots")
+    lp = jax.jit(plain)({"params": params}, inp, tgt_s)
+    lr = jax.jit(remat)({"params": params}, inp, tgt_s)
+    np.testing.assert_allclose(float(lr), float(lp), rtol=1e-6)
+    gp = jax.jit(jax.grad(lambda p: plain({"params": p}, inp, tgt_s)))(
+        params)
+    gr = jax.jit(jax.grad(lambda p: remat({"params": p}, inp, tgt_s)))(
+        params)
+    for a, b in zip(jax.tree_util.tree_leaves(gp),
+                    jax.tree_util.tree_leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_loss_bubble_nonfinite_safe(nprng):
+    """Bubble devices run final_fn on a zero output buffer; a non-finite
+    value there (0/0 normalisation, log 0, ...) must NOT poison the psum —
+    regression for the ``val * mask`` NaN*0 masking (ISSUE 1 satellite 2:
+    now jnp.where-selected)."""
+    mesh = pt.make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    S, M, mbg, Din = 4, 6, 4, 8
+    w = jnp.asarray(nprng.normal(size=(S, Din, Din)).astype(np.float32) * .3)
+    x = jnp.asarray(nprng.normal(size=(M, mbg, Din)).astype(np.float32))
+
+    def stage_fn(p, a):
+        return jnp.tanh(a @ p["w"])
+
+    def final_fn(fp, outbuf):
+        # 0/0 on bubble devices (their outbuf is all zeros): mean over the
+        # buffer's nonzero entries — NaN on every stage but the last
+        nz = jnp.sum(jnp.abs(outbuf) > 0)
+        return jnp.sum(outbuf * fp["v"]) / nz
+
+    fp = {"v": jnp.asarray(nprng.normal(size=(Din,)).astype(np.float32))}
+    loss_sp = parallel.make_pipeline_loss(mesh, stage_fn, final_fn)
+    got = float(jax.jit(loss_sp)({"w": w}, fp, x))
+    assert np.isfinite(got), "bubble-device NaN poisoned the psum"
+    # the BACKWARD must survive too: an outer where alone still multiplies
+    # the zeroed cotangent into final_fn's inf partials (0 * inf = NaN) —
+    # the double-where (safe bubble input) keeps stage grads finite
+    grads = jax.jit(jax.grad(lambda sp: loss_sp(sp, fp, x)))({"w": w})
+    for g in jax.tree_util.tree_leaves(grads):
+        assert np.isfinite(np.asarray(g)).all(), \
+            "bubble-device NaN poisoned the backward"
+
+    # sequential oracle
+    def seq(w, fp, x):
+        outs = []
+        for m in range(M):
+            a = x[m]
+            for s in range(S):
+                a = jnp.tanh(a @ w[s])
+            outs.append(a)
+        ob = jnp.stack(outs)
+        return jnp.sum(ob * fp["v"]) / jnp.sum(jnp.abs(ob) > 0)
+
+    want = float(seq(w, fp, x))
+    np.testing.assert_allclose(got, want, rtol=2e-5)
