@@ -687,6 +687,17 @@ def bench_differential(name, n=None, k=None, budget=None):
 # CPU smoke gate: fused-vs-plain differential (ISSUE 1; runs in CI tier-1)
 # ---------------------------------------------------------------------------
 
+# Keys every telemetry JSONL step record must carry (the smoke gate and
+# tests/test_bench_smoke.py both enforce this schema — BENCH_* snapshots
+# carry the telemetry block going forward).
+TELEMETRY_STEP_KEYS = frozenset((
+    "kind", "ts", "pass", "step", "k_steps", "m", "loss",
+    "host_stack_ms", "shard_ms", "dispatch_ms", "device_ms", "replay_ms",
+    "compile_count", "retrace_count", "grad_norm", "param_norm",
+    "update_ratio", "nonfinite_count", "bytes_in_use", "peak_bytes",
+    "fenced"))
+
+
 def run_smoke(K=4, M=2, timing_passes=3):
     """Tiny-model fused-vs-plain gate, CPU-sized for CI: train the SAME
     batch stream through ``Trainer(steps_per_call=K, grad_accum=M)`` (one
@@ -695,7 +706,14 @@ def run_smoke(K=4, M=2, timing_passes=3):
     assert bit-identical f32 params and per-step losses, then time both hot
     loops post-compile and print ONE JSON line with the per-optimizer-step
     differential. Non-equal params exit non-zero — the fused path cannot
-    silently rot."""
+    silently rot.
+
+    ISSUE 2 extension: a third, telemetry-on fused run emits JSONL through
+    ``obs.Telemetry(sinks=[JsonlSink])``; the gate asserts the file parses
+    and every step record carries the required schema keys
+    (``TELEMETRY_STEP_KEYS``), and the output JSON carries the telemetry
+    summary (step breakdown, retrace count, est. MFU) so BENCH_* snapshots
+    record them going forward."""
     import jax.numpy as jnp   # noqa: F811 (module-level import is fine too)
     from paddle_tpu import optim
     from paddle_tpu.models import TransformerLM
@@ -708,14 +726,14 @@ def run_smoke(K=4, M=2, timing_passes=3):
                 "y": rng.randint(0, V, (bs, T)).astype(np.int32)}
                for _ in range(n_batches)]
 
-    def make(k_steps):
+    def make(k_steps, telemetry=None):
         tr = Trainer(
             model=TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
                                 ffn_hidden=64, max_len=T, remat="dots"),
             loss_fn=lambda out, b: costs.softmax_cross_entropy(
                 out.reshape(-1, V), b["y"].reshape(-1)),
             optimizer=optim.adam(1e-3), steps_per_call=k_steps,
-            grad_accum=M)
+            grad_accum=M, telemetry=telemetry)
         tr.init(jax.random.PRNGKey(0), batches[0])
         return tr
 
@@ -749,6 +767,40 @@ def run_smoke(K=4, M=2, timing_passes=3):
                 tr_plain.train_state.params))))
     fused_ms = timed(tr_fused) * 1e3      # post-compile hot-loop timing
     plain_ms = timed(tr_plain) * 1e3
+
+    # -- telemetry gate: short telemetry-on fused run, JSONL must parse and
+    # carry the required keys (ISSUE 2 satellite) -------------------------
+    import tempfile
+    from paddle_tpu.obs import InMemorySink, JsonlSink, Telemetry
+    jsonl_path = os.path.join(tempfile.mkdtemp(prefix="paddle_tpu_tel_"),
+                              "telemetry.jsonl")
+    tel = Telemetry(
+        sinks=[InMemorySink(), JsonlSink(jsonl_path)],
+        tokens_per_step=bs * T * M,
+        flops_per_step=M * transformer_train_flops(bs, T, 32, 2, V, 64))
+    tr_tel = make(K, telemetry=tel)
+    l_tel = run(tr_tel)
+    tel.close()
+    tel_records = []
+    jsonl_ok, missing = False, []
+    try:
+        with open(jsonl_path) as f:
+            tel_records = [json.loads(line) for line in f if line.strip()]
+        steps = [r for r in tel_records if r.get("kind") == "step"]
+        missing = sorted(TELEMETRY_STEP_KEYS
+                         - set(steps[0] if steps else {}))
+        jsonl_ok = (bool(steps) and not missing
+                    and all(r.get("device_ms") is not None for r in steps)
+                    and tel.compile_count >= 1)
+    except (OSError, json.JSONDecodeError) as e:
+        missing = [f"parse-error: {e}"]
+    telemetry = {"jsonl_records": len(tel_records), "jsonl_ok": jsonl_ok,
+                 # telemetry must not perturb the math: same loss stream
+                 "losses_equal_with_telemetry": l_tel == l_plain,
+                 **tel.summary()}
+    if missing:
+        telemetry["missing_keys"] = missing
+
     out = {
         "metric": "fused_vs_plain_smoke",
         "equal": bool(eq_params and eq_losses),
@@ -759,9 +811,11 @@ def run_smoke(K=4, M=2, timing_passes=3):
         "fused_vs_plain_speedup": round(plain_ms / fused_ms, 3),
         "final_loss": round(l_fused[-1], 4) if l_fused else None,
         "device": jax.devices()[0].device_kind,
+        "telemetry": telemetry,
     }
     print(json.dumps(out))
-    return 0 if out["equal"] else 1
+    ok = out["equal"] and jsonl_ok and telemetry["losses_equal_with_telemetry"]
+    return 0 if ok else 1
 
 
 # ---------------------------------------------------------------------------
@@ -1015,6 +1069,21 @@ def main():
             "environment": environment,
             "all_metrics": {r["metric"]: r for r in results.values()
                             if "metric" in r}}
+    # ISSUE 2: the telemetry gate's summary (step breakdown, retrace count,
+    # est. MFU) rides every full BENCH_* snapshot going forward. Runs in
+    # the pinned-CPU smoke subprocess; a failure is recorded, not fatal.
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        res = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"), "--smoke"],
+            cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=900)
+        smoke = json.loads(res.stdout.strip().splitlines()[-1])
+        full["telemetry_smoke"] = smoke.get("telemetry",
+                                            {"error": "no telemetry block"})
+    except (subprocess.TimeoutExpired, ValueError, IndexError,
+            OSError) as e:
+        full["telemetry_smoke"] = {"error": str(e)[-300:]}
     if errors:
         full["bench_errors"] = errors
     # Full protocol detail goes to a committed sidecar and is printed BEFORE

@@ -12,6 +12,7 @@ __version__ = "0.1.0"
 from . import core
 from .core import (Module, Sequential, SeqBatch, initializers, make_mesh,
                    default_mesh, use_mesh)
+from . import obs
 from . import parallel
 from . import inference
 from .inference import export, infer, load_inference_model

@@ -75,17 +75,23 @@ class TransformerBlock(Module):
         self.dropout = Dropout(dropout) if dropout else None
 
     def forward(self, x, train: bool = False, segments=None):
-        h = x + self._maybe_drop(
-            self.attn(self.ln1(x), causal=True, segments=segments), train)
+        # named_scope: profiler traces (utils/stats.py:profile_trace) show
+        # model structure instead of anonymous fusions — trace-time
+        # metadata only, zero runtime effect.
+        with jax.named_scope("attn"):
+            h = x + self._maybe_drop(
+                self.attn(self.ln1(x), causal=True, segments=segments),
+                train)
         if self.residual_sharding is not None:
             h = self.residual_sharding(h)
-        z = self.ln2(h)
-        if self.moe_experts > 0:
-            y, aux = self.ffn(z, return_aux=True)
-        else:
-            y = self.ffn2(self.ffn1(z))
-            aux = jnp.zeros((), jnp.float32)
-        out = h + self._maybe_drop(y, train)
+        with jax.named_scope("ffn"):
+            z = self.ln2(h)
+            if self.moe_experts > 0:
+                y, aux = self.ffn(z, return_aux=True)
+            else:
+                y = self.ffn2(self.ffn1(z))
+                aux = jnp.zeros((), jnp.float32)
+            out = h + self._maybe_drop(y, train)
         if self.residual_sharding is not None:
             out = self.residual_sharding(out)
         return out, aux
@@ -158,7 +164,8 @@ class TransformerLM(Module):
         T = ids.shape[1]
         assert T <= self.max_len, f"T={T} exceeds max_len={self.max_len}"
         pos = jnp.arange(T)[None] if positions is None else positions
-        x = self.emb(ids) + self.pos(pos)
+        with jax.named_scope("embed"):
+            x = self.emb(ids) + self.pos(pos)
         if self.residual_sharding is not None:
             x = self.residual_sharding(x)
         if self.remat is not None and not is_initializing():
@@ -168,10 +175,12 @@ class TransformerLM(Module):
         else:
             aux_total = jnp.zeros((), jnp.float32)
             for blk in self.blocks:
-                x, aux = blk(x, train=train, segments=segments)
+                with jax.named_scope(blk._name):
+                    x, aux = blk(x, train=train, segments=segments)
                 aux_total = aux_total + aux
-        x = self.ln_f(x)
-        logits = self.emb.attend(x)          # tied softmax weights
+        with jax.named_scope("head"):
+            x = self.ln_f(x)
+            logits = self.emb.attend(x)      # tied softmax weights
         if return_aux:
             return logits, aux_total
         return logits
@@ -192,8 +201,9 @@ class TransformerLM(Module):
 
         def body(carry, bp):
             h, aux = carry
-            y, a = block0.apply({"params": {block0._name: bp}}, h,
-                                train=train, segments=segments)
+            with jax.named_scope("block_scan"):
+                y, a = block0.apply({"params": {block0._name: bp}}, h,
+                                    train=train, segments=segments)
             return (y, aux + a), None
 
         body = jax.checkpoint(body, policy=remat_policy(self.remat))

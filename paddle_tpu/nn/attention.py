@@ -154,9 +154,12 @@ class MultiHeadAttention(Module):
             return jnp.dot(pol.cast_compute(x), pol.cast_compute(w),
                            preferred_element_type=pol.accum_dtype)
 
-        q = proj("wq", q_in, h * hd).reshape(*q_in.shape[:2], h, hd)
-        k = proj("wk", kv_in, h * hd).reshape(*kv_in.shape[:2], h, hd)
-        v = proj("wv", kv_in, h * hd).reshape(*kv_in.shape[:2], h, hd)
+        # named_scope annotations: profiler traces resolve the projections
+        # and the attention core by name instead of anonymous fusions.
+        with jax.named_scope("qkv_proj"):
+            q = proj("wq", q_in, h * hd).reshape(*q_in.shape[:2], h, hd)
+            k = proj("wk", kv_in, h * hd).reshape(*kv_in.shape[:2], h, hd)
+            v = proj("wv", kv_in, h * hd).reshape(*kv_in.shape[:2], h, hd)
         impl = self.attention_impl
         if impl == "flash":
             self._fast_path_checks(q_in, kv_in, mask)
@@ -169,11 +172,12 @@ class MultiHeadAttention(Module):
             # block sizes auto-select in the kernel (large blocks: the
             # per-grid-step overhead dominated at the old fixed 128 —
             # measured 5x per-layer, experiments/profile_transformer.py)
-            ctx = flash_attention(jnp.moveaxis(q, 2, 1),
-                                  jnp.moveaxis(k, 2, 1),
-                                  jnp.moveaxis(v, 2, 1),
-                                  segments, causal)
-            ctx = jnp.moveaxis(ctx, 1, 2).astype(pol.compute_dtype)
+            with jax.named_scope("flash_attention"):
+                ctx = flash_attention(jnp.moveaxis(q, 2, 1),
+                                      jnp.moveaxis(k, 2, 1),
+                                      jnp.moveaxis(v, 2, 1),
+                                      segments, causal)
+                ctx = jnp.moveaxis(ctx, 1, 2).astype(pol.compute_dtype)
         elif impl in ("ring", "seq"):
             self._fast_path_checks(q_in, kv_in, mask)
             if impl == "ring":
@@ -183,22 +187,25 @@ class MultiHeadAttention(Module):
             attn = make(self.seq_mesh, seq_axis=self.seq_axis,
                         batch_axis=self.batch_axis, causal=causal,
                         with_segments=segments is not None)
-            ctx = (attn(q, k, v, segments) if segments is not None
-                   else attn(q, k, v)).astype(pol.compute_dtype)
+            with jax.named_scope(f"{impl}_attention"):
+                ctx = (attn(q, k, v, segments) if segments is not None
+                       else attn(q, k, v)).astype(pol.compute_dtype)
         else:
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-            logits = logits.astype(jnp.float32)
-            if causal:
-                Tq, Tk = logits.shape[-2:]
-                cm = jnp.tril(jnp.ones((Tq, Tk), bool))
-                logits = jnp.where(cm[None, None], logits, -1e9)
-            if segments is not None:
-                sm = (segments[:, :, None] == segments[:, None, :]) \
-                    & (segments[:, :, None] > 0)
-                logits = jnp.where(sm[:, None], logits, -1e9)
-            if mask is not None:
-                logits = jnp.where(mask[:, None, :, :] > 0, logits, -1e9)
-            w = jax.nn.softmax(logits, axis=-1).astype(pol.compute_dtype)
-            ctx = jnp.einsum("bhqk,bkhd->bqhd", w, v)
+            with jax.named_scope("sdpa_xla"):
+                logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+                logits = logits.astype(jnp.float32)
+                if causal:
+                    Tq, Tk = logits.shape[-2:]
+                    cm = jnp.tril(jnp.ones((Tq, Tk), bool))
+                    logits = jnp.where(cm[None, None], logits, -1e9)
+                if segments is not None:
+                    sm = (segments[:, :, None] == segments[:, None, :]) \
+                        & (segments[:, :, None] > 0)
+                    logits = jnp.where(sm[:, None], logits, -1e9)
+                if mask is not None:
+                    logits = jnp.where(mask[:, None, :, :] > 0, logits, -1e9)
+                w = jax.nn.softmax(logits, axis=-1).astype(pol.compute_dtype)
+                ctx = jnp.einsum("bhqk,bkhd->bqhd", w, v)
         ctx = ctx.reshape(*q_in.shape[:2], h * hd)
-        return proj("wo", ctx, out_d)
+        with jax.named_scope("out_proj"):
+            return proj("wo", ctx, out_d)

@@ -1,0 +1,33 @@
+"""Observability subsystem — the ``Stat.h``/``REGISTER_TIMER`` successor
+for the fused hot loop (ISSUE 2).
+
+Three layers:
+
+- :mod:`~paddle_tpu.obs.sinks` — pluggable record consumers (in-memory,
+  JSONL file, logging).
+- :mod:`~paddle_tpu.obs.health` — device-side training-health scalars
+  (grad/param/update norms, update ratio, NaN/Inf sentinel) traced into
+  the compiled step.
+- :mod:`~paddle_tpu.obs.telemetry` — the :class:`Telemetry` object the
+  Trainer drives: per-call step-time breakdown (host stack / shard /
+  dispatch / fenced device / events-replay), retrace+compile tracking
+  keyed by step fingerprint with HLO cost-analysis FLOPs, MFU and
+  tokens/sec accounting, and device-memory peak sampling.
+
+Attach with ``Trainer(..., telemetry=Telemetry(sinks=[JsonlSink(path)]))``.
+With no Telemetry attached the hot loop is unchanged: same traced step,
+same dispatch count, same donation, zero extra device fetches.
+"""
+
+from .health import (HEALTH_KEYS, health_scalars, tree_l2_norm,
+                     tree_nonfinite_count)
+from .sinks import InMemorySink, JsonlSink, LoggingSink, Sink
+from .telemetry import (PEAK_FLOPS, Telemetry, device_memory_stats,
+                        device_peak_flops, lowered_hlo_flops)
+
+__all__ = [
+    "Telemetry", "Sink", "InMemorySink", "JsonlSink", "LoggingSink",
+    "HEALTH_KEYS", "health_scalars", "tree_l2_norm", "tree_nonfinite_count",
+    "PEAK_FLOPS", "device_peak_flops", "lowered_hlo_flops",
+    "device_memory_stats",
+]

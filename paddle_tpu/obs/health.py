@@ -1,0 +1,63 @@
+"""Training-health monitors — device-side scalars extending the
+overflow-trip machinery (``Trainer(nan_check=True)``; the reference's
+feenableexcept trap, ``TrainerMain.cpp:36``) with the standard
+loss-scale-era diagnostics: global gradient norm, global parameter norm,
+update/param ratio, and a NaN/Inf sentinel.
+
+Everything here is traced INTO the compiled train step (pure jnp on the
+gradient/update/param pytrees — a handful of reduce ops XLA fuses into the
+step program), so monitoring adds no extra device dispatch: the scalars
+ride the step's existing outputs and the host fetches them with the same
+per-call sync that already fetches the losses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["HEALTH_KEYS", "tree_l2_norm", "tree_nonfinite_count",
+           "health_scalars"]
+
+# The fixed key set every health pytree carries (schema contract for the
+# JSONL sink and its tests).
+HEALTH_KEYS = ("grad_norm", "param_norm", "update_norm", "update_ratio",
+               "nonfinite_count")
+
+
+def tree_l2_norm(tree) -> jax.Array:
+    """Global L2 norm over every leaf of a pytree (f32 accumulation)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(sq)
+
+
+def tree_nonfinite_count(tree) -> jax.Array:
+    """Total count of NaN/Inf elements across a pytree (int32)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.int32)
+    return sum(jnp.sum(~jnp.isfinite(l.astype(jnp.float32))).astype(jnp.int32)
+               for l in leaves)
+
+
+def health_scalars(grads, updates, new_params, loss) -> dict:
+    """The per-step health pytree (dict of scalars), traced inside the
+    compiled step. ``update_ratio`` is ||update|| / ||param|| — the
+    learning-dynamics sanity signal (healthy training sits around 1e-3;
+    a collapse toward 0 or blow-up toward 1 flags a bad LR). The sentinel
+    counts non-finite elements in the gradients plus the step loss."""
+    gn = tree_l2_norm(grads)
+    pn = tree_l2_norm(new_params)
+    un = tree_l2_norm(updates)
+    bad = tree_nonfinite_count(grads) + \
+        (~jnp.isfinite(loss.astype(jnp.float32))).astype(jnp.int32)
+    return {
+        "grad_norm": gn,
+        "param_norm": pn,
+        "update_norm": un,
+        "update_ratio": un / jnp.maximum(pn, 1e-12),
+        "nonfinite_count": bad,
+    }

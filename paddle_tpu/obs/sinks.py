@@ -1,0 +1,110 @@
+"""Telemetry sinks — where :class:`~paddle_tpu.obs.Telemetry` records go.
+
+A sink consumes finished record dicts (JSON-serializable by construction:
+the Telemetry layer converts device scalars to Python floats before
+emitting). Three built-ins cover the reference's output surfaces: in-memory
+(tests/notebooks), JSONL file (the machine-readable ``printAllStatus``
+successor — one record per line, append-only, crash-tolerant), and the
+logging module (human eyes).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Sink", "InMemorySink", "JsonlSink", "LoggingSink"]
+
+
+class Sink:
+    """Base sink: ``emit(record)`` consumes one record; ``close()`` releases
+    resources. Sinks must tolerate being closed twice."""
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemorySink(Sink):
+    """Keeps every record in ``self.records`` — the test/notebook sink."""
+
+    def __init__(self):
+        self.records: List[Dict[str, Any]] = []
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        self.records.append(record)
+
+    def by_kind(self, kind: str) -> List[Dict[str, Any]]:
+        return [r for r in self.records if r.get("kind") == kind]
+
+
+class JsonlSink(Sink):
+    """One JSON object per line, flushed per emit (a record is never half
+    on disk after a crash — the CRC'd-checkpoint philosophy applied to
+    telemetry). The file opens lazily on first emit so constructing a
+    Telemetry never touches the filesystem."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+        self._lock = threading.Lock()
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            if self._f is None:
+                self._f = open(self.path, "a")
+            self._f.write(json.dumps(record) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    @staticmethod
+    def read(path: str) -> List[Dict[str, Any]]:
+        """Parse a JSONL telemetry file back into record dicts."""
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
+
+
+class LoggingSink(Sink):
+    """Compact per-record line through the stdlib logging module."""
+
+    _STEP_KEYS = ("step", "loss", "host_stack_ms", "shard_ms", "dispatch_ms",
+                  "device_ms", "replay_ms", "retrace_count", "grad_norm",
+                  "tokens_per_sec", "est_mfu_pct", "peak_bytes")
+
+    def __init__(self, logger: Optional[logging.Logger] = None,
+                 level: int = logging.INFO):
+        self.logger = logger or logging.getLogger("paddle_tpu.telemetry")
+        self.level = level
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        kind = record.get("kind", "step")
+        if kind == "compile":
+            self.logger.log(self.level,
+                            "telemetry compile #%s wall=%.3fs flops=%s %s",
+                            record.get("compile_count"),
+                            record.get("wall_s", float("nan")),
+                            record.get("hlo_flops"),
+                            record.get("fingerprint", ""))
+            return
+        parts = []
+        for k in self._STEP_KEYS:
+            v = record.get(k)
+            if v is None:
+                continue
+            parts.append(f"{k}={v:.4g}" if isinstance(v, float) else
+                         f"{k}={v}")
+        self.logger.log(self.level, "telemetry %s %s", kind, " ".join(parts))

@@ -1,0 +1,313 @@
+"""The telemetry core — per-call step-time breakdown, compile/retrace
+tracking, MFU accounting, and device-memory sampling for the fused hot
+loop.
+
+Design rules (README "Observability" has the long form):
+
+- **Fencing.** Device work is async: a dispatch returns as soon as XLA has
+  enqueued the program, so a wall-clock timer around the call measures
+  *dispatch* cost, not compute. Device time therefore requires a
+  ``jax.block_until_ready`` fence on the call's outputs — which serializes
+  the pipeline. Telemetry owns that fence and ONLY installs it when
+  telemetry is on.
+- **Zero overhead when off.** With no Telemetry attached the trainer's hot
+  loop is byte-identical to the untelemetered build: same traced step
+  function (no health outputs), same dispatch count, same donation, zero
+  extra device fetches (``tests/test_obs.py`` pins this).
+- **Compile observability.** The trainer keys every dispatch by its *step
+  fingerprint* — (K, M, leaf shapes/dtypes) of the stacked group — and
+  reports a new fingerprint to :meth:`Telemetry.observe_fingerprint`. The
+  first fingerprint is the initial compile; each later one is a RETRACE
+  (jit cache miss) — the silent step-time doubler this counter exists to
+  surface. Per-compile wall time is the first call's dispatch wall (trace +
+  compile + enqueue), and an HLO ``cost_analysis()``-derived FLOPs estimate
+  (from the un-compiled Lowered, so it costs one extra trace, not a second
+  compile) feeds the live MFU / tokens-per-second metric.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+
+from .sinks import InMemorySink, JsonlSink, LoggingSink, Sink
+from .health import HEALTH_KEYS
+
+__all__ = ["Telemetry", "PEAK_FLOPS", "device_peak_flops",
+           "lowered_hlo_flops", "device_memory_stats"]
+
+_log = logging.getLogger("paddle_tpu.telemetry")
+
+# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets) — the
+# MFU denominator. bench.py consumes this table too.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+    "TPU v2": 46e12,
+}
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Spec-sheet peak FLOP/s for ``device`` (default: first local device);
+    None when the device kind has no published entry (e.g. CPU)."""
+    device = device or jax.devices()[0]
+    return PEAK_FLOPS.get(getattr(device, "device_kind", ""))
+
+
+def lowered_hlo_flops(lowered) -> Optional[float]:
+    """FLOPs estimate from a ``jax.stages.Lowered``'s ``cost_analysis()``
+    (XLA's HLO-level count; no compile needed). Returns None when the
+    backend doesn't implement cost analysis."""
+    try:
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):        # older jax returns [dict]
+            ca = ca[0] if ca else {}
+        flops = ca.get("flops")
+        return float(flops) if flops is not None else None
+    except Exception:                            # pragma: no cover - backend
+        return None
+
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """``device.memory_stats()`` with the None/unimplemented cases folded to
+    an empty dict (CPU returns None; some plugins raise)."""
+    device = device or jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:                            # pragma: no cover - backend
+        return {}
+    if not stats:
+        return {}
+    return {k: int(v) for k, v in stats.items()
+            if isinstance(v, (int, np.integer))}
+
+
+def _scalar(x):
+    """Device/npy scalar -> strict-JSON-safe value: finite floats pass
+    through, NaN/Inf become None (json.dumps would emit bare ``NaN``
+    literals otherwise — invalid per RFC 8259, breaking strict parsers on
+    exactly the diagnostic file a NaN run produces). The
+    ``nonfinite_count`` sentinel (always a finite count) carries the
+    poisoned-run signal."""
+    v = float(np.asarray(x))
+    return v if np.isfinite(v) else None
+
+
+class Telemetry:
+    """Pluggable-sink telemetry for the training hot loop.
+
+    Args:
+      sinks: Sink instances (or the classes themselves — ``JsonlSink``
+        still needs a path, so classes only work for no-arg sinks);
+        defaults to one :class:`InMemorySink`.
+      health: trace the health monitors (grad/param/update norms, NaN
+        sentinel) into the compiled step. Costs a few fused reduces on
+        device; off by default only when the caller says so.
+      memory: sample ``device.memory_stats()`` once per fused call.
+      fence: block on the call's outputs to measure true device time.
+        Turn off on pathological transports where ``block_until_ready``
+        does not fence (experiments/PERF.md "Incident") — dispatch time
+        and throughput-derived metrics remain.
+      flops_per_step: analytic FLOPs per optimizer step (e.g.
+        ``bench.transformer_train_flops``). When absent, the HLO
+        cost-analysis estimate (per *call*, i.e. K steps) is used.
+      tokens_per_step: tokens consumed per optimizer step — enables
+        ``tokens_per_sec`` in step records.
+      peak_flops: MFU denominator override (defaults to the spec-sheet
+        table keyed by device kind; None on CPU, which disables MFU).
+    """
+
+    def __init__(self, sinks: Optional[Sequence[Sink]] = None,
+                 health: bool = True, memory: bool = True,
+                 fence: bool = True,
+                 flops_per_step: Optional[float] = None,
+                 tokens_per_step: Optional[float] = None,
+                 peak_flops: Optional[float] = None):
+        if sinks is None:
+            sinks = [InMemorySink()]
+        self.sinks: List[Sink] = [s() if isinstance(s, type) else s
+                                  for s in sinks]
+        self.health = health
+        self.memory = memory
+        self.fence = fence
+        self.flops_per_step = flops_per_step
+        self.tokens_per_step = tokens_per_step
+        self._peak_flops = peak_flops
+        # compile tracking
+        self._fingerprints: Dict[Any, int] = {}
+        self.compile_count = 0
+        self.retrace_count = 0
+        self.hlo_flops_per_call: Optional[float] = None
+        # memory peaks
+        self.peak_bytes: Optional[int] = None       # per-pass peak
+        self.peak_bytes_run: Optional[int] = None   # whole-run peak
+        # latest health scalars (host-side, refreshed per call)
+        self.last_health: Dict[str, float] = {}
+        self._steps_emitted = 0
+
+    # -- compile / retrace -------------------------------------------------
+
+    def observe_fingerprint(self, fingerprint) -> bool:
+        """Report a dispatch's step fingerprint. Returns True when it is
+        NEW (this dispatch will trace + compile). The first fingerprint is
+        the initial compile; later new ones increment ``retrace_count``."""
+        if fingerprint in self._fingerprints:
+            return False
+        self._fingerprints[fingerprint] = self.compile_count
+        self.compile_count += 1
+        if self.compile_count > 1:
+            self.retrace_count += 1
+        return True
+
+    def record_compile(self, fingerprint, wall_s: float,
+                       hlo_flops: Optional[float] = None,
+                       meta: Optional[Dict[str, Any]] = None) -> None:
+        """Emit a compile record (fires once per new fingerprint)."""
+        if hlo_flops is not None:
+            self.hlo_flops_per_call = hlo_flops
+        rec = {"kind": "compile", "ts": time.time(),
+               "fingerprint": str(fingerprint),
+               "compile_count": self.compile_count,
+               "retrace_count": self.retrace_count,
+               "wall_s": round(float(wall_s), 6),
+               "hlo_flops": hlo_flops}
+        if meta:
+            rec.update(meta)
+        self._emit(rec)
+
+    # -- memory ------------------------------------------------------------
+
+    def begin_pass(self, pass_id: int) -> None:
+        """Reset the per-pass memory peak (whole-run peak persists)."""
+        self.peak_bytes = None
+
+    def sample_memory(self) -> Optional[int]:
+        """Sample device memory; returns current bytes-in-use (None when
+        the backend reports nothing, e.g. CPU). The per-pass peak is the
+        max of the bytes-in-use SAMPLES this pass (the device's own
+        ``peak_bytes_in_use`` counter is process-monotonic — it never
+        resets, so it can only feed the whole-run peak); sampling
+        happens once per fused call, so short intra-call spikes between
+        samples are not observed."""
+        if not self.memory:
+            return None
+        stats = device_memory_stats()
+        if not stats:
+            return None
+        cur = stats.get("bytes_in_use")
+        dev_peak = stats.get("peak_bytes_in_use")
+        if cur is not None:
+            self.peak_bytes = max(self.peak_bytes or 0, cur)
+        run_cand = dev_peak if dev_peak is not None else cur
+        if run_cand is not None:
+            self.peak_bytes_run = max(self.peak_bytes_run or 0, run_cand)
+        return cur
+
+    # -- step records --------------------------------------------------------
+
+    def emit_step(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        """Finalize and emit one per-call step record. The caller provides
+        the breakdown fields; this layer attaches compile counters, memory,
+        health, and the throughput/MFU derivations. Returns the finalized
+        record (the exact object the sinks received — the trainer hands it
+        to ``events.TelemetryRecord``)."""
+        rec = {"kind": "step", "ts": time.time()}
+        rec.update(record)
+        rec["compile_count"] = self.compile_count
+        rec["retrace_count"] = self.retrace_count
+        cur = self.sample_memory()
+        rec["bytes_in_use"] = cur
+        rec["peak_bytes"] = self.peak_bytes
+        rec.update(self.last_health)
+        for k in HEALTH_KEYS:          # fixed schema even with health=False
+            rec.setdefault(k, None)
+        # throughput / MFU: prefer true device time (fenced); fall back to
+        # dispatch wall when fencing is off (labelled by fenced=False)
+        k_steps = rec.get("k_steps") or 1
+        dev_s = rec.get("device_ms")
+        disp_s = rec.get("dispatch_ms")
+        total_ms = (dev_s or 0.0) + (disp_s or 0.0)
+        rec["fenced"] = bool(self.fence and dev_s is not None)
+        if total_ms > 0:
+            per_step_s = total_ms * 1e-3 / k_steps
+            if self.tokens_per_step:
+                rec["tokens_per_sec"] = round(
+                    self.tokens_per_step / per_step_s, 2)
+            flops = self.flops_per_step
+            if flops is None and self.hlo_flops_per_call:
+                flops = self.hlo_flops_per_call / k_steps
+            peak = (self._peak_flops if self._peak_flops is not None
+                    else device_peak_flops())
+            rec["est_mfu_pct"] = (
+                round(100.0 * flops / per_step_s / peak, 2)
+                if (flops and peak) else None)
+        # strict-JSON guarantee: no bare NaN/Inf literals reach a sink
+        # (a NaN loss would otherwise break every downstream parser)
+        for k, v in rec.items():
+            if isinstance(v, float) and not np.isfinite(v):
+                rec[k] = None
+        self._steps_emitted += 1
+        self._emit(rec)
+        return rec
+
+    def update_health(self, health_host: Dict[str, Any]) -> Dict[str, float]:
+        """Record the latest fetched health scalars (host values for ONE
+        optimizer step). Returns the JSON-safe dict it stored."""
+        out = {}
+        for k in HEALTH_KEYS:
+            if k in health_host:
+                out[k] = _scalar(health_host[k])
+        self.last_health = out
+        return out
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        for s in self.sinks:
+            try:
+                s.emit(rec)
+            except Exception:                    # a broken sink must never
+                _log.exception("telemetry sink %r failed", s)  # kill training
+
+    def close(self) -> None:
+        for s in self.sinks:
+            try:
+                s.close()
+            except Exception:
+                _log.exception("telemetry sink %r close failed", s)
+
+    # -- summaries -----------------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        """Aggregate view (bench.py wires this into its output JSON)."""
+        mem = self.peak_bytes_run
+        out = {"steps_emitted": self._steps_emitted,
+               "compile_count": self.compile_count,
+               "retrace_count": self.retrace_count,
+               "hlo_flops_per_call": self.hlo_flops_per_call,
+               "peak_bytes": mem}
+        for s in self.sinks:
+            if isinstance(s, InMemorySink) and s.records:
+                steps = s.by_kind("step")
+                if steps:
+                    for key in ("host_stack_ms", "shard_ms", "dispatch_ms",
+                                "device_ms", "replay_ms"):
+                        vals = [r[key] for r in steps
+                                if r.get(key) is not None]
+                        if vals:
+                            out[f"mean_{key}"] = round(
+                                float(np.mean(vals)), 4)
+                    last = steps[-1]
+                    for key in ("tokens_per_sec", "est_mfu_pct",
+                                "grad_norm"):
+                        if last.get(key) is not None:
+                            out[key] = last[key]
+                break
+        return out

@@ -149,20 +149,22 @@ def make_megatron_sp_lm_apply(model, mesh: Mesh, data_axis: str = "data",
         XLA's simplifier otherwise reorders convert across the collective
         and cancels the pair, silently restoring f32 wire (observed on
         the CPU backend)."""
-        if comm_dtype is None:
-            return lax.all_gather(z, model_axis, axis=1, tiled=True)
-        zb = lax.optimization_barrier(z.astype(comm_dtype))
-        return lax.all_gather(zb, model_axis, axis=1,
-                              tiled=True).astype(z.dtype)
+        with jax.named_scope("sp_allgather"):
+            if comm_dtype is None:
+                return lax.all_gather(z, model_axis, axis=1, tiled=True)
+            zb = lax.optimization_barrier(z.astype(comm_dtype))
+            return lax.all_gather(zb, model_axis, axis=1,
+                                  tiled=True).astype(z.dtype)
 
     def _rs(part):
         """Sequence reduce-scatter of row-parallel partial sums."""
-        if comm_dtype is None:
-            return lax.psum_scatter(part, model_axis,
-                                    scatter_dimension=1, tiled=True)
-        pb = lax.optimization_barrier(part.astype(comm_dtype))
-        return lax.psum_scatter(pb, model_axis, scatter_dimension=1,
-                                tiled=True).astype(part.dtype)
+        with jax.named_scope("sp_reduce_scatter"):
+            if comm_dtype is None:
+                return lax.psum_scatter(part, model_axis,
+                                        scatter_dimension=1, tiled=True)
+            pb = lax.optimization_barrier(part.astype(comm_dtype))
+            return lax.psum_scatter(pb, model_axis, scatter_dimension=1,
+                                    tiled=True).astype(part.dtype)
 
     from ..core.dtypes import current_policy
 
@@ -199,23 +201,26 @@ def make_megatron_sp_lm_apply(model, mesh: Mesh, data_axis: str = "data",
 
     def _block_local(x, bp):
         """One transformer block on this device's shards — the Megatron-SP
-        AG -> column -> row -> RS recipe for both sublayers."""
+        AG -> column -> row -> RS recipe for both sublayers. named_scope
+        annotations expose the tp regions in profiler traces."""
         # attention sublayer: AG(seq) -> column qkv -> row wo -> RS(seq)
-        z = _layernorm(x, bp["ln1"])
-        zg = _ag(z)
-        hl = H // tp
-        q = _dot(zg, bp["attn"]["wq"]).reshape(*zg.shape[:2], hl, hd)
-        k = _dot(zg, bp["attn"]["wk"]).reshape(*zg.shape[:2], hl, hd)
-        v = _dot(zg, bp["attn"]["wv"]).reshape(*zg.shape[:2], hl, hd)
-        ctx = _attend_local(q, k, v).reshape(*zg.shape[:2], hl * hd)
-        part = _dot(ctx, bp["attn"]["wo"])     # partial over model
-        x = x + _rs(part)
+        with jax.named_scope("tp_attn"):
+            z = _layernorm(x, bp["ln1"])
+            zg = _ag(z)
+            hl = H // tp
+            q = _dot(zg, bp["attn"]["wq"]).reshape(*zg.shape[:2], hl, hd)
+            k = _dot(zg, bp["attn"]["wk"]).reshape(*zg.shape[:2], hl, hd)
+            v = _dot(zg, bp["attn"]["wv"]).reshape(*zg.shape[:2], hl, hd)
+            ctx = _attend_local(q, k, v).reshape(*zg.shape[:2], hl * hd)
+            part = _dot(ctx, bp["attn"]["wo"])     # partial over model
+            x = x + _rs(part)
         # FFN sublayer: AG(seq) -> column ffn1 -> row ffn2 -> RS(seq)
-        z = _layernorm(x, bp["ln2"])
-        zg = _ag(z)
-        h1 = gelu(_dot(zg, bp["ffn1"]["w"]) + bp["ffn1"]["b"])
-        part = _dot(h1, bp["ffn2"]["w"])
-        return x + _rs(part) + bp["ffn2"]["b"]
+        with jax.named_scope("tp_ffn"):
+            z = _layernorm(x, bp["ln2"])
+            zg = _ag(z)
+            h1 = gelu(_dot(zg, bp["ffn1"]["w"]) + bp["ffn1"]["b"])
+            part = _dot(h1, bp["ffn2"]["w"])
+            return x + _rs(part) + bp["ffn2"]["b"]
 
     def _forward_local(params, ids):
         """Per-device body. ``params``: this device's shards (column/row
@@ -227,13 +232,15 @@ def make_megatron_sp_lm_apply(model, mesh: Mesh, data_axis: str = "data",
         assert T % tp == 0, f"seq len {T} must divide by tp {tp}"
         Tl = T // tp
         # ---- embed: each device embeds only ITS seq slice (sp) ----------
-        sl = lax.dynamic_slice_in_dim(ids, midx * Tl, Tl, axis=1)
-        emb_w = root["emb"]["w"]
-        pos_w = root["pos"]["w"]
-        valid = (sl >= 0) & (sl < emb_w.shape[0])    # Embedding.forward's
-        x = jnp.take(emb_w, jnp.clip(sl, 0, emb_w.shape[0] - 1), axis=0)
-        x = x * valid[..., None].astype(x.dtype)     # zero-for-padding rule
-        x = x + jnp.take(pos_w, jnp.arange(Tl) + midx * Tl, axis=0)[None]
+        with jax.named_scope("sp_embed"):
+            sl = lax.dynamic_slice_in_dim(ids, midx * Tl, Tl, axis=1)
+            emb_w = root["emb"]["w"]
+            pos_w = root["pos"]["w"]
+            valid = (sl >= 0) & (sl < emb_w.shape[0])  # Embedding.forward's
+            x = jnp.take(emb_w, jnp.clip(sl, 0, emb_w.shape[0] - 1), axis=0)
+            x = x * valid[..., None].astype(x.dtype)   # zero-for-padding
+            x = x + jnp.take(pos_w, jnp.arange(Tl) + midx * Tl,
+                             axis=0)[None]
         # (the residual stream stays in the embedding-table dtype — the
         # pjit path never casts it; only matmul operands drop to the
         # policy's compute dtype inside _dot)
@@ -253,8 +260,9 @@ def make_megatron_sp_lm_apply(model, mesh: Mesh, data_axis: str = "data",
             body = jax.checkpoint(body, policy=remat_policy(remat))
             x, _ = lax.scan(body, x, stacked)
         # ---- head: final LN + tied readout on the local seq rows --------
-        z = _layernorm(x, root["ln_f"])
-        return z @ emb_w.T.astype(z.dtype)
+        with jax.named_scope("sp_head"):
+            z = _layernorm(x, root["ln_f"])
+            return z @ emb_w.T.astype(z.dtype)
 
     rules = megatron_sp_rules()
 

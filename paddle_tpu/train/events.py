@@ -11,7 +11,7 @@ import dataclasses
 from typing import Any, Dict, Optional
 
 __all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration",
-           "TestResult"]
+           "TestResult", "TelemetryRecord"]
 
 
 @dataclasses.dataclass
@@ -45,3 +45,14 @@ class TestResult:
     pass_id: int
     cost: float
     metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TelemetryRecord:
+    """Fired once per telemetry step record (fused: one per device call,
+    AFTER the call's event replay; plain: one per step) when a
+    :class:`paddle_tpu.obs.Telemetry` is attached to the Trainer. The
+    ``record`` dict is the same JSON-safe object the sinks received —
+    step-time breakdown, retrace/compile counters, health scalars, memory.
+    Never fired for untelemetered runs."""
+    record: Dict[str, Any] = dataclasses.field(default_factory=dict)

@@ -59,6 +59,16 @@ def _batch_shapes(host_batch):
                  for leaf in jax.tree_util.tree_leaves(host_batch))
 
 
+def _step_fingerprint(batch_tree) -> tuple:
+    """The trainer's step fingerprint: per-leaf (shape, dtype) of the batch
+    pytree feeding one dispatch. A dispatch whose fingerprint was never
+    seen before will trace + compile (a jit cache miss); the telemetry
+    retrace counter is keyed by exactly this tuple. For fused groups the
+    stacked leaves carry [K, M, ...], so K/M changes fingerprint too."""
+    return tuple((tuple(np.shape(leaf)), str(np.asarray(leaf).dtype))
+                 for leaf in jax.tree_util.tree_leaves(batch_tree))
+
+
 class TrainState:
     """The complete training pytree: params, module state, optimizer state, step."""
 
@@ -113,6 +123,17 @@ class Trainer:
         optimizer update — and with ``param_sharding`` the gradient
         all-reduce the partitioner hoists out of the accumulation loop —
         fires once per accumulated step, not per microbatch.
+      telemetry: optional :class:`paddle_tpu.obs.Telemetry`. When attached,
+        the trainer records a per-call step-time breakdown (host stack /
+        shard / dispatch / fenced device / events-replay), tracks jit
+        retraces by step fingerprint (with per-compile wall time and an
+        HLO cost-analysis FLOPs estimate feeding MFU/tokens-per-sec),
+        samples device memory, and — when ``telemetry.health`` — traces
+        the training-health scalars (grad/param/update norms, NaN
+        sentinel) INTO the compiled step, returned alongside the losses.
+        With ``telemetry=None`` (default) the hot loop is unchanged: same
+        traced step function, same dispatch count, same donation, and
+        zero extra device fetches or fences.
     """
 
     def __init__(self, model: Module, loss_fn: Callable, optimizer: Optimizer,
@@ -120,7 +141,8 @@ class Trainer:
                  evaluator=None, param_sharding=None, donate: bool = True,
                  nan_check: bool = False,
                  param_stats_period: Optional[int] = None,
-                 steps_per_call: int = 1, grad_accum: int = 1):
+                 steps_per_call: int = 1, grad_accum: int = 1,
+                 telemetry=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -144,9 +166,16 @@ class Trainer:
             raise ValueError("steps_per_call and grad_accum must be >= 1")
         self.steps_per_call = int(steps_per_call)
         self.grad_accum = int(grad_accum)
+        # telemetry: None = the untelemetered hot loop, byte-identical to
+        # the pre-obs build (no health outputs in the traced step, no
+        # fencing, no extra fetches — pinned by tests/test_obs.py).
+        self.telemetry = telemetry
         self._fused_step = None
         self.train_state: Optional[TrainState] = None
         self._last_iter_state: Optional[Dict[str, Any]] = None
+
+    def _health_on(self) -> bool:
+        return self.telemetry is not None and self.telemetry.health
 
     # -- setup ---------------------------------------------------------------
 
@@ -216,7 +245,13 @@ class Trainer:
         normalized mean, loss/grads are the mean of the M microbatch means
         (mean-of-means — mask/weight-correct within each microbatch), the
         module state threads sequentially, and the optimizer update fires
-        once on the accumulated gradient."""
+        once on the accumulated gradient.
+
+        With health telemetry on, the step returns a 7th element: the
+        per-step health-scalar dict (obs.health.health_scalars) — a few
+        fused reduces over grads/updates/params that XLA folds into the
+        step program, so monitoring never adds a dispatch."""
+        health_on = self._health_on()
         opt = self.optimizer
         model = self.model
         loss_fn = self.loss_fn
@@ -270,6 +305,11 @@ class Trainer:
                 loss = lacc / M
             updates, new_opt = opt.update(grads, opt_state, params, step)
             new_params = apply_updates(params, updates)
+            if health_on:
+                from ..obs.health import health_scalars
+                health = health_scalars(grads, updates, new_params, loss)
+                return (new_params, new_state, new_opt, step + 1, loss,
+                        stats, health)
             return new_params, new_state, new_opt, step + 1, loss, stats
 
         return step_fn
@@ -308,12 +348,14 @@ class Trainer:
         def fused_fn(params, state, opt_state, step, batches, rng):
             def body(carry, kbatch):
                 p, st, o, s = carry
-                p, st, o, s, loss, stats = step_fn(p, st, o, s, kbatch, rng)
-                return (p, st, o, s), (loss, stats)
+                out = step_fn(p, st, o, s, kbatch, rng)
+                # ys = (loss, stats) or (loss, stats, health) — the scan
+                # stacks each over the K steps
+                return out[:4], out[4:]
 
-            (params, state, opt_state, step), (losses, stats) = lax.scan(
+            (params, state, opt_state, step), ys = lax.scan(
                 body, (params, state, opt_state, step), batches)
-            return params, state, opt_state, step, losses, stats
+            return (params, state, opt_state, step) + tuple(ys)
 
         donate = (0, 1, 2) if self._donate else ()
         if self._param_sharding is None:
@@ -414,8 +456,11 @@ class Trainer:
         group = self.steps_per_call * self.grad_accum
         params, state, opt_state, step = (ts.params, ts.state, ts.opt_state,
                                           ts.step)
+        tel = self.telemetry
         for pass_id in range(start_pass, num_passes):
             handler(ev.BeginPass(pass_id))
+            if tel is not None:
+                tel.begin_pass(pass_id)   # reset the per-pass memory peak
             if self.evaluator is not None:
                 self.evaluator.reset()
             costs = []
@@ -462,17 +507,62 @@ class Trainer:
                         buf = []
                     continue
                 handler(ev.BeginIteration(pass_id, batch_id))
+                is_new, fp = False, None
+                if tel is not None:
+                    fp = ((1, 1),) + _step_fingerprint(host_batch)
+                    is_new = tel.observe_fingerprint(fp)
+                t0 = time.perf_counter()
                 with self.stats.time("shard_batch"):
                     batch = self._shard(host_batch)
+                t1 = time.perf_counter()
+                hlo_flops = None
+                if is_new:
+                    from ..obs.telemetry import lowered_hlo_flops
+                    try:
+                        hlo_flops = lowered_hlo_flops(self._train_step.lower(
+                            params, state, opt_state, step, batch, rng))
+                    except Exception:
+                        hlo_flops = None
+                # dispatch timing starts AFTER the FLOPs lowering — the
+                # measurement layer must not bill its own extra trace to
+                # the step it measures (the fused path does the same)
+                t_disp = time.perf_counter()
                 with self.stats.time("train_step"):
-                    params, state, opt_state, step, loss, stats = \
-                        self._train_step(params, state, opt_state, step,
-                                         batch, rng)
+                    out = self._train_step(params, state, opt_state, step,
+                                           batch, rng)
+                params, state, opt_state, step = out[:4]
+                loss, stats = out[4], out[5]
+                health = out[6] if len(out) > 6 else None
+                t2 = time.perf_counter()
+                device_s = None
+                if tel is not None and tel.fence:
+                    # the fencing rule: the dispatch above returned as soon
+                    # as the program was enqueued — device time needs a sync
+                    jax.block_until_ready((params, loss))
+                    device_s = time.perf_counter() - t2
+                    self.stats.add("device_wait", device_s)
+                if is_new:
+                    tel.record_compile(
+                        fp, wall_s=(t2 - t_disp) + (device_s or 0.0),
+                        hlo_flops=hlo_flops, meta={"k_steps": 1, "m": 1})
                 # Refresh train_state every step: with buffer donation the
                 # previous arrays are invalidated, and event handlers may read
                 # trainer.train_state (e.g. to save) mid-pass.
                 self.train_state = TrainState(params, state, opt_state, step)
                 cost = float(loss)
+                if tel is not None:
+                    if health is not None:
+                        tel.update_health(jax.device_get(health))
+                    rec = tel.emit_step(
+                        {"pass": pass_id, "step": int(step),
+                         "k_steps": 1, "m": 1, "loss": cost,
+                         "host_stack_ms": None,
+                         "shard_ms": round((t1 - t0) * 1e3, 3),
+                         "dispatch_ms": round((t2 - t_disp) * 1e3, 3),
+                         "device_ms": (round(device_s * 1e3, 3)
+                                       if device_s is not None else None),
+                         "replay_ms": None})
+                    handler(ev.TelemetryRecord(record=rec))
                 if self._nan_check and not np.isfinite(cost):
                     from ..utils import debug as dbg
                     bad = dbg.nonfinite_leaves(
@@ -488,8 +578,15 @@ class Trainer:
                     metrics = self.evaluator.result()
                 if log_period and (batch_id + 1) % log_period == 0:
                     msg = " ".join(f"{k}={v:.4f}" for k, v in metrics.items())
+                    if tel is not None and tel.last_health:
+                        # health monitors are fetched per call (riding the
+                        # same sync as the loss) but LOGGED only here
+                        msg += " " + " ".join(
+                            f"{k}={v:.3g}"
+                            for k, v in tel.last_health.items())
                     _log.info("pass %d batch %d cost=%.4f %s",
                               pass_id, batch_id + 1, cost, msg)
+                    self._log_stat_report()
                 if self._param_stats_period and \
                         (batch_id + 1) % self._param_stats_period == 0:
                     self._log_param_stats(pass_id, batch_id)
@@ -554,6 +651,7 @@ class Trainer:
 
         ``fused_step(params, state, opt_state, step, device_batches, rng)``
         returns ``(params, state, opt_state, step, losses[K], stats)`` —
+        plus a trailing health pytree when health telemetry is attached —
         the stable surface benchmarks drive for repeated dispatch of one
         resident group (bench.py's ``transformer_fused`` metric) without
         depending on the Trainer's private stacking/sharding layout."""
@@ -582,19 +680,75 @@ class Trainer:
             lambda x: jax.device_put(x, self._fused_leaf_sharding(x)),
             stacked)
 
-    def _dispatch_fused(self, stacked, rng):
+    def _dispatch_fused(self, stacked, rng, stack_s=None):
         """One fused device call; refreshes train_state (donation invalidates
-        the previous buffers). Returns (losses [K], stats [K(, M), ...])."""
+        the previous buffers). Returns ``(losses [K], stats [K(, M), ...],
+        health_or_None, record_or_None)`` — ``health`` is the device-side
+        [K]-stacked health pytree (no fetch here), ``record`` the partial
+        telemetry step record (breakdown fields filled; the events-replay
+        time is appended by the caller).
+
+        Telemetry-off takes the exact pre-obs path: no fingerprinting, no
+        fencing, no extra fetches — the dispatch count and donation
+        behavior are byte-identical (tests/test_obs.py pins this)."""
         if self._fused_step is None:
             self._build_fused_step(stacked)
+        tel = self.telemetry
+        is_new, fp, hlo_flops = False, None, None
+        if tel is not None:
+            fp = _step_fingerprint(stacked)
+            is_new = tel.observe_fingerprint(fp)
         with self.stats.time("shard_batch"):
+            t_sh = time.perf_counter()
             batches = self._shard_fused(stacked)
+            shard_s = time.perf_counter() - t_sh
         ts = self.train_state
+        args = (ts.params, ts.state, ts.opt_state, ts.step, batches, rng)
+        if is_new:
+            # HLO cost-analysis FLOPs from the UN-compiled Lowered: one
+            # extra trace (cheap), not a second compile; feeds MFU.
+            from ..obs.telemetry import lowered_hlo_flops
+            try:
+                hlo_flops = lowered_hlo_flops(self._fused_step.lower(*args))
+            except Exception:
+                hlo_flops = None
+        t_disp = time.perf_counter()
         with self.stats.time("train_step"):
-            params, state, opt_state, step, losses, stats = self._fused_step(
-                ts.params, ts.state, ts.opt_state, ts.step, batches, rng)
+            out = self._fused_step(*args)
+        dispatch_s = time.perf_counter() - t_disp
+        params, state, opt_state, step = out[:4]
+        losses, stats = out[4], out[5]
+        health = out[6] if len(out) > 6 else None
+        device_s = None
+        if tel is not None and tel.fence:
+            # The fencing rule: the jit call above returns once XLA has
+            # ENQUEUED the program (async dispatch) — a wall timer around
+            # it measures dispatch, not compute. True device time is the
+            # extra wait until the outputs are ready. Telemetry owns this
+            # sync; without telemetry the loop never fences.
+            jax.block_until_ready((params, losses))
+            device_s = time.perf_counter() - t_disp - dispatch_s
+            self.stats.add("device_wait", device_s)
+        k_eff = int(losses.shape[0])
+        if is_new:
+            tel.record_compile(
+                fp, wall_s=dispatch_s + (device_s or 0.0),
+                hlo_flops=hlo_flops,
+                meta={"k_steps": k_eff,
+                      "m": int(jax.tree_util.tree_leaves(stacked)[0]
+                               .shape[1])})
+        rec = None
+        if tel is not None:
+            rec = {"k_steps": k_eff,
+                   "m": int(jax.tree_util.tree_leaves(stacked)[0].shape[1]),
+                   "host_stack_ms": (round(stack_s * 1e3, 3)
+                                     if stack_s is not None else None),
+                   "shard_ms": round(shard_s * 1e3, 3),
+                   "dispatch_ms": round(dispatch_s * 1e3, 3),
+                   "device_ms": (round(device_s * 1e3, 3)
+                                 if device_s is not None else None)}
         self.train_state = TrainState(params, state, opt_state, step)
-        return losses, stats
+        return losses, stats, health, rec
 
     def _run_fused_group(self, buf, buf_start, pass_id, rng, handler, costs,
                          log_period, saving_period, checkpoint_dir,
@@ -612,19 +766,24 @@ class Trainer:
         the true ``next_batch`` position — so resume replay stays aligned
         with the fused grouping)."""
         M = self.grad_accum
+        tel = self.telemetry
         done, results = 0, []
         while done < len(buf):
             rem = len(buf) - done
             take = (rem // M) * M or rem        # full KxM part, then the tail
             m_eff = M if take >= M else take
+            t_stack = time.perf_counter()
             stacked = self._stack_group(buf[done:done + take],
                                         take // m_eff, m_eff)
-            losses, stats = self._dispatch_fused(stacked, rng)
+            stack_s = time.perf_counter() - t_stack
+            self.stats.add("stack_group", stack_s)
+            losses, stats, health, rec = self._dispatch_fused(
+                stacked, rng, stack_s=stack_s)
             # record THIS dispatch's post-call step count: a group split
             # into several dispatches (tail not a multiple of M) must not
             # number earlier dispatches' steps off the later ones' state
             results.append((buf_start + done, m_eff, losses, stats,
-                            int(self.train_state.step)))
+                            int(self.train_state.step), health, rec))
             done += take
         # The boundary checkpoint lands BEFORE the replayed events, matching
         # the plain loop's save-then-EndIteration order (handlers that kill
@@ -636,7 +795,7 @@ class Trainer:
         end = buf_start + len(buf)
         group_finite = (not self._nan_check) or all(
             np.isfinite(np.asarray(jax.device_get(losses))).all()
-            for _, _, losses, _, _ in results)
+            for _, _, losses, _, _, _, _ in results)
         if saving_period and checkpoint_dir and group_finite and \
                 (end // saving_period) > (buf_start // saving_period):
             save_fn(
@@ -646,14 +805,37 @@ class Trainer:
                           "completed": 0,
                           "batch_crc": _batch_fingerprint(buf[-1])}},
                 keep_last=checkpoint_keep)
-        for start, m_eff, losses, stats, step_after in results:
+        for start, m_eff, losses, stats, step_after, health, rec in results:
+            # Health scalars are device-side [K] stacks; fetching them here
+            # rides the same per-call host sync that already fetches the
+            # losses — no extra dispatch. The human-readable log still
+            # fires only at log_period (inside _post_fused).
+            health_np = (jax.device_get(health)
+                         if (tel is not None and health is not None)
+                         else None)
+            t_replay = time.perf_counter()
             self._post_fused(pass_id, start, m_eff, losses, stats,
-                             step_after, handler, costs, log_period)
+                             step_after, handler, costs, log_period,
+                             health_np=health_np)
+            if tel is not None and rec is not None:
+                if health_np is not None:
+                    tel.update_health({k: v[-1]
+                                       for k, v in health_np.items()})
+                rec["pass"] = pass_id
+                rec["step"] = step_after
+                rec["loss"] = float(np.asarray(
+                    jax.device_get(losses)).ravel()[-1])
+                rec["replay_ms"] = round(
+                    (time.perf_counter() - t_replay) * 1e3, 3)
+                rec = tel.emit_step(rec)
+                handler(ev.TelemetryRecord(record=rec))
 
     def _post_fused(self, pass_id, start_index, m_eff, losses, stats,
-                    step_after, handler, costs, log_period):
+                    step_after, handler, costs, log_period, health_np=None):
         """Replay one dispatch's host bookkeeping; ``step_after`` is the
-        global optimizer-step count right after THAT dispatch."""
+        global optimizer-step count right after THAT dispatch.
+        ``health_np``: host-fetched dict of [K] health scalars (telemetry
+        on) — logged at log_period crossings."""
         losses_np = np.asarray(jax.device_get(losses))
         stats_np = (jax.device_get(stats)
                     if self.evaluator is not None else None)
@@ -687,13 +869,27 @@ class Trainer:
             if log_period and \
                     (last_id + 1) // log_period > step_first // log_period:
                 msg = " ".join(f"{k_}={v:.4f}" for k_, v in metrics.items())
+                if health_np is not None:
+                    msg += " " + " ".join(
+                        f"{hk}={float(hv[k]):.3g}"
+                        for hk, hv in health_np.items())
                 _log.info("pass %d batch %d cost=%.4f %s",
                           pass_id, last_id + 1, cost, msg)
+                self._log_stat_report()
             psp = self._param_stats_period
             if psp and (last_id + 1) // psp > step_first // psp:
                 self._log_param_stats(pass_id, last_id)
             handler(ev.EndIteration(pass_id, last_id,
                                     step_after - (K - 1 - k), cost, metrics))
+
+    def _log_stat_report(self, top_n: int = 8):
+        """Periodic StatSet summary at log_period — the reference's
+        ``printAllStatus`` analog (``utils/Stat.h``). INFO when telemetry
+        is attached (the operator asked for visibility), DEBUG otherwise
+        (no new log noise for untelemetered runs)."""
+        lvl = logging.INFO if self.telemetry is not None else logging.DEBUG
+        if _log.isEnabledFor(lvl):
+            _log.log(lvl, "%s", self.stats.report(top_n=top_n))
 
     def _log_param_stats(self, pass_id: int, batch_id: int):
         """Per-parameter scale telemetry (``--show_parameter_stats_period``:

@@ -69,12 +69,25 @@ class StatSet:
                         "max_ms": 1e3 * s.max}
                     for k, s in self._stats.items()}
 
-    def report(self) -> str:
+    def to_dict(self) -> Dict[str, object]:
+        """JSON-ready export: name + the per-key summary (telemetry sinks
+        and bench.py consume this)."""
+        return {"name": self.name, "stats": self.summary()}
+
+    def report(self, top_n: Optional[int] = None) -> str:
+        """Sorted summary, heaviest total time first — the reference's
+        ``printAllStatus`` table (``utils/Stat.h``). ``top_n`` caps the
+        rows (None = all)."""
+        rows = sorted(self.summary().items(),
+                      key=lambda kv: kv[1]["total_s"], reverse=True)
+        shown = rows if top_n is None else rows[:top_n]
         lines = [f"=== {self.name} ==="]
-        for k, v in sorted(self.summary().items()):
+        for k, v in shown:
             lines.append(f"  {k:<30s} n={v['count']:<6d} "
                          f"avg={v['avg_ms']:8.2f}ms max={v['max_ms']:8.2f}ms "
                          f"total={v['total_s']:.2f}s")
+        if top_n is not None and len(rows) > top_n:
+            lines.append(f"  ... {len(rows) - top_n} more")
         return "\n".join(lines)
 
 

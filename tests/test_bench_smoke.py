@@ -1,6 +1,8 @@
 """CI gate for the fused train-step pipeline: ``bench.py --smoke`` must run
 green on CPU and report the fused-vs-plain differential (ISSUE 1 satellite:
-the fused path cannot rot without tier-1 noticing)."""
+the fused path cannot rot without tier-1 noticing) AND the telemetry block
+(ISSUE 2 satellite: a telemetry-on CPU training must emit JSONL that parses
+and carries the required schema keys)."""
 
 import json
 import os
@@ -28,6 +30,18 @@ def test_bench_smoke_cpu_green_and_equal():
     assert out["fused_ms_per_opt_step"] > 0
     assert out["plain_ms_per_opt_step"] > 0
     assert np.isfinite(out["final_loss"])
+    # ISSUE 2: the telemetry gate ran, its JSONL parsed with the required
+    # keys, and attaching telemetry did not perturb the training math
+    tel = out["telemetry"]
+    assert tel["jsonl_ok"] is True, tel
+    assert tel["losses_equal_with_telemetry"] is True
+    assert tel["jsonl_records"] > 0 and tel["steps_emitted"] > 0
+    assert tel["compile_count"] >= 1 and tel["retrace_count"] >= 0
+    # step breakdown + MFU accounting carried into the BENCH snapshot
+    assert tel["mean_dispatch_ms"] > 0 and tel["mean_device_ms"] > 0
+    assert tel["hlo_flops_per_call"] and tel["hlo_flops_per_call"] > 0
+    assert tel["tokens_per_sec"] > 0
+    assert tel["grad_norm"] > 0
 
 
 def test_bench_prep_transformer_fused_builds():

@@ -1,0 +1,327 @@
+"""Telemetry subsystem tests (ISSUE 2): JSONL sink schema round-trip,
+retrace counter keyed by step fingerprint, health monitors flagging an
+injected NaN, and the telemetry-off zero-overhead invariant (no extra
+dispatches, no fences, no health outputs, bit-identical params)."""
+
+import json
+import logging
+
+import numpy as np
+import jax
+import pytest
+
+from paddle_tpu import optim
+from paddle_tpu.models import MnistMLP
+from paddle_tpu.nn import costs
+from paddle_tpu.train import Trainer, events as ev
+from paddle_tpu.obs import (HEALTH_KEYS, InMemorySink, JsonlSink,
+                            LoggingSink, Telemetry)
+from paddle_tpu.utils.stats import StatSet
+
+BS, DIM = 16, 12
+
+
+def make_batches(n, bs=BS, dim=DIM, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.normal(size=(bs, dim)).astype(np.float32),
+             "label": rng.randint(0, 4, size=bs).astype(np.int32)}
+            for _ in range(n)]
+
+
+def make_trainer(K=2, M=2, telemetry=None):
+    return Trainer(
+        model=MnistMLP(num_classes=4, hidden=(8,)),
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
+        optimizer=optim.adam(1e-3),
+        steps_per_call=K, grad_accum=M, telemetry=telemetry)
+
+
+def run_fused(trainer, batches, log_period=0):
+    trainer.init(jax.random.PRNGKey(0), batches[0])
+    trainer.train(lambda: iter(batches), num_passes=1,
+                  log_period=log_period)
+    return trainer
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+def test_jsonl_sink_schema_roundtrip(tmp_path):
+    """Records written through JsonlSink parse back identical to what the
+    in-memory sink saw — the schema survives the serialization."""
+    path = str(tmp_path / "tel.jsonl")
+    mem = InMemorySink()
+    tel = Telemetry(sinks=[mem, JsonlSink(path)])
+    batches = make_batches(2 * 2 * 2 + 1)     # +1 ragged tail
+    run_fused(make_trainer(telemetry=tel), batches)
+    tel.close()
+    from_disk = JsonlSink.read(path)
+    assert from_disk == mem.records
+    steps = [r for r in from_disk if r["kind"] == "step"]
+    compiles = [r for r in from_disk if r["kind"] == "compile"]
+    assert steps and compiles
+    for r in steps:
+        for key in ("ts", "pass", "step", "k_steps", "m", "loss",
+                    "host_stack_ms", "shard_ms", "dispatch_ms", "device_ms",
+                    "replay_ms", "compile_count", "retrace_count",
+                    "peak_bytes", "fenced") + HEALTH_KEYS:
+            assert key in r, f"missing {key}"
+        assert r["fenced"] is True and r["device_ms"] is not None
+    for r in compiles:
+        assert r["wall_s"] > 0
+        assert "hlo_flops" in r
+
+
+def test_logging_sink_emits(caplog):
+    sink = LoggingSink(level=logging.INFO)
+    with caplog.at_level(logging.INFO, logger="paddle_tpu.telemetry"):
+        sink.emit({"kind": "step", "step": 3, "dispatch_ms": 1.25,
+                   "grad_norm": 0.5})
+        sink.emit({"kind": "compile", "compile_count": 1, "wall_s": 0.1,
+                   "hlo_flops": 100.0, "fingerprint": "fp"})
+    text = caplog.text
+    assert "step=3" in text and "compile" in text
+
+
+def test_broken_sink_never_kills_training():
+    class Boom:
+        def emit(self, record):
+            raise RuntimeError("sink died")
+
+    tel = Telemetry(sinks=[Boom(), InMemorySink()])
+    batches = make_batches(2 * 2 * 2)
+    run_fused(make_trainer(telemetry=tel), batches)   # must not raise
+    assert tel.compile_count >= 1
+
+
+# ---------------------------------------------------------------------------
+# retrace / compile tracking
+# ---------------------------------------------------------------------------
+
+def test_retrace_counter_increments_once_per_fingerprint():
+    tel = Telemetry(sinks=[InMemorySink()])
+    assert tel.observe_fingerprint(("a",)) is True
+    assert tel.observe_fingerprint(("a",)) is False
+    assert tel.observe_fingerprint(("a",)) is False
+    assert (tel.compile_count, tel.retrace_count) == (1, 0)
+    assert tel.observe_fingerprint(("b",)) is True
+    assert tel.observe_fingerprint(("b",)) is False
+    assert tel.observe_fingerprint(("a",)) is False
+    assert (tel.compile_count, tel.retrace_count) == (2, 1)
+
+
+def test_trainer_retrace_tracking_ragged_tail():
+    """K*M-uniform groups compile once; the ragged pass tail is a second
+    fingerprint (ONE retrace), and a second pass over the same stream adds
+    none — the counter keys on fingerprints, not dispatches."""
+    mem = InMemorySink()
+    tel = Telemetry(sinks=[mem])
+    tr = make_trainer(K=2, M=2, telemetry=tel)
+    batches = make_batches(2 * 2 * 2 + 1)      # two full groups + tail 1
+    tr.init(jax.random.PRNGKey(0), batches[0])
+    tr.train(lambda: iter(batches), num_passes=1, log_period=0)
+    assert tel.compile_count == 2              # full-group + tail shapes
+    assert tel.retrace_count == 1
+    tr.train(lambda: iter(batches), num_passes=1, log_period=0)
+    assert tel.compile_count == 2              # nothing new the 2nd pass
+    assert tel.retrace_count == 1
+    assert len(mem.by_kind("compile")) == 2
+    # compile records carry wall time and the HLO FLOPs estimate
+    for r in mem.by_kind("compile"):
+        assert r["wall_s"] > 0
+
+
+def test_mfu_and_tokens_per_sec_accounting():
+    """With an explicit peak-FLOPs denominator (the CPU table has none)
+    emit_step derives est_mfu_pct from the analytic flops_per_step."""
+    mem = InMemorySink()
+    tel = Telemetry(sinks=[mem], flops_per_step=1e9, tokens_per_step=1024,
+                    peak_flops=1e12)
+    tel.emit_step({"k_steps": 2, "dispatch_ms": 1.0, "device_ms": 9.0})
+    rec = mem.records[-1]
+    # per-step time = 10ms/2 = 5ms -> 1e9 / 5e-3 / 1e12 = 20% MFU
+    assert rec["est_mfu_pct"] == pytest.approx(20.0)
+    assert rec["tokens_per_sec"] == pytest.approx(1024 / 5e-3)
+
+
+# ---------------------------------------------------------------------------
+# health monitors
+# ---------------------------------------------------------------------------
+
+def test_health_monitors_flag_injected_nan(tmp_path):
+    path = str(tmp_path / "nan.jsonl")
+    mem = InMemorySink()
+    tel = Telemetry(sinks=[mem, JsonlSink(path)])
+    tr = make_trainer(K=2, M=1, telemetry=tel)
+    batches = make_batches(4)
+    batches[2]["x"][0, 0] = np.nan            # poison one microbatch
+    tr.init(jax.random.PRNGKey(0), batches[0])
+    tr.train(lambda: iter(batches), num_passes=1, log_period=0)
+    tel.close()
+    steps = mem.by_kind("step")
+    assert len(steps) == 2                    # 4 batches / K=2 per call
+    assert steps[0]["nonfinite_count"] == 0
+    assert steps[0]["grad_norm"] > 0
+    # the poisoned call: the sentinel trips; the NaN norms/loss are
+    # sanitized to None so the JSONL stays strict-RFC-8259 parseable
+    assert steps[1]["nonfinite_count"] > 0
+    assert steps[1]["grad_norm"] is None
+    assert steps[1]["loss"] is None
+
+    def no_nan_literals(name):
+        raise AssertionError(f"bare {name} literal in JSONL")
+
+    with open(path) as f:
+        for line in f:                        # strict parse: NaN/Inf reject
+            json.loads(line, parse_constant=no_nan_literals)
+
+
+def test_healthy_run_monitor_values():
+    mem = InMemorySink()
+    tel = Telemetry(sinks=[mem])
+    run_fused(make_trainer(telemetry=tel), make_batches(8))
+    for r in mem.by_kind("step"):
+        assert r["nonfinite_count"] == 0
+        assert r["grad_norm"] > 0
+        assert r["param_norm"] > 0
+        assert 0 < r["update_ratio"] < 1
+
+
+# ---------------------------------------------------------------------------
+# the telemetry-off zero-overhead invariant
+# ---------------------------------------------------------------------------
+
+def _count_dispatches(tr, batches, monkeypatch_fence=None):
+    """Run one pass counting fused-step dispatches (and optionally
+    block_until_ready fences)."""
+    tr.init(jax.random.PRNGKey(0), batches[0])
+    calls = {"n": 0}
+    orig_dispatch = tr._dispatch_fused
+
+    def counting_dispatch(stacked, rng, **kw):
+        calls["n"] += 1
+        return orig_dispatch(stacked, rng, **kw)
+
+    tr._dispatch_fused = counting_dispatch
+    tr.train(lambda: iter(batches), num_passes=1, log_period=0)
+    return calls["n"]
+
+
+def test_telemetry_off_zero_dispatch_and_fence_overhead(monkeypatch):
+    """With telemetry off the fused loop adds NOTHING: same dispatch count
+    as the telemetered run, zero block_until_ready fences, no health
+    outputs in the traced step, and bit-identical trained params."""
+    batches = make_batches(2 * 2 * 3)
+    fences = {"n": 0}
+    orig_fence = jax.block_until_ready
+
+    def counting_fence(x):
+        fences["n"] += 1
+        return orig_fence(x)
+
+    monkeypatch.setattr(jax, "block_until_ready", counting_fence)
+
+    tr_off = make_trainer(telemetry=None)
+    n_off = _count_dispatches(tr_off, batches)
+    fences_off = fences["n"]
+    assert fences_off == 0                    # telemetry owns the fence
+    # no health outputs traced into the step: 6-tuple contract
+    out = tr_off._fused_step
+    assert out is not None
+    assert not tr_off._health_on()
+
+    tel = Telemetry(sinks=[InMemorySink()])
+    tr_on = make_trainer(telemetry=tel)
+    n_on = _count_dispatches(tr_on, batches)
+    assert n_on == n_off                      # telemetry adds no dispatch
+    assert fences["n"] > 0                    # ...but does fence when on
+    assert tr_on._health_on()
+
+    # telemetry (health outputs included) must not perturb the math
+    for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(
+                tr_off.train_state.params)),
+            jax.tree_util.tree_leaves(jax.device_get(
+                tr_on.train_state.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_telemetry_event_fires_only_when_attached():
+    batches = make_batches(2 * 2 * 2)
+    seen = {"on": 0, "off": 0}
+
+    tr = make_trainer(telemetry=None)
+    tr.init(jax.random.PRNGKey(0), batches[0])
+    tr.train(lambda: iter(batches), num_passes=1, log_period=0,
+             event_handler=lambda e: seen.__setitem__(
+                 "off", seen["off"] + isinstance(e, ev.TelemetryRecord)))
+    assert seen["off"] == 0
+
+    tr = make_trainer(telemetry=Telemetry(sinks=[InMemorySink()]))
+    tr.init(jax.random.PRNGKey(0), batches[0])
+    tr.train(lambda: iter(batches), num_passes=1, log_period=0,
+             event_handler=lambda e: seen.__setitem__(
+                 "on", seen["on"] + isinstance(e, ev.TelemetryRecord)))
+    assert seen["on"] == 2                    # one per fused call
+
+
+def test_plain_loop_telemetry_records():
+    """steps_per_call=1, grad_accum=1 (the unfused loop) also records a
+    per-step breakdown and retraces."""
+    mem = InMemorySink()
+    tr = make_trainer(K=1, M=1, telemetry=Telemetry(sinks=[mem]))
+    batches = make_batches(3)
+    tr.init(jax.random.PRNGKey(0), batches[0])
+    tr.train(lambda: iter(batches), num_passes=1, log_period=0)
+    steps = mem.by_kind("step")
+    assert len(steps) == 3
+    for r in steps:
+        assert r["k_steps"] == 1
+        assert r["shard_ms"] is not None and r["dispatch_ms"] is not None
+        assert r["device_ms"] is not None and r["fenced"] is True
+        assert r["grad_norm"] > 0
+    assert len(mem.by_kind("compile")) == 1
+
+
+# ---------------------------------------------------------------------------
+# StatSet satellite
+# ---------------------------------------------------------------------------
+
+def test_statset_report_topn_and_to_dict():
+    s = StatSet("t")
+    s.add("slow", 2.0)
+    s.add("fast", 0.1)
+    s.add("mid", 0.5)
+    rep = s.report(top_n=2)
+    lines = rep.splitlines()
+    assert "slow" in lines[1]                 # sorted by total desc
+    assert "mid" in lines[2]
+    assert "fast" not in rep
+    assert "1 more" in lines[-1]
+    d = s.to_dict()
+    assert d["name"] == "t"
+    assert d["stats"]["slow"]["count"] == 1
+    json.dumps(d)                             # JSON-ready
+    s.reset()
+    assert s.summary() == {}
+
+
+# ---------------------------------------------------------------------------
+# named_scope satellite: profiler traces show model structure
+# ---------------------------------------------------------------------------
+
+def test_transformer_named_scopes_reach_compiled_hlo():
+    from paddle_tpu.models import TransformerLM
+    import jax.numpy as jnp
+
+    model = TransformerLM(vocab=32, dim=16, num_layers=2, num_heads=2,
+                          ffn_hidden=32, max_len=8)
+    ids = jnp.zeros((2, 8), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    compiled = jax.jit(
+        lambda p, i: model.apply(p, i)).lower(variables, ids).compile()
+    txt = compiled.as_text()
+    for scope in ("embed", "block0", "block1", "attn", "ffn", "head",
+                  "qkv_proj", "sdpa_xla", "out_proj"):
+        assert scope in txt, f"named_scope {scope!r} missing from HLO"
