@@ -528,6 +528,9 @@ PLANS = {
     # one step_body call = 8 fused optimizer steps; k stays 1 (the fusion
     # under test is the Trainer's, not the harness fori_loop's)
     "transformer_fused": dict(n=8, k=1, budget=2400),
+    # Trainer-loop-level overlap differential (own child protocol:
+    # run_pipelined_child; n/k unused)
+    "transformer_pipelined": dict(n=0, k=1, budget=2400),
 }
 
 
@@ -693,6 +696,7 @@ def bench_differential(name, n=None, k=None, budget=None):
 TELEMETRY_STEP_KEYS = frozenset((
     "kind", "ts", "pass", "step", "k_steps", "m", "loss",
     "host_stack_ms", "shard_ms", "dispatch_ms", "device_ms", "replay_ms",
+    "stage_ms", "drain_wait_ms", "overlap_frac",
     "compile_count", "retrace_count", "grad_norm", "param_norm",
     "update_ratio", "nonfinite_count", "bytes_in_use", "peak_bytes",
     "fenced"))
@@ -726,14 +730,14 @@ def run_smoke(K=4, M=2, timing_passes=3):
                 "y": rng.randint(0, V, (bs, T)).astype(np.int32)}
                for _ in range(n_batches)]
 
-    def make(k_steps, telemetry=None):
+    def make(k_steps, telemetry=None, pipeline_depth=1):
         tr = Trainer(
             model=TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
                                 ffn_hidden=64, max_len=T, remat="dots"),
             loss_fn=lambda out, b: costs.softmax_cross_entropy(
                 out.reshape(-1, V), b["y"].reshape(-1)),
             optimizer=optim.adam(1e-3), steps_per_call=k_steps,
-            grad_accum=M, telemetry=telemetry)
+            grad_accum=M, pipeline_depth=pipeline_depth, telemetry=telemetry)
         tr.init(jax.random.PRNGKey(0), batches[0])
         return tr
 
@@ -801,6 +805,37 @@ def run_smoke(K=4, M=2, timing_passes=3):
     if missing:
         telemetry["missing_keys"] = missing
 
+    # -- async host pipeline gate (ISSUE 3): a pipeline_depth=2 fused run
+    # must reproduce the serial loss stream bit-exact and its telemetry
+    # must carry the overlap keys (stage_ms / drain_wait_ms / overlap_frac
+    # non-None). The steps/s delta is recorded but informational — on a
+    # shared-core CPU CI box the stager thread competes with XLA for the
+    # same cores, so the overlap win is only reliably visible on device.
+    tel_pipe = Telemetry(sinks=[InMemorySink()])
+    tr_pipe = make(K, telemetry=tel_pipe, pipeline_depth=2)
+    l_pipe = run(tr_pipe)
+    pipe_steps = [r for r in tel_pipe.sinks[0].by_kind("step")]
+    overlap_ok = bool(pipe_steps) and all(
+        r.get("stage_ms") is not None and r.get("drain_wait_ms") is not None
+        and r.get("overlap_frac") is not None for r in pipe_steps)
+    tr_pipe_t = make(K, pipeline_depth=2)              # untelemetered timing
+    run(tr_pipe_t)                                     # compile warmup pass
+    pipe_ms = timed(tr_pipe_t) * 1e3
+    pipeline = {
+        "losses_equal": l_pipe == l_fused,
+        "overlap_keys_ok": overlap_ok,
+        "pipelined_ms_per_opt_step": round(pipe_ms, 3),
+        "serial_ms_per_opt_step": round(fused_ms, 3),
+        "pipelined_vs_serial_speedup": round(fused_ms / pipe_ms, 3),
+        "mean_stage_ms": tel_pipe.summary().get("mean_stage_ms"),
+        "mean_drain_wait_ms": tel_pipe.summary().get("mean_drain_wait_ms"),
+        "mean_overlap_frac": tel_pipe.summary().get("mean_overlap_frac"),
+        # the serial host cost the pipeline hides (acceptance comparator)
+        "serial_host_stack_plus_shard_ms": round(
+            (telemetry.get("mean_host_stack_ms") or 0.0)
+            + (telemetry.get("mean_shard_ms") or 0.0), 4),
+    }
+
     out = {
         "metric": "fused_vs_plain_smoke",
         "equal": bool(eq_params and eq_losses),
@@ -812,10 +847,118 @@ def run_smoke(K=4, M=2, timing_passes=3):
         "final_loss": round(l_fused[-1], 4) if l_fused else None,
         "device": jax.devices()[0].device_kind,
         "telemetry": telemetry,
+        "pipeline": pipeline,
     }
     print(json.dumps(out))
-    ok = out["equal"] and jsonl_ok and telemetry["losses_equal_with_telemetry"]
+    ok = (out["equal"] and jsonl_ok
+          and telemetry["losses_equal_with_telemetry"]
+          and pipeline["losses_equal"] and pipeline["overlap_keys_ok"])
     return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# async host pipeline differential (ISSUE 3): overlap-on vs overlap-off
+# steps/s through the REAL Trainer host loop (reader -> stager -> window),
+# not the harness fori_loop — the serialization under test is the host's.
+# ---------------------------------------------------------------------------
+
+def run_pipelined_child(k_steps=8, depth=3, timed_passes=2,
+                        groups_per_pass=3, batch_size=8, seq_len=2048,
+                        dim=512, layers=6, heads=4, vocab=32000):
+    """Train the same batch stream through ``Trainer(steps_per_call=K)``
+    with ``pipeline_depth=1`` (serial) and ``pipeline_depth=depth``
+    (async host pipeline), timing the post-compile hot loop of each, and
+    report the steps/s delta plus the overlap telemetry (stage_ms /
+    drain_wait_ms / overlap_frac vs the serial host_stack+shard baseline).
+    Prints one JSON line for the parent."""
+    from paddle_tpu import optim
+    from paddle_tpu.core.dtypes import bfloat16_compute, use_policy
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.nn import costs
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    from paddle_tpu.train import Trainer
+
+    ffn = 4 * dim
+    rng = np.random.RandomState(0)
+    n_batches = groups_per_pass * k_steps
+    batches = [{"x": rng.randint(0, vocab, (batch_size, seq_len))
+                .astype(np.int32),
+                "y": rng.randint(0, vocab, (batch_size, seq_len))
+                .astype(np.int32)}
+               for _ in range(n_batches)]
+
+    def make(W, telemetry=None):
+        tr = Trainer(
+            model=TransformerLM(vocab=vocab, dim=dim, num_layers=layers,
+                                num_heads=heads, ffn_hidden=ffn,
+                                max_len=seq_len, use_flash=True),
+            loss_fn=lambda out, b: costs.softmax_cross_entropy(
+                out.reshape(-1, vocab), b["y"].reshape(-1)),
+            optimizer=optim.adam(1e-4), steps_per_call=k_steps,
+            pipeline_depth=W, telemetry=telemetry)
+        tr.init(jax.random.PRNGKey(0), batches[0])
+        return tr
+
+    def measure(W):
+        # fence=False: the serial run must not pay the telemetry fence the
+        # pipelined run structurally avoids — both record host timings only
+        tel = Telemetry(sinks=[InMemorySink()], health=False, fence=False)
+        with use_policy(bfloat16_compute):
+            tr = make(W, telemetry=tel)
+            tr.train(lambda: iter(batches), num_passes=1,
+                     log_period=0)             # compile + warmup pass
+            t0 = time.perf_counter()
+            tr.train(lambda: iter(batches), num_passes=timed_passes,
+                     log_period=0)
+            wall = time.perf_counter() - t0
+        steps = timed_passes * n_batches
+        return steps / wall, tel.summary()
+
+    serial_rate, serial_tel = measure(1)
+    pipe_rate, pipe_tel = measure(depth)
+    out = {
+        "child": "transformer_pipelined",
+        "pipelined_steps_per_sec": round(pipe_rate, 4),
+        "serial_steps_per_sec": round(serial_rate, 4),
+        "pipelined_vs_serial": round(pipe_rate / serial_rate, 4),
+        "tokens_per_sec": round(pipe_rate * batch_size * seq_len, 1),
+        "pipeline_depth": depth, "k_steps": k_steps,
+        "batch_size": batch_size, "seq_len": seq_len, "dim": dim,
+        "mean_stage_ms": pipe_tel.get("mean_stage_ms"),
+        "mean_drain_wait_ms": pipe_tel.get("mean_drain_wait_ms"),
+        "mean_overlap_frac": pipe_tel.get("mean_overlap_frac"),
+        "serial_host_stack_plus_shard_ms": round(
+            (serial_tel.get("mean_host_stack_ms") or 0.0)
+            + (serial_tel.get("mean_shard_ms") or 0.0), 4),
+        "device": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out))
+
+
+def bench_pipelined(budget=None):
+    """Fresh-subprocess wrapper for run_pipelined_child (one child = one
+    tunnel session, like every other metric)."""
+    budget = budget or PLANS["transformer_pipelined"]["budget"]
+    r = _spawn_child("transformer_pipelined", 0, 1, budget)
+    return {
+        "metric": "transformer_pipelined_train_steps_per_sec",
+        "unit": "steps/sec",
+        "value": r["pipelined_steps_per_sec"],
+        "serial_steps_per_sec": r["serial_steps_per_sec"],
+        "pipelined_vs_serial": r["pipelined_vs_serial"],
+        "tokens_per_sec": r["tokens_per_sec"],
+        "ms_per_step": round(1e3 / r["pipelined_steps_per_sec"], 2)
+        if r["pipelined_steps_per_sec"] else None,
+        "mean_stage_ms": r["mean_stage_ms"],
+        "mean_drain_wait_ms": r["mean_drain_wait_ms"],
+        "mean_overlap_frac": r["mean_overlap_frac"],
+        "serial_host_stack_plus_shard_ms":
+            r["serial_host_stack_plus_shard_ms"],
+        "pipeline_depth": r["pipeline_depth"], "k_steps": r["k_steps"],
+        "batch_size": r["batch_size"], "seq_len": r["seq_len"],
+        "dim": r["dim"], "device": r["device"],
+        "baseline": None, "vs_baseline": None,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -975,7 +1118,8 @@ def bench_scaling(per_device_batch=32, iters=2, steps_per_call=4):
 # CPU compiles cost ~20 min — run it explicitly (`--metric scaling`); the
 # committed artifacts are SCALING_r05.json (proxy + analytic projection).
 DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_fused",
-                "transformer_big", "lstm", "lstm_h256", "lstm_h1280"]
+                "transformer_pipelined", "transformer_big", "lstm",
+                "lstm_h256", "lstm_h1280"]
 
 
 _KNOWN_FLAGS = ("--metric", "--child", "--probe", "--n", "--k",
@@ -1025,16 +1169,32 @@ def main():
     if metric == "all":                 # legacy alias for the full plan
         metric = None
     if flag("--child", cast=int):
-        run_timed_child(metric, flag("--timed-steps", 100, int),
-                        flag("--steps-per-call", 1, int))
+        if metric == "transformer_pipelined":
+            run_pipelined_child()
+        else:
+            run_timed_child(metric, flag("--timed-steps", 100, int),
+                            flag("--steps-per-call", 1, int))
         return
 
     if metric == "scaling":
         print(json.dumps(bench_scaling()))
         return
+    if metric == "transformer_pipelined":
+        try:
+            out = bench_pipelined()
+        except (RuntimeError, subprocess.TimeoutExpired, ValueError,
+                IndexError, KeyError) as e:
+            print(json.dumps({"metric": metric, "error": str(e)[-800:],
+                              "environment": probe_environment()}))
+            sys.exit(1)
+        out["environment"] = probe_environment()
+        print(json.dumps(out))
+        return
     if metric is not None and metric not in PREPS:
-        print(json.dumps({"error": f"unknown metric {metric!r}; choose from "
-                                   f"{sorted(PREPS) + ['scaling']}"}))
+        print(json.dumps(
+            {"error": f"unknown metric {metric!r}; choose from "
+                      f"{sorted(PREPS) + ['scaling', 'transformer_pipelined']}"
+             }))
         sys.exit(2)
     if metric in PREPS:
         try:
@@ -1058,11 +1218,13 @@ def main():
     for name in DEFAULT_PLAN:
         for attempt in (1, 2):
             try:
-                results[name] = bench_differential(name)
+                results[name] = (bench_pipelined()
+                                 if name == "transformer_pipelined"
+                                 else bench_differential(name))
                 errors.pop(name, None)
                 break
             except (RuntimeError, subprocess.TimeoutExpired,
-                    ValueError, IndexError) as e:
+                    ValueError, IndexError, KeyError) as e:
                 errors[name] = f"attempt {attempt}: {e}"
     headline = dict(results.get("resnet50", {}))
     full = {**headline,

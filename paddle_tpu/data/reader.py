@@ -54,30 +54,55 @@ def shuffle(reader_fn: Reader, buf_size: int, seed: Optional[int] = None) -> Rea
 
 def buffered(reader_fn: Reader, size: int) -> Reader:
     """Decouple producer/consumer with a bounded queue on a thread
-    (reference: buffered decorator)."""
+    (reference: buffered decorator).
+
+    Shutdown contract: when the consumer abandons the generator early
+    (``break`` mid-pass, :func:`firstn`, generator ``close()``), the fill
+    thread terminates instead of blocking forever on ``q.put`` into the
+    full queue — the generator's ``finally`` sets a stop event every
+    producer-side ``put`` polls. Producer exceptions surface PROMPTLY:
+    the consumer re-raises as soon as the error is recorded, without
+    first draining the items already buffered ahead of it."""
     def reader():
         q: queue.Queue = queue.Queue(maxsize=size)
         end = object()
         err: List[BaseException] = []
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False                       # consumer gone
 
         def fill():
             try:
                 for item in reader_fn():
-                    q.put(item)
+                    if not _put(item):
+                        return
             except BaseException as e:  # propagate into consumer
                 err.append(e)
             finally:
-                q.put(end)
+                _put(end)
 
-        t = threading.Thread(target=fill, daemon=True)
+        t = threading.Thread(target=fill, daemon=True,
+                             name="paddle_tpu.data.buffered.fill")
         t.start()
-        while True:
-            item = q.get()
-            if item is end:
-                break
-            yield item
-        if err:
-            raise err[0]
+        try:
+            while True:
+                if err:                        # prompt: don't drain first
+                    raise err[0]
+                item = q.get()
+                if item is end:
+                    break
+                yield item
+            if err:
+                raise err[0]
+        finally:
+            stop.set()                         # unblock + end the producer
     return reader
 
 

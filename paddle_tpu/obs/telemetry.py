@@ -122,6 +122,13 @@ class Telemetry:
         ``tokens_per_sec`` in step records.
       peak_flops: MFU denominator override (defaults to the spec-sheet
         table keyed by device kind; None on CPU, which disables MFU).
+      retrace_warn_threshold: one-shot WARNING once ``retrace_count``
+        reaches this many distinct step fingerprints beyond the first
+        compile — the silent-recompile trap (each distinct ragged pass
+        tail compiles a new program). The warning points at
+        ``batched(drop_last=True)`` / padding to a fixed shape. The
+        default (3) never fires for the healthy fused pattern (one
+        full-group shape + one tail shape = 1 retrace).
     """
 
     def __init__(self, sinks: Optional[Sequence[Sink]] = None,
@@ -129,7 +136,8 @@ class Telemetry:
                  fence: bool = True,
                  flops_per_step: Optional[float] = None,
                  tokens_per_step: Optional[float] = None,
-                 peak_flops: Optional[float] = None):
+                 peak_flops: Optional[float] = None,
+                 retrace_warn_threshold: int = 3):
         if sinks is None:
             sinks = [InMemorySink()]
         self.sinks: List[Sink] = [s() if isinstance(s, type) else s
@@ -144,6 +152,8 @@ class Telemetry:
         self._fingerprints: Dict[Any, int] = {}
         self.compile_count = 0
         self.retrace_count = 0
+        self.retrace_warn_threshold = int(retrace_warn_threshold)
+        self._retrace_warned = False
         self.hlo_flops_per_call: Optional[float] = None
         # memory peaks
         self.peak_bytes: Optional[int] = None       # per-pass peak
@@ -164,6 +174,17 @@ class Telemetry:
         self.compile_count += 1
         if self.compile_count > 1:
             self.retrace_count += 1
+            if (not self._retrace_warned
+                    and self.retrace_count >= self.retrace_warn_threshold):
+                self._retrace_warned = True
+                _log.warning(
+                    "%d distinct step fingerprints have each compiled their "
+                    "own program (%d retraces) — every distinct batch/group "
+                    "shape is a silent recompile. Ragged pass tails are the "
+                    "usual cause: use data.batched(..., drop_last=True), pad "
+                    "batches to a fixed shape, or make the pass length a "
+                    "multiple of steps_per_call*grad_accum.",
+                    self.compile_count, self.retrace_count)
         return True
 
     def record_compile(self, fingerprint, wall_s: float,
@@ -228,12 +249,27 @@ class Telemetry:
         rec.update(self.last_health)
         for k in HEALTH_KEYS:          # fixed schema even with health=False
             rec.setdefault(k, None)
+        # host-pipeline overlap keys: fixed schema; None outside pipelined
+        # mode (stage_ms = background stack+shard wall, drain_wait_ms = the
+        # blocking loss fetch at drain, overlap_frac = fraction of staging
+        # cost hidden from the main thread)
+        for k in ("stage_ms", "drain_wait_ms", "overlap_frac"):
+            rec.setdefault(k, None)
         # throughput / MFU: prefer true device time (fenced); fall back to
-        # dispatch wall when fencing is off (labelled by fenced=False)
+        # dispatch wall when fencing is off (labelled by fenced=False).
+        # PIPELINED records (drain_wait_ms present) get NO per-record
+        # throughput/MFU: without a fence the per-call device time is
+        # unknowable, and a group released late (boundary/pass-end
+        # drain-all, host-bound windows) can drain with ~0 wait — deriving
+        # a rate from dispatch+drain would inflate it arbitrarily (>100%
+        # MFU). summary() derives the honest aggregate steps/s from the
+        # record timestamps instead.
         k_steps = rec.get("k_steps") or 1
         dev_s = rec.get("device_ms")
         disp_s = rec.get("dispatch_ms")
-        total_ms = (dev_s or 0.0) + (disp_s or 0.0)
+        pipelined = rec.get("drain_wait_ms") is not None
+        total_ms = (0.0 if pipelined
+                    else (dev_s or 0.0) + (disp_s or 0.0))
         rec["fenced"] = bool(self.fence and dev_s is not None)
         if total_ms > 0:
             per_step_s = total_ms * 1e-3 / k_steps
@@ -298,7 +334,8 @@ class Telemetry:
                 steps = s.by_kind("step")
                 if steps:
                     for key in ("host_stack_ms", "shard_ms", "dispatch_ms",
-                                "device_ms", "replay_ms"):
+                                "device_ms", "replay_ms", "stage_ms",
+                                "drain_wait_ms", "overlap_frac"):
                         vals = [r[key] for r in steps
                                 if r.get(key) is not None]
                         if vals:
@@ -309,5 +346,21 @@ class Telemetry:
                                 "grad_norm"):
                         if last.get(key) is not None:
                             out[key] = last[key]
+                    # pipelined aggregate rate from record timestamps (the
+                    # per-record rate is deliberately absent — see
+                    # emit_step): steps completed between the first and
+                    # last drained records over their wall span
+                    piped = [r for r in steps
+                             if r.get("drain_wait_ms") is not None]
+                    if len(piped) >= 2:
+                        span = piped[-1]["ts"] - piped[0]["ts"]
+                        done = sum(r.get("k_steps") or 1
+                                   for r in piped[1:])
+                        if span > 0:
+                            rate = done / span
+                            out["pipelined_steps_per_sec"] = round(rate, 3)
+                            if self.tokens_per_step:
+                                out["pipelined_tokens_per_sec"] = round(
+                                    rate * self.tokens_per_step, 2)
                 break
         return out

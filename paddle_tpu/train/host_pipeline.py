@@ -1,0 +1,273 @@
+"""Async host pipeline — double-buffered group staging + a bounded
+in-flight dispatch window for the fused hot loop.
+
+The problem (telemetry PR's measurement): with ``steps_per_call`` fusion
+the per-call dispatch constant is amortized, but the host still serializes
+each group: stack K*M batches (``_stack_group``), ``device_put`` them
+(``_shard_fused``), dispatch, then ``jax.device_get`` the losses for the
+event replay — and that fetch fences the host until the device call
+finishes, so group N+1 cannot even be staged while call N runs. The fix is
+the standard production input-pipeline design (tf.data, NVIDIA DALI): the
+host work moves off the critical path and the host-side result fetch is
+deferred behind a bounded window of in-flight calls.
+
+Three cooperating pieces, all host-side (no change to any compiled
+program — ``pipeline_depth`` is bit-exact with the serial loop):
+
+- :class:`GroupStager` — ONE background thread that stacks and
+  ``device_put``-shards the NEXT group while the current call runs on
+  device (double buffering: its input queue holds at most one raw group,
+  so at most one group is being staged while one staged group awaits
+  dispatch — bounded host memory). ``device_put`` from a worker thread is
+  safe: it touches no trainer state, only the shared per-leaf sharding
+  rule.
+- the bounded **in-flight window** — up to ``pipeline_depth`` dispatched
+  fused calls whose host replay (events, costs, evaluator updates,
+  logging, telemetry records) is deferred. JAX's async dispatch already
+  chains call N's donated outputs into call N+1 without a host sync; the
+  window just stops the host from asking for the losses too early.
+- the **drain policy** — replay is FIFO, so the serial event order is
+  preserved exactly. A drain happens (a) when the window is full (oldest
+  group only), (b) BEFORE the save at every ``saving_period`` checkpoint
+  boundary (the save must observe a quiesced ``train_state`` — no later
+  dispatch may have advanced it — and ``nan_check``'s skip-the-poisoned-
+  save rule needs the group's losses on host), and (c) at pass end.
+
+Telemetry accounting (``obs.Telemetry`` step records): ``stage_ms`` is the
+background stack+shard wall for the call's group, ``drain_wait_ms`` the
+time the host actually blocked fetching the call's losses at drain, and
+``overlap_frac`` the fraction of staging cost hidden from the main thread
+(1.0 = the staged group was ready the moment the dispatcher wanted it).
+Per-call ``device_ms`` is None in pipelined mode — a per-call
+``block_until_ready`` fence would serialize exactly the pipeline this
+module exists to build (the README "fencing rule"). Per-record
+throughput/MFU are therefore absent too (a late-drained group's ~0 wait
+would inflate them arbitrarily); ``Telemetry.summary()`` derives the
+honest aggregate ``pipelined_steps_per_sec`` from record timestamps.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+__all__ = ["GroupStager", "StagedUnit", "StagedGroup", "FusedPipeline"]
+
+
+@dataclasses.dataclass
+class StagedUnit:
+    """One dispatch-ready slice of a group: the [k, m_eff, batch, ...]
+    device tree plus the background staging timings."""
+    offset: int          # first host-batch index within the group buffer
+    m_eff: int
+    batches: Any         # device pytree, already placed by the shared rule
+    stack_s: float
+    shard_s: float
+
+
+@dataclasses.dataclass
+class StagedGroup:
+    buf_start: int       # pass-relative index of the group's first batch
+    buf_len: int
+    units: List[StagedUnit]
+    boundary: bool       # crosses a saving_period checkpoint boundary
+    crc: Optional[int]   # fingerprint of the group's last host batch
+                         # (computed in the stager, only for boundary groups)
+
+
+@dataclasses.dataclass
+class _PendingGroup:
+    """A dispatched-but-not-replayed group in the in-flight window."""
+    staged: StagedGroup
+    results: List[tuple]     # per-dispatch tuples, the _finalize_group layout
+    overlap_frac: Optional[float]
+
+
+class GroupStager:
+    """One background staging thread with a depth-1 input queue.
+
+    ``submit`` hands a raw group to the worker (blocking only when the
+    worker is still busy with the previous group — backpressure, so host
+    memory stays bounded at ~2 raw groups + the staged device trees).
+    ``get`` returns the next staged group in submission order together
+    with the time the caller blocked waiting for it. Worker exceptions
+    re-raise in the caller at the next ``submit``/``get``.
+    """
+
+    _POLL_S = 0.05
+
+    def __init__(self, stage_fn: Callable[[Any], Any]):
+        self._stage_fn = stage_fn
+        self._in: queue.Queue = queue.Queue(maxsize=1)
+        self._out: queue.Queue = queue.Queue()
+        self._exc: List[BaseException] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="paddle_tpu.host_pipeline.stager")
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                item = self._in.get(timeout=self._POLL_S)
+            except queue.Empty:
+                continue
+            try:
+                self._out.put(self._stage_fn(item))
+            except BaseException as e:   # surface in the consumer
+                self._exc.append(e)
+                self._out.put(None)      # wake a blocked get()
+                return
+
+    def _check(self):
+        if self._exc:
+            raise self._exc[0]
+
+    def submit(self, work) -> float:
+        """Enqueue one raw group; returns seconds blocked on backpressure."""
+        t0 = time.perf_counter()
+        while True:
+            self._check()
+            try:
+                self._in.put(work, timeout=self._POLL_S)
+                return time.perf_counter() - t0
+            except queue.Full:
+                continue
+
+    def get(self, block: bool):
+        """Next staged group as ``(StagedGroup, wait_s)``; None when
+        ``block=False`` and nothing is staged yet."""
+        t0 = time.perf_counter()
+        while True:
+            self._check()
+            try:
+                item = self._out.get(block=False) if not block else \
+                    self._out.get(timeout=self._POLL_S)
+            except queue.Empty:
+                if not block:
+                    return None
+                continue
+            if item is None:             # worker died; _check raises
+                self._check()
+                raise RuntimeError("host-pipeline stager exited unexpectedly")
+            return item, time.perf_counter() - t0
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+
+
+class FusedPipeline:
+    """Drives the fused hot loop with ``pipeline_depth`` >= 2: groups flow
+    reader -> stager thread -> dispatch -> in-flight window -> FIFO drain.
+
+    The trainer owns the math (``_stage_group_work``, ``_dispatch_fused``,
+    ``_finalize_group``); this class owns only the overlap scheduling and
+    the drain policy. One instance per pass (the window never spans a
+    pass boundary — pass end drains everything).
+    """
+
+    def __init__(self, trainer, pass_id, rng, handler, costs, log_period,
+                 saving_period, checkpoint_dir, checkpoint_keep, save_fn,
+                 depth: int):
+        self._tr = trainer
+        self._pass_id = pass_id
+        self._rng = rng
+        self._handler = handler
+        self._costs = costs
+        self._log_period = log_period
+        self._saving_period = saving_period
+        self._checkpoint_dir = checkpoint_dir
+        self._checkpoint_keep = checkpoint_keep
+        self._save_fn = save_fn
+        self.depth = max(2, int(depth))
+        self._stager = GroupStager(trainer._stage_group_work)
+        self._window: collections.deque = collections.deque()
+        self._n_submitted = 0
+        self._n_dispatched = 0
+        # backpressure wait from submit(), attributed to the next
+        # dispatched group's overlap accounting
+        self._carry_wait_s = 0.0
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, buf, buf_start: int):
+        """Hand one complete group buffer to the pipeline. Never blocks on
+        device results unless the window or a checkpoint boundary forces a
+        drain."""
+        sp = self._saving_period
+        end = buf_start + len(buf)
+        boundary = bool(sp and self._checkpoint_dir
+                        and end // sp > buf_start // sp)
+        self._pump()                      # free the stager's output first
+        self._carry_wait_s += self._stager.submit(
+            (list(buf), buf_start, boundary))
+        self._n_submitted += 1
+        self._pump()
+
+    def _pump(self):
+        """Dispatch every already-staged group without blocking."""
+        while self._n_dispatched < self._n_submitted:
+            got = self._stager.get(block=False)
+            if got is None:
+                return
+            self._dispatch_staged(*got)
+
+    def _dispatch_staged(self, sg: StagedGroup, wait_s: float):
+        tr = self._tr
+        # window bound: make room BEFORE dispatching so at most `depth`
+        # calls are ever in flight
+        while self._in_flight_calls() >= self.depth:
+            self._drain_one()
+        wait = wait_s + self._carry_wait_s
+        self._carry_wait_s = 0.0
+        results, stage_total = [], 0.0
+        for u in sg.units:
+            losses, stats, health, rec = tr._dispatch_fused(
+                None, self._rng, staged=u, defer=True)
+            stage_total += u.stack_s + u.shard_s
+            results.append((sg.buf_start + u.offset, u.m_eff, losses, stats,
+                            tr._host_step, health, rec))
+        overlap = (max(0.0, min(1.0, 1.0 - wait / stage_total))
+                   if stage_total > 0 else None)
+        self._window.append(_PendingGroup(sg, results, overlap))
+        self._n_dispatched += 1
+        if sg.boundary:
+            # checkpoint boundary: the save inside _finalize_group must see
+            # train_state quiesced at exactly this group's last step — no
+            # later dispatch may run first, so drain everything now.
+            self.drain_all()
+
+    def _in_flight_calls(self) -> int:
+        return sum(len(g.results) for g in self._window)
+
+    # -- drain --------------------------------------------------------------
+
+    def _drain_one(self):
+        pg = self._window.popleft()
+        self._tr._finalize_group(
+            self._pass_id, pg.staged.buf_start, pg.staged.buf_len,
+            pg.results, self._handler, self._costs, self._log_period,
+            self._saving_period, self._checkpoint_dir, self._checkpoint_keep,
+            self._save_fn, crc_fn=lambda: pg.staged.crc,
+            drain_timing=True, overlap_frac=pg.overlap_frac)
+
+    def drain_all(self):
+        while self._window:
+            self._drain_one()
+
+    def flush(self):
+        """Pass end: dispatch everything still staging, then drain the
+        whole window (FIFO — serial event order)."""
+        while self._n_dispatched < self._n_submitted:
+            sg, wait_s = self._stager.get(block=True)
+            self._dispatch_staged(sg, wait_s)
+        self.drain_all()
+
+    def close(self):
+        self._stager.close()

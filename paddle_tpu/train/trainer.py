@@ -64,8 +64,16 @@ def _step_fingerprint(batch_tree) -> tuple:
     pytree feeding one dispatch. A dispatch whose fingerprint was never
     seen before will trace + compile (a jit cache miss); the telemetry
     retrace counter is keyed by exactly this tuple. For fused groups the
-    stacked leaves carry [K, M, ...], so K/M changes fingerprint too."""
-    return tuple((tuple(np.shape(leaf)), str(np.asarray(leaf).dtype))
+    stacked leaves carry [K, M, ...], so K/M changes fingerprint too.
+
+    Metadata-only on purpose: leaves may be DEVICE arrays (the pipelined
+    path fingerprints the staged group) and an ``np.asarray`` here would
+    download them."""
+    def leaf_dtype(leaf):
+        dt = getattr(leaf, "dtype", None)
+        return str(dt) if dt is not None else str(np.asarray(leaf).dtype)
+
+    return tuple((tuple(np.shape(leaf)), leaf_dtype(leaf))
                  for leaf in jax.tree_util.tree_leaves(batch_tree))
 
 
@@ -123,6 +131,29 @@ class Trainer:
         optimizer update — and with ``param_sharding`` the gradient
         all-reduce the partitioner hoists out of the accumulation loop —
         fires once per accumulated step, not per microbatch.
+      pipeline_depth: W > 1 turns on the async host pipeline
+        (``train/host_pipeline.py``): a background stager thread stacks and
+        ``device_put``-shards group N+1 (double-buffered) while call N runs
+        on device, and up to W fused calls stay in flight with their host
+        replay (events, costs, evaluator updates, logging, telemetry)
+        deferred until drained — removing the per-group host staging and
+        the eager per-group loss fetch from the critical path. Draining is
+        FIFO (the serial event order is preserved exactly), forced at
+        every ``saving_period`` checkpoint boundary (saves observe a
+        quiesced ``train_state``; ``nan_check``'s skip-the-poisoned-save
+        rule still holds) and at pass end. Bit-identical math to the
+        serial loop — same dispatches, same order, same rng. The plain
+        (K=1, M=1) loop gets the same deferred-fetch treatment when
+        ``nan_check`` is off (with it on, plain mode must trap each loss
+        before the next dispatch, so it stays serial). The default W=1 is
+        today's serial loop, byte-identical. With telemetry attached,
+        pipelined calls skip the per-call device fence (it would serialize
+        the pipeline) and record ``stage_ms`` / ``drain_wait_ms`` /
+        ``overlap_frac`` instead. CONTRACT CHANGE for event handlers that
+        read ``trainer.train_state``: at replay time the state may already
+        include up to W later dispatched groups (only ``saving_period``
+        saves are quiesced, via the forced boundary drain) — handlers
+        that snapshot state per iteration need ``pipeline_depth=1``.
       telemetry: optional :class:`paddle_tpu.obs.Telemetry`. When attached,
         the trainer records a per-call step-time breakdown (host stack /
         shard / dispatch / fenced device / events-replay), tracks jit
@@ -142,7 +173,7 @@ class Trainer:
                  nan_check: bool = False,
                  param_stats_period: Optional[int] = None,
                  steps_per_call: int = 1, grad_accum: int = 1,
-                 telemetry=None):
+                 pipeline_depth: int = 1, telemetry=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -164,8 +195,16 @@ class Trainer:
         self._param_stats_period = param_stats_period
         if steps_per_call < 1 or grad_accum < 1:
             raise ValueError("steps_per_call and grad_accum must be >= 1")
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
         self.steps_per_call = int(steps_per_call)
         self.grad_accum = int(grad_accum)
+        # pipeline_depth: bounded in-flight dispatch window (1 = serial).
+        self.pipeline_depth = int(pipeline_depth)
+        # host-side optimizer-step mirror: lets the fused replay number its
+        # steps without fetching the device step scalar (a sync that would
+        # defeat the pipeline); re-anchored from train_state at pass start.
+        self._host_step = 0
         # telemetry: None = the untelemetered hot loop, byte-identical to
         # the pre-obs build (no health outputs in the traced step, no
         # fencing, no extra fetches — pinned by tests/test_obs.py).
@@ -451,11 +490,7 @@ class Trainer:
     def _train_loop(self, reader, num_passes, handler, test_reader,
                     checkpoint_dir, checkpoint_keep, saving_period,
                     log_period, rng, start_pass, skip_batches, save_fn):
-        ts = self.train_state
         fused = self.steps_per_call > 1 or self.grad_accum > 1
-        group = self.steps_per_call * self.grad_accum
-        params, state, opt_state, step = (ts.params, ts.state, ts.opt_state,
-                                          ts.step)
         tel = self.telemetry
         for pass_id in range(start_pass, num_passes):
             handler(ev.BeginPass(pass_id))
@@ -463,157 +498,26 @@ class Trainer:
                 tel.begin_pass(pass_id)   # reset the per-pass memory peak
             if self.evaluator is not None:
                 self.evaluator.reset()
+            # re-anchor the host-side step mirror (train_state is quiesced
+            # at pass boundaries: serial mode syncs per group, pipelined
+            # mode drained at the previous pass end)
+            self._host_step = int(jax.device_get(self.train_state.step))
             costs = []
-            buf, buf_start = [], 0
-            for batch_id, host_batch in enumerate(reader()):
-                if pass_id == start_pass and batch_id < skip_batches:
-                    # Deterministic replay skip on resume. On the last
-                    # skipped batch, compare against the fingerprint the
-                    # checkpoint recorded for it — a mismatch means the
-                    # reader is not deterministic and the resumed pass
-                    # would train on a different batch remainder.
-                    if batch_id == skip_batches - 1:
-                        want = (self._last_iter_state or {}).get("batch_crc")
-                        if want is not None and \
-                                _batch_fingerprint(host_batch) != int(want):
-                            _log.warning(
-                                "resume: reader replay diverged from the "
-                                "checkpointed batch fingerprint at batch %d "
-                                "— the reader is nondeterministic (shuffle/"
-                                "buffered?); the resumed pass trains on a "
-                                "different batch remainder than the "
-                                "interrupted run", batch_id)
-                    continue
-                if fused:
-                    # Buffer K*M host batches, then ONE device dispatch for
-                    # K optimizer steps; host bookkeeping replays after. A
-                    # shape change mid-group (ragged final reader batch)
-                    # flushes the buffer early — groups must stack.
-                    if buf and _batch_shapes(host_batch) != \
-                            _batch_shapes(buf[0]):
-                        self._run_fused_group(
-                            buf, buf_start, pass_id, rng, handler, costs,
-                            log_period, saving_period, checkpoint_dir,
-                            checkpoint_keep, save_fn)
-                        buf = []
-                    if not buf:
-                        buf_start = batch_id
-                    buf.append(host_batch)
-                    if len(buf) == group:
-                        self._run_fused_group(
-                            buf, buf_start, pass_id, rng, handler, costs,
-                            log_period, saving_period, checkpoint_dir,
-                            checkpoint_keep, save_fn)
-                        buf = []
-                    continue
-                handler(ev.BeginIteration(pass_id, batch_id))
-                is_new, fp = False, None
-                if tel is not None:
-                    fp = ((1, 1),) + _step_fingerprint(host_batch)
-                    is_new = tel.observe_fingerprint(fp)
-                t0 = time.perf_counter()
-                with self.stats.time("shard_batch"):
-                    batch = self._shard(host_batch)
-                t1 = time.perf_counter()
-                hlo_flops = None
-                if is_new:
-                    from ..obs.telemetry import lowered_hlo_flops
-                    try:
-                        hlo_flops = lowered_hlo_flops(self._train_step.lower(
-                            params, state, opt_state, step, batch, rng))
-                    except Exception:
-                        hlo_flops = None
-                # dispatch timing starts AFTER the FLOPs lowering — the
-                # measurement layer must not bill its own extra trace to
-                # the step it measures (the fused path does the same)
-                t_disp = time.perf_counter()
-                with self.stats.time("train_step"):
-                    out = self._train_step(params, state, opt_state, step,
-                                           batch, rng)
-                params, state, opt_state, step = out[:4]
-                loss, stats = out[4], out[5]
-                health = out[6] if len(out) > 6 else None
-                t2 = time.perf_counter()
-                device_s = None
-                if tel is not None and tel.fence:
-                    # the fencing rule: the dispatch above returned as soon
-                    # as the program was enqueued — device time needs a sync
-                    jax.block_until_ready((params, loss))
-                    device_s = time.perf_counter() - t2
-                    self.stats.add("device_wait", device_s)
-                if is_new:
-                    tel.record_compile(
-                        fp, wall_s=(t2 - t_disp) + (device_s or 0.0),
-                        hlo_flops=hlo_flops, meta={"k_steps": 1, "m": 1})
-                # Refresh train_state every step: with buffer donation the
-                # previous arrays are invalidated, and event handlers may read
-                # trainer.train_state (e.g. to save) mid-pass.
-                self.train_state = TrainState(params, state, opt_state, step)
-                cost = float(loss)
-                if tel is not None:
-                    if health is not None:
-                        tel.update_health(jax.device_get(health))
-                    rec = tel.emit_step(
-                        {"pass": pass_id, "step": int(step),
-                         "k_steps": 1, "m": 1, "loss": cost,
-                         "host_stack_ms": None,
-                         "shard_ms": round((t1 - t0) * 1e3, 3),
-                         "dispatch_ms": round((t2 - t_disp) * 1e3, 3),
-                         "device_ms": (round(device_s * 1e3, 3)
-                                       if device_s is not None else None),
-                         "replay_ms": None})
-                    handler(ev.TelemetryRecord(record=rec))
-                if self._nan_check and not np.isfinite(cost):
-                    from ..utils import debug as dbg
-                    bad = dbg.nonfinite_leaves(
-                        {"params": params, "state": state})
-                    raise FloatingPointError(
-                        f"non-finite loss {cost} at pass {pass_id} batch "
-                        f"{batch_id} (step {int(step)}); non-finite leaves: "
-                        f"{bad[:8] or 'none (loss only)'}")
-                costs.append(cost)
-                metrics = {}
-                if self.evaluator is not None:
-                    self.evaluator.update(jax.device_get(stats))
-                    metrics = self.evaluator.result()
-                if log_period and (batch_id + 1) % log_period == 0:
-                    msg = " ".join(f"{k}={v:.4f}" for k, v in metrics.items())
-                    if tel is not None and tel.last_health:
-                        # health monitors are fetched per call (riding the
-                        # same sync as the loss) but LOGGED only here
-                        msg += " " + " ".join(
-                            f"{k}={v:.3g}"
-                            for k, v in tel.last_health.items())
-                    _log.info("pass %d batch %d cost=%.4f %s",
-                              pass_id, batch_id + 1, cost, msg)
-                    self._log_stat_report()
-                if self._param_stats_period and \
-                        (batch_id + 1) % self._param_stats_period == 0:
-                    self._log_param_stats(pass_id, batch_id)
-                if saving_period and checkpoint_dir and \
-                        (batch_id + 1) % saving_period == 0:
-                    save_fn(
-                        checkpoint_dir, pass_id,
-                        {**self.train_state.as_dict(),
-                         "iter": {"pass": pass_id, "next_batch": batch_id + 1,
-                                  "completed": 0,
-                                  "batch_crc": _batch_fingerprint(host_batch)}},
-                        keep_last=checkpoint_keep)
-                handler(ev.EndIteration(pass_id, batch_id, int(step), cost,
-                                        metrics))
-            if fused and buf:
-                # Pass tail smaller than K*M: flush what's buffered (the
-                # final optimizer step may accumulate < M microbatches;
-                # its loss/grads average over the actual count).
-                self._run_fused_group(
-                    buf, buf_start, pass_id, rng, handler, costs,
-                    log_period, saving_period, checkpoint_dir,
-                    checkpoint_keep, save_fn)
-                buf = []
-            if fused:
-                ts = self.train_state
-                params, state, opt_state, step = (ts.params, ts.state,
-                                                  ts.opt_state, ts.step)
+            pipe = None
+            if fused and self.pipeline_depth > 1:
+                from .host_pipeline import FusedPipeline
+                pipe = FusedPipeline(
+                    self, pass_id, rng, handler, costs, log_period,
+                    saving_period, checkpoint_dir, checkpoint_keep, save_fn,
+                    depth=self.pipeline_depth)
+            try:
+                self._run_pass(
+                    reader, pass_id, start_pass, skip_batches, pipe,
+                    handler, costs, log_period, saving_period,
+                    checkpoint_dir, checkpoint_keep, save_fn, rng)
+            finally:
+                if pipe is not None:
+                    pipe.close()
             pass_metrics = (self.evaluator.result()
                             if self.evaluator is not None else {})
             pass_metrics["mean_cost"] = float(np.mean(costs)) if costs else 0.0
@@ -631,7 +535,366 @@ class Trainer:
             handler(ev.EndPass(pass_id, pass_metrics))
         return self.train_state
 
+    def _run_pass(self, reader, pass_id, start_pass, skip_batches, pipe,
+                  handler, costs, log_period, saving_period, checkpoint_dir,
+                  checkpoint_keep, save_fn, rng):
+        """One pass's batch loop (split out of ``_train_loop`` so the fused
+        pipeline's stager thread is always closed via try/finally). The
+        serial paths are byte-identical to the pre-pipeline loop."""
+        tel = self.telemetry
+        fused = self.steps_per_call > 1 or self.grad_accum > 1
+        group = self.steps_per_call * self.grad_accum
+        # The plain loop defers its loss fetch only with nan_check off: the
+        # finiteness trap's contract is raise-before-the-next-dispatch.
+        plain_deferred = (not fused and self.pipeline_depth > 1
+                          and not self._nan_check)
+        ts = self.train_state
+        params, state, opt_state, step = (ts.params, ts.state, ts.opt_state,
+                                          ts.step)
+        buf, buf_start = [], 0
+        pending = []              # plain deferred-fetch in-flight window
+        for batch_id, host_batch in enumerate(reader()):
+            if pass_id == start_pass and batch_id < skip_batches:
+                # Deterministic replay skip on resume. On the last
+                # skipped batch, compare against the fingerprint the
+                # checkpoint recorded for it — a mismatch means the
+                # reader is not deterministic and the resumed pass
+                # would train on a different batch remainder.
+                if batch_id == skip_batches - 1:
+                    want = (self._last_iter_state or {}).get("batch_crc")
+                    if want is not None and \
+                            _batch_fingerprint(host_batch) != int(want):
+                        _log.warning(
+                            "resume: reader replay diverged from the "
+                            "checkpointed batch fingerprint at batch %d "
+                            "— the reader is nondeterministic (shuffle/"
+                            "buffered?); the resumed pass trains on a "
+                            "different batch remainder than the "
+                            "interrupted run", batch_id)
+                continue
+            if fused:
+                # Buffer K*M host batches, then ONE device dispatch for
+                # K optimizer steps; host bookkeeping replays after. A
+                # shape change mid-group (ragged final reader batch)
+                # flushes the buffer early — groups must stack. With a
+                # pipe (pipeline_depth > 1) the group goes to the stager
+                # thread instead of being stacked/dispatched serially.
+                if buf and _batch_shapes(host_batch) != \
+                        _batch_shapes(buf[0]):
+                    if pipe is not None:
+                        pipe.submit(buf, buf_start)
+                    else:
+                        self._run_fused_group(
+                            buf, buf_start, pass_id, rng, handler, costs,
+                            log_period, saving_period, checkpoint_dir,
+                            checkpoint_keep, save_fn)
+                    buf = []
+                if not buf:
+                    buf_start = batch_id
+                buf.append(host_batch)
+                if len(buf) == group:
+                    if pipe is not None:
+                        pipe.submit(buf, buf_start)
+                    else:
+                        self._run_fused_group(
+                            buf, buf_start, pass_id, rng, handler, costs,
+                            log_period, saving_period, checkpoint_dir,
+                            checkpoint_keep, save_fn)
+                    buf = []
+                continue
+            if plain_deferred:
+                # The plain loop's deferred-fetch window: dispatch now,
+                # replay the host bookkeeping (Begin/EndIteration both —
+                # like fused mode) when the window drains. nan_check off
+                # by construction. Make room BEFORE dispatching (like
+                # FusedPipeline) so at most pipeline_depth calls are ever
+                # in flight.
+                while len(pending) >= self.pipeline_depth:
+                    self._replay_plain(
+                        pending.pop(0), pass_id, handler, costs,
+                        log_period, checkpoint_dir, checkpoint_keep,
+                        save_fn)
+                params, state, opt_state, step = self._plain_dispatch(
+                    host_batch, pass_id, batch_id, params, state,
+                    opt_state, step, rng, tel, pending, saving_period,
+                    checkpoint_dir)
+                if pending[-1]["boundary"]:
+                    # checkpoint boundary: the save needs train_state
+                    # quiesced at exactly this batch — drain everything
+                    # before the next dispatch advances it
+                    while pending:
+                        self._replay_plain(
+                            pending.pop(0), pass_id, handler, costs,
+                            log_period, checkpoint_dir, checkpoint_keep,
+                            save_fn)
+                continue
+            # SERIAL plain step. _plain_dispatch/_replay_plain mirror this
+            # body for the deferred-fetch window (divergences are the
+            # point: BeginIteration pre-dispatch here, the per-call fence,
+            # int(step) fetches) — a bookkeeping change here must be
+            # mirrored there.
+            handler(ev.BeginIteration(pass_id, batch_id))
+            is_new, fp = False, None
+            if tel is not None:
+                fp = ((1, 1),) + _step_fingerprint(host_batch)
+                is_new = tel.observe_fingerprint(fp)
+            t0 = time.perf_counter()
+            with self.stats.time("shard_batch"):
+                batch = self._shard(host_batch)
+            t1 = time.perf_counter()
+            hlo_flops = None
+            if is_new:
+                from ..obs.telemetry import lowered_hlo_flops
+                try:
+                    hlo_flops = lowered_hlo_flops(self._train_step.lower(
+                        params, state, opt_state, step, batch, rng))
+                except Exception:
+                    hlo_flops = None
+            # dispatch timing starts AFTER the FLOPs lowering — the
+            # measurement layer must not bill its own extra trace to
+            # the step it measures (the fused path does the same)
+            t_disp = time.perf_counter()
+            with self.stats.time("train_step"):
+                out = self._train_step(params, state, opt_state, step,
+                                       batch, rng)
+            params, state, opt_state, step = out[:4]
+            loss, stats = out[4], out[5]
+            health = out[6] if len(out) > 6 else None
+            t2 = time.perf_counter()
+            device_s = None
+            if tel is not None and tel.fence:
+                # the fencing rule: the dispatch above returned as soon
+                # as the program was enqueued — device time needs a sync
+                jax.block_until_ready((params, loss))
+                device_s = time.perf_counter() - t2
+                self.stats.add("device_wait", device_s)
+            if is_new:
+                tel.record_compile(
+                    fp, wall_s=(t2 - t_disp) + (device_s or 0.0),
+                    hlo_flops=hlo_flops, meta={"k_steps": 1, "m": 1})
+            # Refresh train_state every step: with buffer donation the
+            # previous arrays are invalidated, and event handlers may read
+            # trainer.train_state (e.g. to save) mid-pass.
+            self.train_state = TrainState(params, state, opt_state, step)
+            self._host_step += 1
+            cost = float(loss)
+            if tel is not None:
+                if health is not None:
+                    tel.update_health(jax.device_get(health))
+                rec = tel.emit_step(
+                    {"pass": pass_id, "step": int(step),
+                     "k_steps": 1, "m": 1, "loss": cost,
+                     "host_stack_ms": None,
+                     "shard_ms": round((t1 - t0) * 1e3, 3),
+                     "dispatch_ms": round((t2 - t_disp) * 1e3, 3),
+                     "device_ms": (round(device_s * 1e3, 3)
+                                   if device_s is not None else None),
+                     "replay_ms": None})
+                handler(ev.TelemetryRecord(record=rec))
+            if self._nan_check and not np.isfinite(cost):
+                from ..utils import debug as dbg
+                bad = dbg.nonfinite_leaves(
+                    {"params": params, "state": state})
+                raise FloatingPointError(
+                    f"non-finite loss {cost} at pass {pass_id} batch "
+                    f"{batch_id} (step {int(step)}); non-finite leaves: "
+                    f"{bad[:8] or 'none (loss only)'}")
+            costs.append(cost)
+            metrics = {}
+            if self.evaluator is not None:
+                self.evaluator.update(jax.device_get(stats))
+                metrics = self.evaluator.result()
+            if log_period and (batch_id + 1) % log_period == 0:
+                msg = " ".join(f"{k}={v:.4f}" for k, v in metrics.items())
+                if tel is not None and tel.last_health:
+                    # health monitors are fetched per call (riding the
+                    # same sync as the loss) but LOGGED only here
+                    msg += " " + " ".join(
+                        f"{k}={v:.3g}"
+                        for k, v in tel.last_health.items())
+                _log.info("pass %d batch %d cost=%.4f %s",
+                          pass_id, batch_id + 1, cost, msg)
+                self._log_stat_report()
+            if self._param_stats_period and \
+                    (batch_id + 1) % self._param_stats_period == 0:
+                self._log_param_stats(pass_id, batch_id)
+            if saving_period and checkpoint_dir and \
+                    (batch_id + 1) % saving_period == 0:
+                save_fn(
+                    checkpoint_dir, pass_id,
+                    {**self.train_state.as_dict(),
+                     "iter": {"pass": pass_id, "next_batch": batch_id + 1,
+                              "completed": 0,
+                              "batch_crc": _batch_fingerprint(host_batch)}},
+                    keep_last=checkpoint_keep)
+            handler(ev.EndIteration(pass_id, batch_id, int(step), cost,
+                                    metrics))
+        if fused and buf:
+            # Pass tail smaller than K*M: flush what's buffered (the
+            # final optimizer step may accumulate < M microbatches;
+            # its loss/grads average over the actual count).
+            if pipe is not None:
+                pipe.submit(buf, buf_start)
+            else:
+                self._run_fused_group(
+                    buf, buf_start, pass_id, rng, handler, costs,
+                    log_period, saving_period, checkpoint_dir,
+                    checkpoint_keep, save_fn)
+        if pipe is not None:
+            pipe.flush()          # pass end drains the whole window (FIFO)
+        while pending:
+            self._replay_plain(pending.pop(0), pass_id, handler, costs,
+                               log_period, checkpoint_dir, checkpoint_keep,
+                               save_fn)
+
+    # -- plain deferred-fetch (pipeline_depth > 1, K=1, M=1) -----------------
+
+    def _plain_dispatch(self, host_batch, pass_id, batch_id, params, state,
+                        opt_state, step, rng, tel, pending, saving_period,
+                        checkpoint_dir):
+        """Dispatch ONE plain step without fetching anything; append a
+        pending entry for the deferred replay. Returns the new device-side
+        carry. No per-call fence even with telemetry on (it would serialize
+        the window); the drain records ``drain_wait_ms``.
+
+        This + ``_replay_plain`` mirror the SERIAL plain body in
+        ``_run_pass`` minus every host sync (fence, ``float(loss)``,
+        ``int(step)`` — replaced by the ``_host_step`` mirror) — keep the
+        bookkeeping in lockstep when editing either."""
+        is_new, fp = False, None
+        if tel is not None:
+            fp = ((1, 1),) + _step_fingerprint(host_batch)
+            is_new = tel.observe_fingerprint(fp)
+        t0 = time.perf_counter()
+        with self.stats.time("shard_batch"):
+            batch = self._shard(host_batch)
+        t1 = time.perf_counter()
+        hlo_flops = None
+        if is_new:
+            from ..obs.telemetry import lowered_hlo_flops
+            try:
+                hlo_flops = lowered_hlo_flops(self._train_step.lower(
+                    params, state, opt_state, step, batch, rng))
+            except Exception:
+                hlo_flops = None
+        t_disp = time.perf_counter()
+        with self.stats.time("train_step"):
+            out = self._train_step(params, state, opt_state, step, batch,
+                                   rng)
+        params, state, opt_state, step = out[:4]
+        t2 = time.perf_counter()
+        if is_new:
+            tel.record_compile(fp, wall_s=t2 - t_disp, hlo_flops=hlo_flops,
+                               meta={"k_steps": 1, "m": 1})
+        self.train_state = TrainState(params, state, opt_state, step)
+        self._host_step += 1
+        boundary = bool(saving_period and checkpoint_dir
+                        and (batch_id + 1) % saving_period == 0)
+        rec = None
+        if tel is not None:
+            rec = {"pass": pass_id, "step": self._host_step,
+                   "k_steps": 1, "m": 1,
+                   "host_stack_ms": None,
+                   "shard_ms": round((t1 - t0) * 1e3, 3),
+                   "dispatch_ms": round((t2 - t_disp) * 1e3, 3),
+                   "device_ms": None, "replay_ms": None}
+        pending.append({
+            "batch_id": batch_id, "step": self._host_step,
+            "loss": out[4], "stats": out[5],
+            "health": out[6] if len(out) > 6 else None,
+            "rec": rec, "boundary": boundary,
+            "crc": _batch_fingerprint(host_batch) if boundary else None})
+        return params, state, opt_state, step
+
+    def _replay_plain(self, entry, pass_id, handler, costs, log_period,
+                      checkpoint_dir, checkpoint_keep, save_fn):
+        """Deferred host bookkeeping for one plain step, replayed at drain
+        in dispatch order — the plain-loop analog of ``_post_fused``
+        (Begin/EndIteration both fire here, post-dispatch, like fused
+        mode; the observable event sequence matches the serial loop
+        exactly). ``nan_check`` is off by construction on this path."""
+        tel = self.telemetry
+        batch_id = entry["batch_id"]
+        handler(ev.BeginIteration(pass_id, batch_id))
+        t0 = time.perf_counter()
+        cost = float(np.asarray(jax.device_get(entry["loss"])))
+        drain_wait = time.perf_counter() - t0
+        self.stats.add("drain_wait", drain_wait)
+        if tel is not None:
+            if entry["health"] is not None:
+                tel.update_health(jax.device_get(entry["health"]))
+            rec = entry["rec"]
+            rec["loss"] = cost
+            rec["drain_wait_ms"] = round(drain_wait * 1e3, 3)
+            rec = tel.emit_step(rec)
+            handler(ev.TelemetryRecord(record=rec))
+        costs.append(cost)
+        metrics = {}
+        if self.evaluator is not None:
+            self.evaluator.update(jax.device_get(entry["stats"]))
+            metrics = self.evaluator.result()
+        if log_period and (batch_id + 1) % log_period == 0:
+            msg = " ".join(f"{k}={v:.4f}" for k, v in metrics.items())
+            if tel is not None and tel.last_health:
+                msg += " " + " ".join(f"{k}={v:.3g}"
+                                      for k, v in tel.last_health.items())
+            _log.info("pass %d batch %d cost=%.4f %s",
+                      pass_id, batch_id + 1, cost, msg)
+            self._log_stat_report()
+        if self._param_stats_period and \
+                (batch_id + 1) % self._param_stats_period == 0:
+            self._log_param_stats(pass_id, batch_id)
+        if entry["boundary"]:
+            # the boundary forced a full drain right after this batch's
+            # dispatch, so train_state is quiesced at exactly this step
+            save_fn(
+                checkpoint_dir, pass_id,
+                {**self.train_state.as_dict(),
+                 "iter": {"pass": pass_id, "next_batch": batch_id + 1,
+                          "completed": 0, "batch_crc": entry["crc"]}},
+                keep_last=checkpoint_keep)
+        handler(ev.EndIteration(pass_id, batch_id, entry["step"], cost,
+                                metrics))
+
     # -- fused dispatch ------------------------------------------------------
+
+    @staticmethod
+    def _plan_group(n: int, m: int):
+        """Split an n-batch group buffer into dispatch slices: the full
+        KxM part first, then the tail (whose final optimizer step may
+        accumulate < M microbatches). Returns [(offset, take, m_eff)] —
+        shared by the serial loop and the stager thread so pipelined
+        grouping is always in lockstep with serial grouping."""
+        plans, done = [], 0
+        while done < n:
+            rem = n - done
+            take = (rem // m) * m or rem
+            plans.append((done, take, m if take >= m else take))
+            done += take
+        return plans
+
+    def _stage_group_work(self, work):
+        """Stage one raw group buffer — stack + device_put every dispatch
+        slice via the shared ``_fused_leaf_sharding`` rule. RUNS IN THE
+        STAGER THREAD: touches no trainer mutable state (StatSet is
+        locked), so it can overlap the in-flight device calls."""
+        from .host_pipeline import StagedGroup, StagedUnit
+        buf, buf_start, boundary = work
+        units = []
+        for off, take, m_eff in self._plan_group(len(buf), self.grad_accum):
+            t0 = time.perf_counter()
+            stacked = self._stack_group(buf[off:off + take],
+                                        take // m_eff, m_eff)
+            t1 = time.perf_counter()
+            staged = self._shard_fused(stacked)
+            t2 = time.perf_counter()
+            self.stats.add("stage_stack", t1 - t0)
+            self.stats.add("stage_shard", t2 - t1)
+            units.append(StagedUnit(offset=off, m_eff=m_eff, batches=staged,
+                                    stack_s=t1 - t0, shard_s=t2 - t1))
+        crc = _batch_fingerprint(buf[-1]) if boundary else None
+        return StagedGroup(buf_start=buf_start, buf_len=len(buf),
+                           units=units, boundary=boundary, crc=crc)
 
     def _stack_group(self, sub, k: int, m: int):
         """Stack k*m host batches into one pytree with leaves
@@ -680,7 +943,8 @@ class Trainer:
             lambda x: jax.device_put(x, self._fused_leaf_sharding(x)),
             stacked)
 
-    def _dispatch_fused(self, stacked, rng, stack_s=None):
+    def _dispatch_fused(self, stacked, rng, stack_s=None, staged=None,
+                        defer=False):
         """One fused device call; refreshes train_state (donation invalidates
         the previous buffers). Returns ``(losses [K], stats [K(, M), ...],
         health_or_None, record_or_None)`` — ``health`` is the device-side
@@ -688,9 +952,20 @@ class Trainer:
         telemetry step record (breakdown fields filled; the events-replay
         time is appended by the caller).
 
+        ``staged`` (a :class:`host_pipeline.StagedUnit`) supplies a group
+        already stacked and device_put by the stager thread — the main
+        thread skips both. ``defer=True`` (pipelined mode) additionally
+        skips the per-call telemetry fence: a ``block_until_ready`` here
+        would serialize exactly the window the pipeline keeps in flight;
+        the drain records ``drain_wait_ms`` instead (``device_ms`` stays
+        None, ``fenced`` False).
+
         Telemetry-off takes the exact pre-obs path: no fingerprinting, no
         fencing, no extra fetches — the dispatch count and donation
         behavior are byte-identical (tests/test_obs.py pins this)."""
+        if staged is not None:
+            stacked = staged.batches     # metadata-only uses below
+            stack_s = staged.stack_s
         if self._fused_step is None:
             self._build_fused_step(stacked)
         tel = self.telemetry
@@ -698,10 +973,13 @@ class Trainer:
         if tel is not None:
             fp = _step_fingerprint(stacked)
             is_new = tel.observe_fingerprint(fp)
-        with self.stats.time("shard_batch"):
-            t_sh = time.perf_counter()
-            batches = self._shard_fused(stacked)
-            shard_s = time.perf_counter() - t_sh
+        if staged is not None:
+            batches, shard_s = staged.batches, staged.shard_s
+        else:
+            with self.stats.time("shard_batch"):
+                t_sh = time.perf_counter()
+                batches = self._shard_fused(stacked)
+                shard_s = time.perf_counter() - t_sh
         ts = self.train_state
         args = (ts.params, ts.state, ts.opt_state, ts.step, batches, rng)
         if is_new:
@@ -720,7 +998,7 @@ class Trainer:
         losses, stats = out[4], out[5]
         health = out[6] if len(out) > 6 else None
         device_s = None
-        if tel is not None and tel.fence:
+        if tel is not None and tel.fence and not defer:
             # The fencing rule: the jit call above returns once XLA has
             # ENQUEUED the program (async dispatch) — a wall timer around
             # it measures dispatch, not compute. True device time is the
@@ -730,6 +1008,7 @@ class Trainer:
             device_s = time.perf_counter() - t_disp - dispatch_s
             self.stats.add("device_wait", device_s)
         k_eff = int(losses.shape[0])
+        self._host_step += k_eff       # host mirror of the device step
         if is_new:
             tel.record_compile(
                 fp, wall_s=dispatch_s + (device_s or 0.0),
@@ -747,6 +1026,17 @@ class Trainer:
                    "dispatch_ms": round(dispatch_s * 1e3, 3),
                    "device_ms": (round(device_s * 1e3, 3)
                                  if device_s is not None else None)}
+            if staged is not None:
+                # background staging wall (stack + device_put, off the
+                # critical path); drain_wait_ms/overlap_frac land at drain.
+                # NOTE the semantic shift: in this record host_stack_ms/
+                # shard_ms were measured on the STAGER thread (their sum
+                # is stage_ms — already-hidden cost), unlike serial
+                # records where they are main-thread critical-path time;
+                # the exposed-cost signal for pipelined runs is
+                # drain_wait_ms.
+                rec["stage_ms"] = round(
+                    (staged.stack_s + staged.shard_s) * 1e3, 3)
         self.train_state = TrainState(params, state, opt_state, step)
         return losses, stats, health, rec
 
@@ -765,15 +1055,10 @@ class Trainer:
         ``saving_period`` crossed mid-call saves once, at the boundary, with
         the true ``next_batch`` position — so resume replay stays aligned
         with the fused grouping)."""
-        M = self.grad_accum
-        tel = self.telemetry
-        done, results = 0, []
-        while done < len(buf):
-            rem = len(buf) - done
-            take = (rem // M) * M or rem        # full KxM part, then the tail
-            m_eff = M if take >= M else take
+        results = []
+        for off, take, m_eff in self._plan_group(len(buf), self.grad_accum):
             t_stack = time.perf_counter()
-            stacked = self._stack_group(buf[done:done + take],
+            stacked = self._stack_group(buf[off:off + take],
                                         take // m_eff, m_eff)
             stack_s = time.perf_counter() - t_stack
             self.stats.add("stack_group", stack_s)
@@ -782,9 +1067,42 @@ class Trainer:
             # record THIS dispatch's post-call step count: a group split
             # into several dispatches (tail not a multiple of M) must not
             # number earlier dispatches' steps off the later ones' state
-            results.append((buf_start + done, m_eff, losses, stats,
-                            int(self.train_state.step), health, rec))
-            done += take
+            results.append((buf_start + off, m_eff, losses, stats,
+                            self._host_step, health, rec))
+        self._finalize_group(pass_id, buf_start, len(buf), results, handler,
+                             costs, log_period, saving_period,
+                             checkpoint_dir, checkpoint_keep, save_fn,
+                             crc_fn=lambda: _batch_fingerprint(buf[-1]))
+
+    def _finalize_group(self, pass_id, buf_start, buf_len, results, handler,
+                        costs, log_period, saving_period, checkpoint_dir,
+                        checkpoint_keep, save_fn, crc_fn,
+                        drain_timing=False, overlap_frac=None):
+        """The bottom half of a group: boundary checkpoint, then the FIFO
+        event replay — shared verbatim by the serial loop (immediately
+        after the dispatches) and the pipelined drain (deferred until the
+        window releases the group). ``crc_fn`` supplies the group's last
+        host-batch fingerprint (serial: computed lazily at save; pipelined:
+        precomputed in the stager). ``drain_timing=True`` times the first
+        blocking loss fetch per dispatch into ``drain_wait_ms`` and stamps
+        ``overlap_frac`` — the pipelined replacements for the per-call
+        device fence the pipeline cannot afford."""
+        tel = self.telemetry
+        if drain_timing:
+            timed = []
+            for i, (start, m_eff, losses, stats, step_after, health,
+                    rec) in enumerate(results):
+                t0 = time.perf_counter()
+                losses = np.asarray(jax.device_get(losses))
+                wait = time.perf_counter() - t0
+                self.stats.add("drain_wait", wait)
+                if rec is not None:
+                    rec["drain_wait_ms"] = round(wait * 1e3, 3)
+                    if overlap_frac is not None:
+                        rec["overlap_frac"] = round(overlap_frac, 4)
+                timed.append((start, m_eff, losses, stats, step_after,
+                              health, rec))
+            results = timed
         # The boundary checkpoint lands BEFORE the replayed events, matching
         # the plain loop's save-then-EndIteration order (handlers that kill
         # training after a period save — the kill/resume pattern — observe
@@ -792,7 +1110,7 @@ class Trainer:
         # in the group SKIPS the save (plain mode raises before reaching its
         # save) — never persist a poisoned train_state that resume would
         # restore.
-        end = buf_start + len(buf)
+        end = buf_start + buf_len
         group_finite = (not self._nan_check) or all(
             np.isfinite(np.asarray(jax.device_get(losses))).all()
             for _, _, losses, _, _, _, _ in results)
@@ -803,7 +1121,7 @@ class Trainer:
                 {**self.train_state.as_dict(),
                  "iter": {"pass": pass_id, "next_batch": end,
                           "completed": 0,
-                          "batch_crc": _batch_fingerprint(buf[-1])}},
+                          "batch_crc": crc_fn()}},
                 keep_last=checkpoint_keep)
         for start, m_eff, losses, stats, step_after, health, rec in results:
             # Health scalars are device-side [K] stacks; fetching them here

@@ -66,6 +66,62 @@ def test_buffered_propagates_errors():
         list(r())
 
 
+def _buffered_fill_threads():
+    import threading
+    return [t for t in threading.enumerate()
+            if t.name == "paddle_tpu.data.buffered.fill"]
+
+
+def test_buffered_abandoned_consumer_stops_fill_thread():
+    """ISSUE 3 satellite: when the consumer abandons the generator early
+    (break / firstn / close), the fill thread must terminate instead of
+    blocking forever on q.put into the full bounded queue."""
+    import time
+
+    def infinite():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    r = data.buffered(infinite, 2)
+    it = r()
+    assert [next(it), next(it)] == [0, 1]     # producer now blocked on put
+    assert _buffered_fill_threads()
+    it.close()                                # generator finally -> stop
+    deadline = time.time() + 5.0
+    while _buffered_fill_threads() and time.time() < deadline:
+        time.sleep(0.02)
+    assert not _buffered_fill_threads(), "fill thread leaked after close()"
+    # the firstn composition (islice abandons the generator on GC)
+    out = list(data.firstn(data.buffered(infinite, 2), 3)())
+    assert out == [0, 1, 2]
+    import gc
+    gc.collect()
+    deadline = time.time() + 5.0
+    while _buffered_fill_threads() and time.time() < deadline:
+        time.sleep(0.02)
+    assert not _buffered_fill_threads()
+
+
+def test_buffered_error_surfaces_before_queue_drains():
+    """Producer exceptions surface PROMPTLY: once the producer has died,
+    the consumer raises on its next pull even though successfully-produced
+    items are still sitting in the queue ahead of the error."""
+    def bad():
+        yield 1
+        raise ValueError("boom")
+
+    r = data.buffered(bad, 8)                 # queue big enough to hold 1
+    it = r()
+    import time
+    deadline = time.time() + 5.0              # let the producer die first
+    while _buffered_fill_threads() and time.time() < deadline:
+        time.sleep(0.02)
+    with pytest.raises(ValueError, match="boom"):
+        next(it)                              # item 1 is buffered — skip it
+
+
 def test_sharded_partition():
     shards = [list(data.sharded(counting_reader(10), 3, i)()) for i in range(3)]
     assert sorted(sum(shards, [])) == list(range(10))

@@ -8,6 +8,8 @@ optimizer step) bit-for-bit in f32: params, per-step losses, and evaluator
 stats, with and without ``param_sharding`` and with weighted batches.
 """
 
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -54,13 +56,15 @@ def _batches(n=8, bs=32, d=16, classes=8, seed=0, weighted=False):
 
 
 def _make_trainer(K, M, batches, mesh=None, param_sharding=None,
-                  evaluator=None, optimizer=None, donate=True):
+                  evaluator=None, optimizer=None, donate=True,
+                  pipeline_depth=1, telemetry=None):
     tr = Trainer(
         model=MLP(),
         loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
         optimizer=optimizer or optim.adam(1e-3),
         mesh=mesh, param_sharding=param_sharding, evaluator=evaluator,
-        donate=donate, steps_per_call=K, grad_accum=M)
+        donate=donate, steps_per_call=K, grad_accum=M,
+        pipeline_depth=pipeline_depth, telemetry=telemetry)
     tr.init(jax.random.PRNGKey(0), batches[0])
     return tr
 
@@ -278,6 +282,200 @@ def test_fused_evaluator_counts_match_plain():
     # different step grouping -> different trajectories, but pass totals
     # count every example exactly once
     assert ev1._total == 8 * 32
+
+
+# ------------------------------------------------- async host pipeline
+
+def _run_events(tr, batches, num_passes=1, **kw):
+    """Like _run but returns the FULL event sequence (order included)."""
+    events = []
+    tr.train(lambda: iter(batches), num_passes=num_passes,
+             event_handler=events.append, log_period=0, **kw)
+    return jax.device_get(tr.train_state.params), events
+
+
+def test_pipelined_fused_bitexact_and_event_order():
+    """pipeline_depth=W (stager thread + bounded in-flight window +
+    deferred FIFO drain) reproduces the serial fused run bit for bit:
+    params in f32, per-step costs, evaluator metrics, and the FULL event
+    sequence in the exact serial order — including a ragged pass tail
+    (13 batches at K=2, M=2) over two passes."""
+    batches = _batches(13)
+    p1, e1 = _run_events(_make_trainer(2, 2, batches,
+                                       evaluator=ClassificationError()),
+                         batches, num_passes=2)
+    p3, e3 = _run_events(_make_trainer(2, 2, batches,
+                                       evaluator=ClassificationError(),
+                                       pipeline_depth=3),
+                         batches, num_passes=2)
+    assert e1 == e3                 # events are dataclasses: order + fields
+    _assert_trees_equal(p1, p3)
+
+
+def test_pipelined_mid_pass_checkpoint_resume(tmp_path):
+    """The kill/resume contract under pipelining: a checkpoint boundary
+    forces a full drain (the save observes a quiesced train_state), so a
+    mid-pass kill after the boundary save resumes to the SAME final params
+    as the uninterrupted SERIAL run."""
+    batches = _batches(16)
+    tr_a = _make_trainer(2, 2, batches)            # serial reference
+    p_want, _, _ = _run(tr_a, batches, num_passes=2)
+    want_step = int(tr_a.train_state.step)
+
+    class Killed(Exception):
+        pass
+
+    def killer(e):
+        if isinstance(e, ev.EndIteration) and e.pass_id == 1 \
+                and e.batch_id == 7:
+            raise Killed()
+
+    tr_b = _make_trainer(2, 2, batches, pipeline_depth=2)
+    with pytest.raises(Killed):
+        tr_b.train(lambda: iter(batches), num_passes=2,
+                   checkpoint_dir=str(tmp_path), saving_period=8,
+                   log_period=0, event_handler=killer)
+
+    tr_c = _make_trainer(2, 2, batches, pipeline_depth=2)
+    tr_c.train(lambda: iter(batches), num_passes=2,
+               checkpoint_dir=str(tmp_path), saving_period=8,
+               log_period=0, resume=True)
+    assert int(tr_c.train_state.step) == want_step
+    _assert_trees_equal(p_want, jax.device_get(tr_c.train_state.params))
+
+
+def test_pipelined_saving_period_event_order_matches_serial(tmp_path):
+    """With mid-pass saving_period checkpoints, the pipelined event
+    sequence (drains forced at boundaries) still equals the serial one,
+    and both runs end bit-identical."""
+    batches = _batches(12)
+    p1, e1 = _run_events(_make_trainer(2, 2, batches), batches,
+                         checkpoint_dir=str(tmp_path / "serial"),
+                         saving_period=4)
+    p2, e2 = _run_events(_make_trainer(2, 2, batches, pipeline_depth=4),
+                         batches, checkpoint_dir=str(tmp_path / "piped"),
+                         saving_period=4)
+    assert e1 == e2
+    _assert_trees_equal(p1, p2)
+
+
+def test_plain_loop_deferred_fetch_matches_serial(tmp_path):
+    """K=1, M=1 with pipeline_depth=2 (the deferred-fetch window; nan_check
+    off) reproduces the serial plain loop bit for bit: params, costs,
+    evaluator metrics, events, and the mid-pass checkpoint stream."""
+    batches = _batches(9)
+    p1, e1 = _run_events(_make_trainer(1, 1, batches,
+                                       evaluator=ClassificationError()),
+                         batches, num_passes=2,
+                         checkpoint_dir=str(tmp_path / "serial"),
+                         saving_period=4)
+    p2, e2 = _run_events(_make_trainer(1, 1, batches,
+                                       evaluator=ClassificationError(),
+                                       pipeline_depth=2),
+                         batches, num_passes=2,
+                         checkpoint_dir=str(tmp_path / "piped"),
+                         saving_period=4)
+    assert e1 == e2
+    _assert_trees_equal(p1, p2)
+
+
+def test_plain_nan_check_stays_serial_and_raises():
+    """nan_check needs the loss on host before the next dispatch, so the
+    plain loop ignores pipeline_depth with it on — and still raises at the
+    poisoned batch."""
+    batches = _batches(4)
+    batches[2]["x"][:] = np.nan
+    tr = Trainer(
+        model=MLP(),
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
+        optimizer=optim.adam(1e-3), nan_check=True, pipeline_depth=4)
+    tr.init(jax.random.PRNGKey(0), batches[0])
+    with pytest.raises(FloatingPointError, match="batch 2"):
+        tr.train(lambda: iter(batches), num_passes=1, log_period=0)
+
+
+def test_pipelined_nan_check_skips_poisoned_save(tmp_path):
+    """nan_check + pipelining: a non-finite loss anywhere in a group still
+    SKIPS the boundary save (never persist a poisoned train_state) and the
+    replay raises."""
+    from paddle_tpu.train import checkpoint as ckpt_lib
+    batches = _batches(8)
+    batches[5]["x"][:] = np.nan          # poisons the second group
+    tr = Trainer(
+        model=MLP(),
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(out, b["label"]),
+        optimizer=optim.adam(1e-3), steps_per_call=2, grad_accum=2,
+        nan_check=True, pipeline_depth=2)
+    tr.init(jax.random.PRNGKey(0), batches[0])
+    with pytest.raises(FloatingPointError):
+        tr.train(lambda: iter(batches), num_passes=1, log_period=0,
+                 checkpoint_dir=str(tmp_path), saving_period=8)
+    # the batch-8 boundary save covered the poisoned group: skipped
+    assert ckpt_lib.latest_pass(str(tmp_path)) is None
+
+
+def test_pipelined_stager_thread_always_closed():
+    """The stager thread dies with the pass — on clean completion AND when
+    a handler raises mid-pass (the try/finally close path)."""
+    import threading
+
+    def stager_threads():
+        return [t for t in threading.enumerate()
+                if t.name == "paddle_tpu.host_pipeline.stager"]
+
+    batches = _batches(8)
+    tr = _make_trainer(2, 2, batches, pipeline_depth=2)
+    tr.train(lambda: iter(batches), num_passes=1, log_period=0)
+    assert not stager_threads()
+
+    class Boom(Exception):
+        pass
+
+    tr2 = _make_trainer(2, 2, batches, pipeline_depth=2)
+
+    def bomb(e):
+        if isinstance(e, ev.EndIteration):
+            raise Boom()
+
+    with pytest.raises(Boom):
+        tr2.train(lambda: iter(batches), num_passes=1, log_period=0,
+                  event_handler=bomb)
+    deadline = time.time() + 5.0
+    while stager_threads() and time.time() < deadline:
+        time.sleep(0.02)
+    assert not stager_threads()
+
+
+def test_pipelined_telemetry_overlap_accounting():
+    """Pipelined fused runs record the overlap keys (stage_ms /
+    drain_wait_ms / overlap_frac all non-None, device_ms None, fenced
+    False — no per-call fence), serial runs carry them as None, and
+    telemetry does not perturb the pipelined math."""
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    batches = _batches(8)
+    p_serial, l_serial, _ = _run(_make_trainer(2, 2, batches), batches)
+
+    mem = InMemorySink()
+    tr = _make_trainer(2, 2, batches, pipeline_depth=2,
+                       telemetry=Telemetry(sinks=[mem]))
+    p_piped, l_piped, _ = _run(tr, batches)
+    assert l_piped == l_serial
+    _assert_trees_equal(p_serial, p_piped)
+    steps = mem.by_kind("step")
+    assert len(steps) == 2                      # 8 batches / (K=2 * M=2)
+    for r in steps:
+        assert r["stage_ms"] is not None and r["stage_ms"] >= 0
+        assert r["drain_wait_ms"] is not None and r["drain_wait_ms"] >= 0
+        assert r["overlap_frac"] is not None and 0 <= r["overlap_frac"] <= 1
+        assert r["device_ms"] is None and r["fenced"] is False
+        assert r["grad_norm"] is not None       # health still rides along
+
+    mem2 = InMemorySink()
+    tr2 = _make_trainer(2, 2, batches, telemetry=Telemetry(sinks=[mem2]))
+    _run(tr2, batches)
+    for r in mem2.by_kind("step"):              # serial: keys fixed, None
+        assert r["stage_ms"] is None and r["drain_wait_ms"] is None
+        assert r["overlap_frac"] is None
 
 
 # ---------------------------------------------------------------- remat

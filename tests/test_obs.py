@@ -132,6 +132,22 @@ def test_trainer_retrace_tracking_ragged_tail():
         assert r["wall_s"] > 0
 
 
+def test_retrace_warning_one_shot(caplog):
+    """ISSUE 3 satellite: crossing the distinct-fingerprint threshold logs
+    ONE warning pointing at drop_last/padding — and only once."""
+    tel = Telemetry(sinks=[InMemorySink()], retrace_warn_threshold=2)
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.telemetry"):
+        tel.observe_fingerprint(("a",))       # initial compile
+        tel.observe_fingerprint(("b",))       # retrace 1: below threshold
+        assert "drop_last" not in caplog.text
+        tel.observe_fingerprint(("c",))       # retrace 2: fires
+        tel.observe_fingerprint(("d",))       # retrace 3: already warned
+    warnings = [r for r in caplog.records
+                if "drop_last" in r.getMessage()]
+    assert len(warnings) == 1
+    assert "recompile" in warnings[0].getMessage()
+
+
 def test_mfu_and_tokens_per_sec_accounting():
     """With an explicit peak-FLOPs denominator (the CPU table has none)
     emit_step derives est_mfu_pct from the analytic flops_per_step."""
