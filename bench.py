@@ -730,14 +730,15 @@ def run_smoke(K=4, M=2, timing_passes=3):
                 "y": rng.randint(0, V, (bs, T)).astype(np.int32)}
                for _ in range(n_batches)]
 
-    def make(k_steps, telemetry=None, pipeline_depth=1):
+    def make(k_steps, telemetry=None, pipeline_depth=1, tracer=None):
         tr = Trainer(
             model=TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
                                 ffn_hidden=64, max_len=T, remat="dots"),
             loss_fn=lambda out, b: costs.softmax_cross_entropy(
                 out.reshape(-1, V), b["y"].reshape(-1)),
             optimizer=optim.adam(1e-3), steps_per_call=k_steps,
-            grad_accum=M, pipeline_depth=pipeline_depth, telemetry=telemetry)
+            grad_accum=M, pipeline_depth=pipeline_depth, telemetry=telemetry,
+            tracer=tracer)
         tr.init(jax.random.PRNGKey(0), batches[0])
         return tr
 
@@ -836,6 +837,67 @@ def run_smoke(K=4, M=2, timing_passes=3):
             + (telemetry.get("mean_shard_ms") or 0.0), 4),
     }
 
+    # -- structured-trace gate (ISSUE 4): a traced pipelined run must
+    # serialize to valid Chrome Trace Event JSON carrying spans from BOTH
+    # the main thread and the stager thread, with every flow event paired
+    # (each staging "s" finds its drain "f"), sane monotonic timestamps,
+    # and at least one stager-thread staging span TIME-INTERSECTING an
+    # individual main-thread span — the two threads provably active at
+    # once, the host/device overlap the trace exists to make auditable
+    # (a union-window check would pass even for fully serialized staging).
+    # Tracing must not perturb the math either (same loss stream as the
+    # serial fused run).
+    from paddle_tpu.obs import Tracer
+    tr_traced = make(K, telemetry=Telemetry(sinks=[InMemorySink()]),
+                     pipeline_depth=2, tracer=Tracer())
+    l_traced = run(tr_traced)
+    # gate on a FRESH tracer over a second, post-compile pass: in pass 1
+    # the tiny stream stages every group before the compile-dominated
+    # first dispatch even starts, so the steady-state interleaving the
+    # concurrency gate checks only exists from pass 2 on
+    tracer = Tracer()
+    tr_traced.tracer = tracer
+    run(tr_traced)
+    trace_path = os.path.join(os.path.dirname(jsonl_path), "trace.json")
+    tracer.save(trace_path)
+    trace_ok, trace = False, {"path": trace_path,
+                              "losses_equal_with_tracer": l_traced == l_fused}
+    try:
+        with open(trace_path) as f:
+            tdata = json.load(f)
+        evs = tdata["traceEvents"]
+        xs = [e for e in evs if e.get("ph") == "X"]
+        s_ids = {e["id"] for e in evs if e.get("ph") == "s"}
+        f_ids = {e["id"] for e in evs if e.get("ph") == "f"}
+        ts_list = [e.get("ts", -1.0) for e in evs]
+        # ts_monotonic alone only validates the serializer's sort; the
+        # clock invariant is every span ts >= 0 (relative to tracer
+        # construction) with a positive duration
+        ts_valid = all(e["ts"] >= 0 and e["dur"] > 0 for e in xs)
+        disp = [e for e in xs if e["name"] == "dispatch"]
+        stage = [e for e in xs if e["name"] == "stage"]
+        stage_tids = {e["tid"] for e in stage}
+        cross_thread = bool(stage and disp and
+                            not (stage_tids & {e["tid"] for e in disp}))
+        main = [e for e in xs if e["tid"] not in stage_tids]
+        stage_concurrent_with_main = any(
+            s["ts"] < m["ts"] + m["dur"] and s["ts"] + s["dur"] > m["ts"]
+            for s in stage for m in main)
+        trace_ok = (len({e["tid"] for e in xs}) >= 2 and cross_thread
+                    and bool(s_ids) and s_ids == f_ids
+                    and ts_list == sorted(ts_list) and ts_valid
+                    and stage_concurrent_with_main)
+        trace.update({
+            "trace_ok": trace_ok, "spans": len(xs),
+            "threads": len({e["tid"] for e in xs}),
+            "flows": len(s_ids), "flows_paired": s_ids == f_ids,
+            "ts_monotonic": ts_list == sorted(ts_list),
+            "ts_valid": ts_valid,
+            "stage_concurrent_with_main": stage_concurrent_with_main,
+        })
+    except Exception as e:                       # malformed file IS the bug
+        trace.update({"trace_ok": False, "error": f"{type(e).__name__}: {e}"})
+
     out = {
         "metric": "fused_vs_plain_smoke",
         "equal": bool(eq_params and eq_losses),
@@ -848,11 +910,13 @@ def run_smoke(K=4, M=2, timing_passes=3):
         "device": jax.devices()[0].device_kind,
         "telemetry": telemetry,
         "pipeline": pipeline,
+        "trace": trace,
     }
     print(json.dumps(out))
     ok = (out["equal"] and jsonl_ok
           and telemetry["losses_equal_with_telemetry"]
-          and pipeline["losses_equal"] and pipeline["overlap_keys_ok"])
+          and pipeline["losses_equal"] and pipeline["overlap_keys_ok"]
+          and trace_ok and trace["losses_equal_with_tracer"])
     return 0 if ok else 1
 
 

@@ -11,6 +11,7 @@ a background thread (the analog of the reference's ``DoubleBuffer`` async layer,
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import multiprocessing as _mp
 import queue
@@ -52,7 +53,18 @@ def shuffle(reader_fn: Reader, buf_size: int, seed: Optional[int] = None) -> Rea
     return reader
 
 
-def buffered(reader_fn: Reader, size: int) -> Reader:
+_NULL_CTX = contextlib.nullcontext()
+
+
+def _fill_span(tracer, name: str):
+    """Null-safe tracer span (duck-typed against ``obs.trace.Tracer`` so
+    this module never imports jax-adjacent packages)."""
+    if tracer is None:
+        return _NULL_CTX
+    return tracer.span(name)
+
+
+def buffered(reader_fn: Reader, size: int, tracer=None) -> Reader:
     """Decouple producer/consumer with a bounded queue on a thread
     (reference: buffered decorator).
 
@@ -62,7 +74,13 @@ def buffered(reader_fn: Reader, size: int) -> Reader:
     full queue — the generator's ``finally`` sets a stop event every
     producer-side ``put`` polls. Producer exceptions surface PROMPTLY:
     the consumer re-raises as soon as the error is recorded, without
-    first draining the items already buffered ahead of it."""
+    first draining the items already buffered ahead of it.
+
+    ``tracer``: optional :class:`paddle_tpu.obs.Tracer` — records one
+    ``data.fill`` span on the fill thread per item produced, so the
+    reader's own cost (parse/augment/collate upstream of this queue)
+    shows up on its thread in the hot-loop timeline next to the stager
+    and main-loop spans (ISSUE 4)."""
     def reader():
         q: queue.Queue = queue.Queue(maxsize=size)
         end = object()
@@ -80,7 +98,12 @@ def buffered(reader_fn: Reader, size: int) -> Reader:
 
         def fill():
             try:
-                for item in reader_fn():
+                it = iter(reader_fn())
+                while True:
+                    with _fill_span(tracer, "data.fill"):
+                        item = next(it, end)
+                    if item is end:
+                        return
                     if not _put(item):
                         return
             except BaseException as e:  # propagate into consumer
@@ -190,10 +213,11 @@ def batched(reader_fn: Reader, batch_size: int, drop_last: bool = True,
     return reader
 
 
-def prefetch(reader_fn: Reader, depth: int = 2) -> Reader:
+def prefetch(reader_fn: Reader, depth: int = 2, tracer=None) -> Reader:
     """Async host-side prefetch (DoubleBuffer analog) — overlap input pipeline
-    with device compute."""
-    return buffered(reader_fn, depth)
+    with device compute. ``tracer`` forwards to :func:`buffered`'s
+    fill-thread spans."""
+    return buffered(reader_fn, depth, tracer=tracer)
 
 
 def _xmap_worker(func, in_q, out_q):

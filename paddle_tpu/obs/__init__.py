@@ -1,10 +1,12 @@
 """Observability subsystem — the ``Stat.h``/``REGISTER_TIMER`` successor
-for the fused hot loop (ISSUE 2).
+for the fused hot loop (ISSUE 2) plus structured tracing and the
+anomaly-triggered flight recorder (ISSUE 4).
 
-Three layers:
+Five layers:
 
 - :mod:`~paddle_tpu.obs.sinks` — pluggable record consumers (in-memory,
-  JSONL file, logging).
+  JSONL file, logging); ``emit`` is thread-safe (stager/fill threads
+  write too).
 - :mod:`~paddle_tpu.obs.health` — device-side training-health scalars
   (grad/param/update norms, update ratio, NaN/Inf sentinel) traced into
   the compiled step.
@@ -13,21 +15,35 @@ Three layers:
   dispatch / fenced device / events-replay), retrace+compile tracking
   keyed by step fingerprint with HLO cost-analysis FLOPs, MFU and
   tokens/sec accounting, and device-memory peak sampling.
+- :mod:`~paddle_tpu.obs.trace` — :class:`Tracer`: thread-aware spans
+  emitted as Chrome Trace Event Format JSON (Perfetto-viewable), with
+  flow events linking a group's stager-thread staging to its main-thread
+  dispatch and drain, and programmatic ``jax.profiler`` capture windows.
+- :mod:`~paddle_tpu.obs.anomaly` — :class:`AnomalyDetector`: rolling
+  robust statistics over the telemetry stream (slow-step outliers,
+  retrace bursts, drain stalls, memory high-water, the NaN sentinel);
+  on trigger, a one-shot forensics bundle (telemetry ring + trace tail +
+  config/env/mesh snapshot + verdict) lands on disk.
 
-Attach with ``Trainer(..., telemetry=Telemetry(sinks=[JsonlSink(path)]))``.
-With no Telemetry attached the hot loop is unchanged: same traced step,
-same dispatch count, same donation, zero extra device fetches.
+Attach with ``Trainer(..., telemetry=Telemetry(sinks=[JsonlSink(path)]),
+tracer=Tracer(), anomaly=AnomalyDetector(out_dir))``. With none attached
+the hot loop is unchanged: same traced step, same dispatch count, same
+donation, zero extra device fetches.
 """
 
+from .anomaly import ANOMALY_KINDS, AnomalyDetector, Verdict
 from .health import (HEALTH_KEYS, health_scalars, tree_l2_norm,
                      tree_nonfinite_count)
 from .sinks import InMemorySink, JsonlSink, LoggingSink, Sink
 from .telemetry import (PEAK_FLOPS, Telemetry, device_memory_stats,
                         device_peak_flops, lowered_hlo_flops)
+from .trace import Tracer, jax_profile, tspan
 
 __all__ = [
     "Telemetry", "Sink", "InMemorySink", "JsonlSink", "LoggingSink",
     "HEALTH_KEYS", "health_scalars", "tree_l2_norm", "tree_nonfinite_count",
     "PEAK_FLOPS", "device_peak_flops", "lowered_hlo_flops",
     "device_memory_stats",
+    "Tracer", "tspan", "jax_profile",
+    "AnomalyDetector", "Verdict", "ANOMALY_KINDS",
 ]

@@ -1,0 +1,385 @@
+"""Anomaly detection + flight recorder for the training hot loop
+(ISSUE 4 tentpole, part 2).
+
+The telemetry stream (PR 2) and the trace ring (:mod:`.trace`) answer
+questions a human asks *while watching*. Production runs misbehave at
+step 40k with nobody watching — by the time someone looks, the JSONL has
+scrolled past the interesting window and the trace ring has been
+overwritten. This module watches the stream mechanically and, the moment
+a run goes sideways, freezes the evidence to disk.
+
+**Detector** (:meth:`AnomalyDetector.observe`, fed every telemetry step
+record by the Trainer): rolling *robust* statistics — median + MAD, not
+mean + stddev, because the contaminated samples the detector exists to
+catch would drag a mean-based threshold toward themselves — over the
+recent window, checking five conditions:
+
+- ``nonfinite``   — the NaN/Inf health sentinel tripped (or the loss was
+  NaN-sanitized to None): the run is poisoned *now*; later records only
+  get worse.
+- ``retrace_burst`` — ``retrace_count`` climbed ≥ N inside the window:
+  something is feeding a stream of fresh shapes and every step pays a
+  recompile.
+- ``drain_stall``  — a pipelined drain blocked longer than the absolute
+  threshold AND 3x the rolling drain median: in the healthy device-bound
+  steady state every drain legitimately blocks for ~one group's device
+  time, so only a wait that is also an outlier vs the run's own drain
+  baseline counts as a stall (the window emptied and the host sat on a
+  dead pipeline).
+- ``memory_high_water`` — ``bytes_in_use`` crossed a fraction of the
+  device's ``bytes_limit``: the step after this one may be the OOM.
+- ``slow_step``    — the call's host wall is a ≥ ``slow_step_zscore``
+  robust-z outlier vs the window median (warmup-gated): preemption,
+  host interference, a competing process.
+
+**Flight recorder** (on trigger): one forensics bundle directory —
+``verdict.json`` (what fired, on which record), ``telemetry_ring.jsonl``
+(the last ``ring_size`` step records), ``trace_tail.json`` (the recent
+span window as a Chrome trace, when a :class:`~.trace.Tracer` is bound),
+and ``snapshot.json`` (trainer config, mesh, devices, jax version,
+JAX_*/XLA_* env) — everything a postmortem needs, written once, cheap
+enough to leave armed in production. Optionally (``arm_profiler=True``)
+the next fused call is wrapped in a ``jax.profiler.trace`` capture into
+the bundle, so the device-side view of the anomaly's neighborhood is
+kept too.
+
+Each detector kind fires **once** per run by default (``rearm=False``):
+the first bundle holds the onset — the interesting record — and a
+misbehaving run must not bury the disk in bundles. Detection is
+observation, not control: training continues (the hard stops remain
+``Trainer(nan_check=True)``), and a detector crash is logged, never
+raised into the hot loop.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["AnomalyDetector", "Verdict", "ANOMALY_KINDS"]
+
+_log = logging.getLogger("paddle_tpu.anomaly")
+
+ANOMALY_KINDS = ("nonfinite", "retrace_burst", "drain_stall",
+                 "memory_high_water", "slow_step")
+
+# Step-record keys whose sum approximates the call's host-observable wall.
+# Stager-staged records (stage_ms present — the fused pipeline): dispatch +
+# drain wait ONLY, because there host_stack_ms/shard_ms were measured on
+# the STAGER thread (already-hidden cost, trainer.py's semantic-shift
+# note) and counting them would flag hidden staging spikes as slow steps.
+# Everything else — serial records AND the plain deferred-fetch loop,
+# whose shard_ms is genuine main-thread critical path — counts the full
+# host-side breakdown (absent keys are simply skipped: device_ms is None
+# unfenced, drain_wait_ms is None serial).
+_WALL_KEYS_STAGED = ("dispatch_ms", "drain_wait_ms")
+_WALL_KEYS_MAIN = ("host_stack_ms", "shard_ms", "dispatch_ms",
+                   "device_ms", "drain_wait_ms")
+
+
+@dataclasses.dataclass
+class Verdict:
+    """One detector trigger: what fired, the observed value vs threshold,
+    and (after the dump) where the forensics bundle landed."""
+    kind: str
+    step: Optional[int]
+    value: Optional[float]
+    threshold: Optional[float]
+    detail: str
+    bundle: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _robust_z(x: float, history) -> tuple:
+    """Robust z-score of ``x`` against ``history``: (x - median) / (1.4826
+    * MAD), with a 5%-of-median floor on the scale so a constant history
+    (MAD = 0) cannot make every later sample an infinite outlier."""
+    arr = np.asarray(history, np.float64)
+    med = float(np.median(arr))
+    mad = float(np.median(np.abs(arr - med)))
+    scale = 1.4826 * mad
+    floor = max(abs(med), 1e-6) * 0.05
+    scale = max(scale, floor)
+    return (x - med) / scale, med
+
+
+class AnomalyDetector:
+    """Rolling anomaly detector + one-shot flight recorder.
+
+    Args:
+      out_dir: where forensics bundles land (``anomaly_NNN_<kind>/``
+        subdirectories; created on first trigger, so arming the detector
+        never touches the filesystem).
+      window: rolling-statistics window (records).
+      warmup: minimum history before ``slow_step`` may fire (the first
+        call carries compile time; early medians are noise).
+      slow_step_zscore: robust-z threshold for ``slow_step`` (the value
+        must also exceed 1.5x the window median — belt and braces against
+        a tiny scale floor).
+      retrace_burst: ``retrace_count`` increase within the window that
+        flags a burst.
+      drain_stall_ms: ``drain_wait_ms`` floor for a stall; the wait must
+        ALSO exceed 3x the rolling drain median (≥3 prior drains), so a
+        device-bound run whose every drain is a legitimate
+        group-compute-time wait never trips it.
+      memory_frac: fraction of the device ``bytes_limit`` that counts as
+        high water.
+      memory_bytes_limit: override the device-reported limit (None =
+        sample ``device_memory_stats()['bytes_limit']`` lazily; backends
+        reporting none — CPU — disable the memory check).
+      ring_size: telemetry records kept for the bundle.
+      trace_tail: trace events snapshotted into the bundle.
+      arm_profiler: on trigger, arm a ``jax.profiler.trace`` capture for
+        the next fused call (written under the bundle).
+      rearm: allow a kind to fire more than once (default: first onset
+        only).
+    """
+
+    def __init__(self, out_dir: str, window: int = 64, warmup: int = 8,
+                 slow_step_zscore: float = 8.0, retrace_burst: int = 3,
+                 drain_stall_ms: float = 5000.0,
+                 memory_frac: float = 0.92,
+                 memory_bytes_limit: Optional[int] = None,
+                 ring_size: int = 256, trace_tail: int = 500,
+                 arm_profiler: bool = False, rearm: bool = False):
+        self.out_dir = out_dir
+        self.window = int(window)
+        self.warmup = int(warmup)
+        self.slow_step_zscore = float(slow_step_zscore)
+        self.retrace_burst = int(retrace_burst)
+        self.drain_stall_ms = float(drain_stall_ms)
+        self.memory_frac = float(memory_frac)
+        self._mem_limit = memory_bytes_limit
+        self.trace_tail = int(trace_tail)
+        self.arm_profiler = bool(arm_profiler)
+        self.rearm = bool(rearm)
+        self._ring: collections.deque = collections.deque(
+            maxlen=int(ring_size))
+        self._walls: collections.deque = collections.deque(maxlen=window)
+        self._drains: collections.deque = collections.deque(maxlen=window)
+        self._retraces: collections.deque = collections.deque(maxlen=window)
+        self._fired: set = set()
+        self._tracer = None
+        self._context_fn: Optional[Callable[[], Dict[str, Any]]] = None
+        self._profiler_request: Optional[str] = None
+        self.bundles: List[str] = []
+        self.verdicts: List[Verdict] = []
+
+    # -- wiring --------------------------------------------------------------
+
+    def bind(self, tracer=None,
+             context_fn: Optional[Callable[[], Dict[str, Any]]] = None
+             ) -> None:
+        """Attach the trace ring and the config/env snapshot source (the
+        Trainer calls this at ``train()`` start)."""
+        if tracer is not None:
+            self._tracer = tracer
+        if context_fn is not None:
+            self._context_fn = context_fn
+
+    def take_profiler_request(self) -> Optional[str]:
+        """Pop the armed profiler-capture directory (None when unarmed).
+        The Trainer polls this before each fused dispatch."""
+        req, self._profiler_request = self._profiler_request, None
+        return req
+
+    def reset(self) -> None:
+        """Re-arm every kind and clear the rolling state (bundles stay)."""
+        self._fired.clear()
+        self._ring.clear()
+        self._walls.clear()
+        self._drains.clear()
+        self._retraces.clear()
+        self._profiler_request = None
+
+    # -- detection -----------------------------------------------------------
+
+    def observe(self, rec: Dict[str, Any]) -> List[Verdict]:
+        """Feed one telemetry step record; returns the verdicts triggered
+        by it (usually empty). Never raises on malformed records — the
+        detector must not be the thing that kills the run it watches."""
+        if rec.get("kind", "step") != "step":
+            return []
+        self._ring.append(dict(rec))
+        verdicts = []
+        for check in (self._check_nonfinite, self._check_retrace_burst,
+                      self._check_drain_stall, self._check_memory,
+                      self._check_slow_step):
+            try:
+                v = check(rec)
+            except Exception:
+                _log.exception("anomaly check %s failed on record",
+                               check.__name__)
+                continue
+            if v is not None and (self.rearm or v.kind not in self._fired):
+                self._fired.add(v.kind)
+                self._trigger(v, rec)
+                verdicts.append(v)
+        self._update_rolling(rec)
+        return verdicts
+
+    def _update_rolling(self, rec: Dict[str, Any]) -> None:
+        wall = self._wall_ms(rec)
+        if wall is not None:
+            self._walls.append(wall)
+        dw = rec.get("drain_wait_ms")
+        if dw is not None and not rec.get("profiled"):
+            self._drains.append(float(dw))
+        rc = rec.get("retrace_count")
+        if rc is not None:
+            self._retraces.append(int(rc))
+
+    @staticmethod
+    def _wall_ms(rec: Dict[str, Any]) -> Optional[float]:
+        if rec.get("profiled"):
+            # anomaly-armed jax.profiler capture: its dispatch window
+            # deliberately contains a compute fence — the flight recorder
+            # must not trigger the detector that armed it
+            return None
+        keys = (_WALL_KEYS_STAGED if rec.get("stage_ms") is not None
+                else _WALL_KEYS_MAIN)
+        vals = [rec.get(k) for k in keys]
+        vals = [v for v in vals if v is not None]
+        return float(sum(vals)) if vals else None
+
+    def _check_nonfinite(self, rec) -> Optional[Verdict]:
+        bad = rec.get("nonfinite_count") or 0
+        loss_nan = "loss" in rec and rec["loss"] is None
+        if bad > 0 or loss_nan:
+            return Verdict(
+                kind="nonfinite", step=rec.get("step"),
+                value=float(bad), threshold=0.0,
+                detail=(f"NaN/Inf sentinel tripped: nonfinite_count={bad}"
+                        + (", loss was NaN-sanitized" if loss_nan else "")))
+        return None
+
+    def _check_retrace_burst(self, rec) -> Optional[Verdict]:
+        rc = rec.get("retrace_count")
+        if rc is None or not self._retraces:
+            return None
+        rise = int(rc) - self._retraces[0]
+        if rise >= self.retrace_burst:
+            return Verdict(
+                kind="retrace_burst", step=rec.get("step"),
+                value=float(rise), threshold=float(self.retrace_burst),
+                detail=(f"retrace_count rose by {rise} within the last "
+                        f"{len(self._retraces)} records — a stream of "
+                        f"fresh shapes is recompiling every step "
+                        f"(ragged batches? dynamic lengths?)"))
+        return None
+
+    def _check_drain_stall(self, rec) -> Optional[Verdict]:
+        dw = rec.get("drain_wait_ms")
+        if dw is None or dw <= self.drain_stall_ms:
+            return None
+        # In the device-bound steady state EVERY drain legitimately blocks
+        # for ~one group's device time, so an absolute wall alone would
+        # flag healthy big-group runs. Require a baseline (>=3 prior
+        # drains) and a 3x-median excess on top of the absolute floor.
+        if len(self._drains) < 3:
+            return None
+        med = float(np.median(self._drains))
+        if dw <= 3.0 * max(med, 1e-9):
+            return None
+        return Verdict(
+            kind="drain_stall", step=rec.get("step"), value=float(dw),
+            threshold=round(max(self.drain_stall_ms, 3.0 * med), 3),
+            detail=(f"pipelined drain blocked {dw:.0f} ms fetching the "
+                    f"call's losses ({dw / max(med, 1e-9):.1f}x the run's "
+                    f"median drain of {med:.0f} ms) — the in-flight window "
+                    f"ran dry (device stall? preempted neighbor?)"))
+
+    def _check_memory(self, rec) -> Optional[Verdict]:
+        cur = rec.get("bytes_in_use")
+        if cur is None:
+            return None
+        if self._mem_limit is None:
+            from .telemetry import device_memory_stats
+            self._mem_limit = device_memory_stats().get("bytes_limit", 0)
+        if not self._mem_limit:
+            return None
+        frac = cur / self._mem_limit
+        if frac >= self.memory_frac:
+            return Verdict(
+                kind="memory_high_water", step=rec.get("step"),
+                value=round(frac, 4), threshold=self.memory_frac,
+                detail=(f"device memory at {100 * frac:.1f}% of "
+                        f"bytes_limit ({cur} / {self._mem_limit}) — the "
+                        f"next allocation spike may OOM"))
+        return None
+
+    def _check_slow_step(self, rec) -> Optional[Verdict]:
+        wall = self._wall_ms(rec)
+        if wall is None or len(self._walls) < self.warmup:
+            return None
+        z, med = _robust_z(wall, self._walls)
+        if z >= self.slow_step_zscore and wall > 1.5 * med:
+            return Verdict(
+                kind="slow_step", step=rec.get("step"), value=round(wall, 3),
+                threshold=round(self.slow_step_zscore, 2),
+                detail=(f"call wall {wall:.1f} ms is a {z:.1f}-sigma robust "
+                        f"outlier vs the window median {med:.1f} ms "
+                        f"(preemption / host interference / IO stall?)"))
+        return None
+
+    # -- flight recorder -----------------------------------------------------
+
+    def _trigger(self, verdict: Verdict, rec: Dict[str, Any]) -> None:
+        try:
+            self._dump_bundle(verdict, rec)
+        except Exception:
+            _log.exception("forensics-bundle dump failed for %s",
+                           verdict.kind)
+        self.verdicts.append(verdict)
+        if self._tracer is not None:
+            try:
+                self._tracer.instant(f"ANOMALY:{verdict.kind}",
+                                     step=verdict.step, value=verdict.value)
+            except Exception:
+                pass
+        if self.arm_profiler and verdict.bundle:
+            self._profiler_request = os.path.join(verdict.bundle,
+                                                  "jax_profile")
+        _log.warning("ANOMALY %s at step %s: %s%s", verdict.kind,
+                     verdict.step, verdict.detail,
+                     f" — forensics bundle: {verdict.bundle}"
+                     if verdict.bundle else "")
+
+    def _dump_bundle(self, verdict: Verdict, rec: Dict[str, Any]) -> str:
+        seq = len(self.bundles)
+        bundle = os.path.join(self.out_dir,
+                              f"anomaly_{seq:03d}_{verdict.kind}")
+        os.makedirs(bundle, exist_ok=True)
+        verdict.bundle = bundle
+        with open(os.path.join(bundle, "verdict.json"), "w") as f:
+            json.dump({"ts": time.time(), "verdict": verdict.to_dict(),
+                       "trigger_record": rec}, f, indent=2, default=str)
+        with open(os.path.join(bundle, "telemetry_ring.jsonl"), "w") as f:
+            for r in self._ring:
+                f.write(json.dumps(r, default=str) + "\n")
+        snapshot: Dict[str, Any] = {}
+        if self._context_fn is not None:
+            try:
+                snapshot = self._context_fn()
+            except Exception as e:
+                snapshot = {"error": f"{type(e).__name__}: {e}"}
+        with open(os.path.join(bundle, "snapshot.json"), "w") as f:
+            json.dump(snapshot, f, indent=2, default=str)
+        if self._tracer is not None:
+            try:
+                with open(os.path.join(bundle, "trace_tail.json"), "w") as f:
+                    json.dump(self._tracer.chrome_trace(
+                        self._tracer.tail(self.trace_tail)), f)
+            except Exception:
+                _log.exception("trace-tail dump failed")
+        self.bundles.append(bundle)
+        return bundle

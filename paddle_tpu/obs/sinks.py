@@ -20,7 +20,16 @@ __all__ = ["Sink", "InMemorySink", "JsonlSink", "LoggingSink"]
 
 class Sink:
     """Base sink: ``emit(record)`` consumes one record; ``close()`` releases
-    resources. Sinks must tolerate being closed twice."""
+    resources. Sinks must tolerate being closed twice.
+
+    THREAD-SAFETY CONTRACT (ISSUE 4): ``emit`` may be called from more
+    than one thread. The obs layer is no longer single-threaded by
+    construction — the hot loop runs main + stager + fill threads, and
+    consumers (event handlers, anomaly tooling, user code holding the
+    Telemetry) may emit from any of them — so every Sink implementation
+    must make ``emit`` safe under concurrent callers (the built-ins
+    lock; ``LoggingSink`` rides the stdlib logging module's own handler
+    lock)."""
 
     def emit(self, record: Dict[str, Any]) -> None:
         raise NotImplementedError
@@ -30,16 +39,21 @@ class Sink:
 
 
 class InMemorySink(Sink):
-    """Keeps every record in ``self.records`` — the test/notebook sink."""
+    """Keeps every record in ``self.records`` — the test/notebook sink.
+    Emits are locked: two threads appending concurrently must both land
+    (and ``by_kind`` must never iterate a list mid-resize)."""
 
     def __init__(self):
         self.records: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
 
     def emit(self, record: Dict[str, Any]) -> None:
-        self.records.append(record)
+        with self._lock:
+            self.records.append(record)
 
     def by_kind(self, kind: str) -> List[Dict[str, Any]]:
-        return [r for r in self.records if r.get("kind") == kind]
+        with self._lock:
+            return [r for r in self.records if r.get("kind") == kind]
 
 
 class JsonlSink(Sink):

@@ -45,6 +45,8 @@ _log = logging.getLogger("paddle_tpu.telemetry")
 # Peak dense bf16 FLOP/s per chip by device kind (public spec sheets) — the
 # MFU denominator. bench.py consumes this table too.
 PEAK_FLOPS = {
+    "TPU v6 lite": 918e12,   # v6e (Trillium)
+    "TPU v6e": 918e12,
     "TPU v5 lite": 197e12,   # v5e
     "TPU v5e": 197e12,
     "TPU v5p": 459e12,
@@ -53,12 +55,32 @@ PEAK_FLOPS = {
     "TPU v2": 46e12,
 }
 
+# device kinds whose missing PEAK_FLOPS entry was already logged — the
+# fallback is one-shot per kind per process, not silent and not spammy
+_unknown_kinds_logged: set = set()
+
 
 def device_peak_flops(device=None) -> Optional[float]:
     """Spec-sheet peak FLOP/s for ``device`` (default: first local device);
-    None when the device kind has no published entry (e.g. CPU)."""
+    None when the device kind has no published entry. An unknown TPU kind
+    logs a one-shot WARNING (MFU silently reading None on new hardware is
+    exactly the kind of quiet observability rot this layer exists to
+    prevent); non-TPU kinds (CPU, GPU plugins) log once at DEBUG."""
     device = device or jax.devices()[0]
-    return PEAK_FLOPS.get(getattr(device, "device_kind", ""))
+    kind = getattr(device, "device_kind", "")
+    peak = PEAK_FLOPS.get(kind)
+    if peak is None and kind not in _unknown_kinds_logged:
+        _unknown_kinds_logged.add(kind)
+        if "TPU" in kind:
+            _log.warning(
+                "no PEAK_FLOPS entry for device kind %r — est_mfu_pct will "
+                "be None; add the spec-sheet bf16 peak to "
+                "obs.telemetry.PEAK_FLOPS (or pass Telemetry(peak_flops=))",
+                kind)
+        else:
+            _log.debug("device kind %r has no peak-FLOPs entry (MFU "
+                       "accounting disabled)", kind)
+    return peak
 
 
 def lowered_hlo_flops(lowered) -> Optional[float]:
@@ -161,6 +183,11 @@ class Telemetry:
         # latest health scalars (host-side, refreshed per call)
         self.last_health: Dict[str, float] = {}
         self._steps_emitted = 0
+        # set by host_pipeline when the stager thread missed its join
+        # deadline at close — surfaced in summary() so a leak is visible
+        # in the run's own output, not only in a log line
+        self.stager_leaked = False
+        self._closed = False
 
     # -- compile / retrace -------------------------------------------------
 
@@ -267,8 +294,12 @@ class Telemetry:
         k_steps = rec.get("k_steps") or 1
         dev_s = rec.get("device_ms")
         disp_s = rec.get("dispatch_ms")
+        rec.setdefault("profiled", False)   # fixed schema
         pipelined = rec.get("drain_wait_ms") is not None
-        total_ms = (0.0 if pipelined
+        # profiled calls (anomaly-armed jax.profiler capture) fence INSIDE
+        # the dispatch window, so their dispatch_ms includes device compute
+        # — no honest per-record rate can be derived from them either
+        total_ms = (0.0 if pipelined or rec["profiled"]
                     else (dev_s or 0.0) + (disp_s or 0.0))
         rec["fenced"] = bool(self.fence and dev_s is not None)
         if total_ms > 0:
@@ -313,6 +344,18 @@ class Telemetry:
                 _log.exception("telemetry sink %r failed", s)  # kill training
 
     def close(self) -> None:
+        """Emit one final ``summary`` record to every sink, then close
+        them. The summary makes a run's JSONL self-contained — the
+        aggregate view (mean breakdowns, retrace totals, peak memory,
+        ``stager_leaked``) previously existed only in-process. Idempotent:
+        a second close neither re-emits nor fails."""
+        if not self._closed:
+            self._closed = True
+            try:
+                self._emit({"kind": "summary", "ts": time.time(),
+                            **self.summary()})
+            except Exception:
+                _log.exception("telemetry summary emit failed at close")
         for s in self.sinks:
             try:
                 s.close()
@@ -328,7 +371,8 @@ class Telemetry:
                "compile_count": self.compile_count,
                "retrace_count": self.retrace_count,
                "hlo_flops_per_call": self.hlo_flops_per_call,
-               "peak_bytes": mem}
+               "peak_bytes": mem,
+               "stager_leaked": self.stager_leaked}
         for s in self.sinks:
             if isinstance(s, InMemorySink) and s.records:
                 steps = s.by_kind("step")
@@ -336,8 +380,12 @@ class Telemetry:
                     for key in ("host_stack_ms", "shard_ms", "dispatch_ms",
                                 "device_ms", "replay_ms", "stage_ms",
                                 "drain_wait_ms", "overlap_frac"):
+                        # profiled records fence inside their dispatch
+                        # window (anomaly-armed capture) — their breakdown
+                        # is not comparable, same rule as emit_step
                         vals = [r[key] for r in steps
-                                if r.get(key) is not None]
+                                if r.get(key) is not None
+                                and not r.get("profiled")]
                         if vals:
                             out[f"mean_{key}"] = round(
                                 float(np.mean(vals)), 4)

@@ -50,12 +50,17 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import logging
 import queue
 import threading
 import time
 from typing import Any, Callable, List, Optional
 
+from ..obs.trace import tspan
+
 __all__ = ["GroupStager", "StagedUnit", "StagedGroup", "FusedPipeline"]
+
+_log = logging.getLogger("paddle_tpu.host_pipeline")
 
 
 @dataclasses.dataclass
@@ -77,6 +82,8 @@ class StagedGroup:
     boundary: bool       # crosses a saving_period checkpoint boundary
     crc: Optional[int]   # fingerprint of the group's last host batch
                          # (computed in the stager, only for boundary groups)
+    flow: Optional[int] = None   # tracer flow id linking this group's
+                                 # staging span to its dispatch + drain spans
 
 
 @dataclasses.dataclass
@@ -100,8 +107,10 @@ class GroupStager:
 
     _POLL_S = 0.05
 
-    def __init__(self, stage_fn: Callable[[Any], Any]):
+    def __init__(self, stage_fn: Callable[[Any], Any],
+                 join_timeout: float = 10.0):
         self._stage_fn = stage_fn
+        self._join_timeout = float(join_timeout)
         self._in: queue.Queue = queue.Queue(maxsize=1)
         self._out: queue.Queue = queue.Queue()
         self._exc: List[BaseException] = []
@@ -157,9 +166,23 @@ class GroupStager:
                 raise RuntimeError("host-pipeline stager exited unexpectedly")
             return item, time.perf_counter() - t0
 
-    def close(self):
+    def close(self) -> bool:
+        """Stop the worker and join it. Returns True when the thread
+        missed the join deadline (stuck in a long ``device_put``/transport
+        call?) — the daemon thread is leaked rather than blocking shutdown
+        forever, but never silently: the caller gets the flag and the log
+        names the thread."""
         self._stop.set()
-        self._thread.join(timeout=10.0)
+        self._thread.join(timeout=self._join_timeout)
+        if self._thread.is_alive():
+            _log.warning(
+                "host-pipeline stager thread %r did not exit within %.1fs "
+                "of close() — leaking the daemon thread (a device_put or "
+                "transport call is likely wedged); telemetry summary will "
+                "carry stager_leaked=True",
+                self._thread.name, self._join_timeout)
+            return True
+        return False
 
 
 class FusedPipeline:
@@ -229,7 +252,7 @@ class FusedPipeline:
         results, stage_total = [], 0.0
         for u in sg.units:
             losses, stats, health, rec = tr._dispatch_fused(
-                None, self._rng, staged=u, defer=True)
+                None, self._rng, staged=u, defer=True, flow=sg.flow)
             stage_total += u.stack_s + u.shard_s
             results.append((sg.buf_start + u.offset, u.m_eff, losses, stats,
                             tr._host_step, health, rec))
@@ -250,12 +273,17 @@ class FusedPipeline:
 
     def _drain_one(self):
         pg = self._window.popleft()
-        self._tr._finalize_group(
-            self._pass_id, pg.staged.buf_start, pg.staged.buf_len,
-            pg.results, self._handler, self._costs, self._log_period,
-            self._saving_period, self._checkpoint_dir, self._checkpoint_keep,
-            self._save_fn, crc_fn=lambda: pg.staged.crc,
-            drain_timing=True, overlap_frac=pg.overlap_frac)
+        # the drain span closes the group's flow: staging (stager thread)
+        # -> dispatch -> drain, arrow-linked in the trace viewer
+        with tspan(self._tr.tracer, "drain", flow_end=pg.staged.flow,
+                   group=pg.staged.buf_start):
+            self._tr._finalize_group(
+                self._pass_id, pg.staged.buf_start, pg.staged.buf_len,
+                pg.results, self._handler, self._costs, self._log_period,
+                self._saving_period, self._checkpoint_dir,
+                self._checkpoint_keep, self._save_fn,
+                crc_fn=lambda: pg.staged.crc,
+                drain_timing=True, overlap_frac=pg.overlap_frac)
 
     def drain_all(self):
         while self._window:
@@ -270,4 +298,6 @@ class FusedPipeline:
         self.drain_all()
 
     def close(self):
-        self._stager.close()
+        leaked = self._stager.close()
+        if leaked and self._tr.telemetry is not None:
+            self._tr.telemetry.stager_leaked = True

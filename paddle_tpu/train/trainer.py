@@ -31,6 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import mesh as mesh_lib
 from ..core.module import Module
+from ..obs.trace import tspan
 from ..optim.optimizers import Optimizer, apply_updates
 from ..utils.stats import StatSet
 from . import checkpoint as ckpt_lib
@@ -165,6 +166,25 @@ class Trainer:
         With ``telemetry=None`` (default) the hot loop is unchanged: same
         traced step function, same dispatch count, same donation, and
         zero extra device fetches or fences.
+      tracer: optional :class:`paddle_tpu.obs.Tracer`. When attached, the
+        trainer records thread-aware timeline spans (plan / stack /
+        device_put / dispatch / fence / drain-wait / events-replay /
+        checkpoint-save / eval on the main thread; stack + shard on the
+        stager thread, flow-linked to the later dispatch and drain) and
+        serializes them as Chrome Trace Event JSON
+        (``tracer.save(path)`` — open in Perfetto), so host/device
+        overlap is visually auditable. Spans are host-side wall clocks
+        only: no extra dispatch, no fence, and with ``tracer=None`` the
+        hot loop is byte-identical (pinned by tests/test_trace.py
+        alongside tests/test_obs.py's telemetry-off invariant).
+      anomaly: optional :class:`paddle_tpu.obs.AnomalyDetector`. Consumes
+        every telemetry step record (requires ``telemetry``); on a
+        detected anomaly (slow-step outlier, retrace burst, drain stall,
+        memory high-water, NaN sentinel) it dumps a one-shot forensics
+        bundle — telemetry ring + recent trace spans + config/env/mesh
+        snapshot + verdict — and can arm a ``jax.profiler`` capture for
+        the next fused call. Observation only: training continues, and a
+        detector failure is logged, never raised.
     """
 
     def __init__(self, model: Module, loss_fn: Callable, optimizer: Optimizer,
@@ -173,7 +193,8 @@ class Trainer:
                  nan_check: bool = False,
                  param_stats_period: Optional[int] = None,
                  steps_per_call: int = 1, grad_accum: int = 1,
-                 pipeline_depth: int = 1, telemetry=None):
+                 pipeline_depth: int = 1, telemetry=None, tracer=None,
+                 anomaly=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -209,12 +230,76 @@ class Trainer:
         # the pre-obs build (no health outputs in the traced step, no
         # fencing, no extra fetches — pinned by tests/test_obs.py).
         self.telemetry = telemetry
+        # tracer/anomaly: same rule — None means the hot loop is the
+        # pre-obs build exactly (tspan(None, ...) is a shared no-op
+        # context; anomaly observation only ever follows a telemetry
+        # emit, which telemetry=None already gates).
+        self.tracer = tracer
+        if anomaly is not None and telemetry is None:
+            raise ValueError(
+                "AnomalyDetector consumes telemetry step records — pass "
+                "telemetry=Telemetry(...) alongside anomaly=")
+        self.anomaly = anomaly
         self._fused_step = None
         self.train_state: Optional[TrainState] = None
         self._last_iter_state: Optional[Dict[str, Any]] = None
 
     def _health_on(self) -> bool:
         return self.telemetry is not None and self.telemetry.health
+
+    # -- anomaly plumbing ----------------------------------------------------
+
+    def _anomaly_observe(self, rec) -> None:
+        """Feed one finalized telemetry record to the anomaly detector.
+        Detection is observation: a detector crash must never kill the
+        run it watches, so failures log and training continues."""
+        if self.anomaly is None or rec is None:
+            return
+        try:
+            self.anomaly.observe(rec)
+        except Exception:
+            _log.exception("anomaly detector failed (training continues)")
+
+    def _maybe_profiled_call(self, fn, *args):
+        """Run ONE compiled dispatch, wrapped in an anomaly-armed
+        ``jax.profiler`` capture when one is pending (every dispatch path
+        — fused, serial plain, deferred plain — polls here, so
+        ``arm_profiler`` is never a silent no-op). Returns ``(out,
+        profiled)``; a profiled call fences inside the capture so the
+        device compute lands in it — its record is stamped ``profiled``
+        and excluded from rates/wall statistics."""
+        prof_dir = (self.anomaly.take_profiler_request()
+                    if self.anomaly is not None else None)
+        if prof_dir is None:
+            return fn(*args), False
+        from ..obs.trace import jax_profile
+        with jax_profile(prof_dir):
+            out = fn(*args)
+            jax.block_until_ready(out[:5])   # capture the compute,
+        return out, True                     # not just the enqueue
+
+    def _anomaly_context(self) -> Dict[str, Any]:
+        """The config/env/mesh snapshot frozen into a forensics bundle."""
+        import os
+        mesh = self.mesh
+        return {
+            "model": type(self.model).__name__,
+            "optimizer": type(self.optimizer).__name__,
+            "steps_per_call": self.steps_per_call,
+            "grad_accum": self.grad_accum,
+            "pipeline_depth": self.pipeline_depth,
+            "donate": self._donate,
+            "nan_check": self._nan_check,
+            "param_sharding": self._param_sharding is not None,
+            "host_step": self._host_step,
+            "mesh_axes": dict(zip(mesh.axis_names, mesh.devices.shape)),
+            "device_count": jax.device_count(),
+            "device_kind": jax.devices()[0].device_kind,
+            "backend": jax.default_backend(),
+            "jax_version": jax.__version__,
+            "env": {k: v for k, v in os.environ.items()
+                    if k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))},
+        }
 
     # -- setup ---------------------------------------------------------------
 
@@ -458,6 +543,11 @@ class Trainer:
         pass's metrics cover only its remaining batches.
         """
         assert self.train_state is not None, "call init() first"
+        if self.anomaly is not None:
+            # the flight recorder needs the trace ring and a lazy
+            # config/env/mesh snapshot source for its bundles
+            self.anomaly.bind(tracer=self.tracer,
+                              context_fn=self._anomaly_context)
         fused = self.steps_per_call > 1 or self.grad_accum > 1
         if not fused and self._train_step is None:
             self._build_train_step()    # fused step builds lazily per group
@@ -526,12 +616,13 @@ class Trainer:
                 pass_metrics.update({f"test_{k}": v for k, v in tm.items()})
                 pass_metrics["test_cost"] = tc
             if checkpoint_dir:
-                save_fn(
-                    checkpoint_dir, pass_id,
-                    {**self.train_state.as_dict(),
-                     "iter": {"pass": pass_id, "next_batch": 0,
-                              "completed": 1}},
-                    keep_last=checkpoint_keep)
+                with tspan(self.tracer, "checkpoint_save", pass_end=pass_id):
+                    save_fn(
+                        checkpoint_dir, pass_id,
+                        {**self.train_state.as_dict(),
+                         "iter": {"pass": pass_id, "next_batch": 0,
+                                  "completed": 1}},
+                        keep_last=checkpoint_keep)
             handler(ev.EndPass(pass_id, pass_metrics))
         return self.train_state
 
@@ -639,7 +730,8 @@ class Trainer:
                 fp = ((1, 1),) + _step_fingerprint(host_batch)
                 is_new = tel.observe_fingerprint(fp)
             t0 = time.perf_counter()
-            with self.stats.time("shard_batch"):
+            with self.stats.time("shard_batch"), \
+                    tspan(self.tracer, "device_put", batch=batch_id):
                 batch = self._shard(host_batch)
             t1 = time.perf_counter()
             hlo_flops = None
@@ -654,9 +746,12 @@ class Trainer:
             # measurement layer must not bill its own extra trace to
             # the step it measures (the fused path does the same)
             t_disp = time.perf_counter()
-            with self.stats.time("train_step"):
-                out = self._train_step(params, state, opt_state, step,
-                                       batch, rng)
+            with self.stats.time("train_step"), \
+                    tspan(self.tracer, "dispatch", batch=batch_id,
+                          new_compile=is_new):
+                out, profiled = self._maybe_profiled_call(
+                    self._train_step, params, state, opt_state, step,
+                    batch, rng)
             params, state, opt_state, step = out[:4]
             loss, stats = out[4], out[5]
             health = out[6] if len(out) > 6 else None
@@ -665,7 +760,8 @@ class Trainer:
             if tel is not None and tel.fence:
                 # the fencing rule: the dispatch above returned as soon
                 # as the program was enqueued — device time needs a sync
-                jax.block_until_ready((params, loss))
+                with tspan(self.tracer, "fence", batch=batch_id):
+                    jax.block_until_ready((params, loss))
                 device_s = time.perf_counter() - t2
                 self.stats.add("device_wait", device_s)
             if is_new:
@@ -684,6 +780,7 @@ class Trainer:
                 rec = tel.emit_step(
                     {"pass": pass_id, "step": int(step),
                      "k_steps": 1, "m": 1, "loss": cost,
+                     "profiled": profiled,
                      "host_stack_ms": None,
                      "shard_ms": round((t1 - t0) * 1e3, 3),
                      "dispatch_ms": round((t2 - t_disp) * 1e3, 3),
@@ -691,6 +788,7 @@ class Trainer:
                                    if device_s is not None else None),
                      "replay_ms": None})
                 handler(ev.TelemetryRecord(record=rec))
+                self._anomaly_observe(rec)
             if self._nan_check and not np.isfinite(cost):
                 from ..utils import debug as dbg
                 bad = dbg.nonfinite_leaves(
@@ -720,13 +818,17 @@ class Trainer:
                 self._log_param_stats(pass_id, batch_id)
             if saving_period and checkpoint_dir and \
                     (batch_id + 1) % saving_period == 0:
-                save_fn(
-                    checkpoint_dir, pass_id,
-                    {**self.train_state.as_dict(),
-                     "iter": {"pass": pass_id, "next_batch": batch_id + 1,
-                              "completed": 0,
-                              "batch_crc": _batch_fingerprint(host_batch)}},
-                    keep_last=checkpoint_keep)
+                with tspan(self.tracer, "checkpoint_save",
+                           next_batch=batch_id + 1):
+                    save_fn(
+                        checkpoint_dir, pass_id,
+                        {**self.train_state.as_dict(),
+                         "iter": {"pass": pass_id,
+                                  "next_batch": batch_id + 1,
+                                  "completed": 0,
+                                  "batch_crc":
+                                      _batch_fingerprint(host_batch)}},
+                        keep_last=checkpoint_keep)
             handler(ev.EndIteration(pass_id, batch_id, int(step), cost,
                                     metrics))
         if fused and buf:
@@ -766,7 +868,8 @@ class Trainer:
             fp = ((1, 1),) + _step_fingerprint(host_batch)
             is_new = tel.observe_fingerprint(fp)
         t0 = time.perf_counter()
-        with self.stats.time("shard_batch"):
+        with self.stats.time("shard_batch"), \
+                tspan(self.tracer, "device_put", batch=batch_id):
             batch = self._shard(host_batch)
         t1 = time.perf_counter()
         hlo_flops = None
@@ -778,9 +881,12 @@ class Trainer:
             except Exception:
                 hlo_flops = None
         t_disp = time.perf_counter()
-        with self.stats.time("train_step"):
-            out = self._train_step(params, state, opt_state, step, batch,
-                                   rng)
+        with self.stats.time("train_step"), \
+                tspan(self.tracer, "dispatch", batch=batch_id,
+                      new_compile=is_new):
+            out, profiled = self._maybe_profiled_call(
+                self._train_step, params, state, opt_state, step, batch,
+                rng)
         params, state, opt_state, step = out[:4]
         t2 = time.perf_counter()
         if is_new:
@@ -793,7 +899,7 @@ class Trainer:
         rec = None
         if tel is not None:
             rec = {"pass": pass_id, "step": self._host_step,
-                   "k_steps": 1, "m": 1,
+                   "k_steps": 1, "m": 1, "profiled": profiled,
                    "host_stack_ms": None,
                    "shard_ms": round((t1 - t0) * 1e3, 3),
                    "dispatch_ms": round((t2 - t_disp) * 1e3, 3),
@@ -817,7 +923,8 @@ class Trainer:
         batch_id = entry["batch_id"]
         handler(ev.BeginIteration(pass_id, batch_id))
         t0 = time.perf_counter()
-        cost = float(np.asarray(jax.device_get(entry["loss"])))
+        with tspan(self.tracer, "drain_wait", batch=batch_id):
+            cost = float(np.asarray(jax.device_get(entry["loss"])))
         drain_wait = time.perf_counter() - t0
         self.stats.add("drain_wait", drain_wait)
         if tel is not None:
@@ -828,6 +935,7 @@ class Trainer:
             rec["drain_wait_ms"] = round(drain_wait * 1e3, 3)
             rec = tel.emit_step(rec)
             handler(ev.TelemetryRecord(record=rec))
+            self._anomaly_observe(rec)
         costs.append(cost)
         metrics = {}
         if self.evaluator is not None:
@@ -847,12 +955,14 @@ class Trainer:
         if entry["boundary"]:
             # the boundary forced a full drain right after this batch's
             # dispatch, so train_state is quiesced at exactly this step
-            save_fn(
-                checkpoint_dir, pass_id,
-                {**self.train_state.as_dict(),
-                 "iter": {"pass": pass_id, "next_batch": batch_id + 1,
-                          "completed": 0, "batch_crc": entry["crc"]}},
-                keep_last=checkpoint_keep)
+            with tspan(self.tracer, "checkpoint_save",
+                       next_batch=batch_id + 1):
+                save_fn(
+                    checkpoint_dir, pass_id,
+                    {**self.train_state.as_dict(),
+                     "iter": {"pass": pass_id, "next_batch": batch_id + 1,
+                              "completed": 0, "batch_crc": entry["crc"]}},
+                    keep_last=checkpoint_keep)
         handler(ev.EndIteration(pass_id, batch_id, entry["step"], cost,
                                 metrics))
 
@@ -880,21 +990,32 @@ class Trainer:
         locked), so it can overlap the in-flight device calls."""
         from .host_pipeline import StagedGroup, StagedUnit
         buf, buf_start, boundary = work
+        tracer = self.tracer
+        # the group's flow id links THIS thread's staging span to the main
+        # thread's later dispatch + drain spans in the trace viewer
+        flow = tracer.new_flow() if tracer is not None else None
         units = []
-        for off, take, m_eff in self._plan_group(len(buf), self.grad_accum):
-            t0 = time.perf_counter()
-            stacked = self._stack_group(buf[off:off + take],
-                                        take // m_eff, m_eff)
-            t1 = time.perf_counter()
-            staged = self._shard_fused(stacked)
-            t2 = time.perf_counter()
-            self.stats.add("stage_stack", t1 - t0)
-            self.stats.add("stage_shard", t2 - t1)
-            units.append(StagedUnit(offset=off, m_eff=m_eff, batches=staged,
-                                    stack_s=t1 - t0, shard_s=t2 - t1))
-        crc = _batch_fingerprint(buf[-1]) if boundary else None
+        with tspan(tracer, "stage", flow_start=flow, group=buf_start,
+                   batches=len(buf)):
+            for off, take, m_eff in self._plan_group(len(buf),
+                                                     self.grad_accum):
+                t0 = time.perf_counter()
+                with tspan(tracer, "stack", group=buf_start, offset=off):
+                    stacked = self._stack_group(buf[off:off + take],
+                                                take // m_eff, m_eff)
+                t1 = time.perf_counter()
+                with tspan(tracer, "shard", group=buf_start, offset=off):
+                    staged = self._shard_fused(stacked)
+                t2 = time.perf_counter()
+                self.stats.add("stage_stack", t1 - t0)
+                self.stats.add("stage_shard", t2 - t1)
+                units.append(StagedUnit(offset=off, m_eff=m_eff,
+                                        batches=staged,
+                                        stack_s=t1 - t0, shard_s=t2 - t1))
+            crc = _batch_fingerprint(buf[-1]) if boundary else None
         return StagedGroup(buf_start=buf_start, buf_len=len(buf),
-                           units=units, boundary=boundary, crc=crc)
+                           units=units, boundary=boundary, crc=crc,
+                           flow=flow)
 
     def _stack_group(self, sub, k: int, m: int):
         """Stack k*m host batches into one pytree with leaves
@@ -944,7 +1065,7 @@ class Trainer:
             stacked)
 
     def _dispatch_fused(self, stacked, rng, stack_s=None, staged=None,
-                        defer=False):
+                        defer=False, flow=None):
         """One fused device call; refreshes train_state (donation invalidates
         the previous buffers). Returns ``(losses [K], stats [K(, M), ...],
         health_or_None, record_or_None)`` — ``health`` is the device-side
@@ -976,7 +1097,8 @@ class Trainer:
         if staged is not None:
             batches, shard_s = staged.batches, staged.shard_s
         else:
-            with self.stats.time("shard_batch"):
+            with self.stats.time("shard_batch"), \
+                    tspan(self.tracer, "device_put"):
                 t_sh = time.perf_counter()
                 batches = self._shard_fused(stacked)
                 shard_s = time.perf_counter() - t_sh
@@ -991,8 +1113,11 @@ class Trainer:
             except Exception:
                 hlo_flops = None
         t_disp = time.perf_counter()
-        with self.stats.time("train_step"):
-            out = self._fused_step(*args)
+        with self.stats.time("train_step"), \
+                tspan(self.tracer, "dispatch", flow_step=flow,
+                      step=self._host_step, new_compile=is_new):
+            out, profiled = self._maybe_profiled_call(self._fused_step,
+                                                      *args)
         dispatch_s = time.perf_counter() - t_disp
         params, state, opt_state, step = out[:4]
         losses, stats = out[4], out[5]
@@ -1004,7 +1129,8 @@ class Trainer:
             # it measures dispatch, not compute. True device time is the
             # extra wait until the outputs are ready. Telemetry owns this
             # sync; without telemetry the loop never fences.
-            jax.block_until_ready((params, losses))
+            with tspan(self.tracer, "fence"):
+                jax.block_until_ready((params, losses))
             device_s = time.perf_counter() - t_disp - dispatch_s
             self.stats.add("device_wait", device_s)
         k_eff = int(losses.shape[0])
@@ -1020,6 +1146,13 @@ class Trainer:
         if tel is not None:
             rec = {"k_steps": k_eff,
                    "m": int(jax.tree_util.tree_leaves(stacked)[0].shape[1]),
+                   # profiled calls carry a block_until_ready INSIDE the
+                   # dispatch window (the capture must include compute) —
+                   # their dispatch_ms is not comparable, so telemetry
+                   # suppresses throughput and the anomaly detector skips
+                   # the record (the flight recorder must not trigger the
+                   # detector that armed it)
+                   "profiled": profiled,
                    "host_stack_ms": (round(stack_s * 1e3, 3)
                                      if stack_s is not None else None),
                    "shard_ms": round(shard_s * 1e3, 3),
@@ -1056,10 +1189,13 @@ class Trainer:
         the true ``next_batch`` position — so resume replay stays aligned
         with the fused grouping)."""
         results = []
-        for off, take, m_eff in self._plan_group(len(buf), self.grad_accum):
+        with tspan(self.tracer, "plan", group=buf_start, batches=len(buf)):
+            plans = self._plan_group(len(buf), self.grad_accum)
+        for off, take, m_eff in plans:
             t_stack = time.perf_counter()
-            stacked = self._stack_group(buf[off:off + take],
-                                        take // m_eff, m_eff)
+            with tspan(self.tracer, "stack", group=buf_start, offset=off):
+                stacked = self._stack_group(buf[off:off + take],
+                                            take // m_eff, m_eff)
             stack_s = time.perf_counter() - t_stack
             self.stats.add("stack_group", stack_s)
             losses, stats, health, rec = self._dispatch_fused(
@@ -1093,7 +1229,8 @@ class Trainer:
             for i, (start, m_eff, losses, stats, step_after, health,
                     rec) in enumerate(results):
                 t0 = time.perf_counter()
-                losses = np.asarray(jax.device_get(losses))
+                with tspan(self.tracer, "drain_wait", step=step_after):
+                    losses = np.asarray(jax.device_get(losses))
                 wait = time.perf_counter() - t0
                 self.stats.add("drain_wait", wait)
                 if rec is not None:
@@ -1116,13 +1253,14 @@ class Trainer:
             for _, _, losses, _, _, _, _ in results)
         if saving_period and checkpoint_dir and group_finite and \
                 (end // saving_period) > (buf_start // saving_period):
-            save_fn(
-                checkpoint_dir, pass_id,
-                {**self.train_state.as_dict(),
-                 "iter": {"pass": pass_id, "next_batch": end,
-                          "completed": 0,
-                          "batch_crc": crc_fn()}},
-                keep_last=checkpoint_keep)
+            with tspan(self.tracer, "checkpoint_save", next_batch=end):
+                save_fn(
+                    checkpoint_dir, pass_id,
+                    {**self.train_state.as_dict(),
+                     "iter": {"pass": pass_id, "next_batch": end,
+                              "completed": 0,
+                              "batch_crc": crc_fn()}},
+                    keep_last=checkpoint_keep)
         for start, m_eff, losses, stats, step_after, health, rec in results:
             # Health scalars are device-side [K] stacks; fetching them here
             # rides the same per-call host sync that already fetches the
@@ -1132,21 +1270,46 @@ class Trainer:
                          if (tel is not None and health is not None)
                          else None)
             t_replay = time.perf_counter()
-            self._post_fused(pass_id, start, m_eff, losses, stats,
-                             step_after, handler, costs, log_period,
-                             health_np=health_np)
-            if tel is not None and rec is not None:
-                if health_np is not None:
-                    tel.update_health({k: v[-1]
-                                       for k, v in health_np.items()})
-                rec["pass"] = pass_id
-                rec["step"] = step_after
-                rec["loss"] = float(np.asarray(
-                    jax.device_get(losses)).ravel()[-1])
-                rec["replay_ms"] = round(
-                    (time.perf_counter() - t_replay) * 1e3, 3)
-                rec = tel.emit_step(rec)
-                handler(ev.TelemetryRecord(record=rec))
+            replay_ok = False
+            try:
+                with tspan(self.tracer, "events_replay", step=step_after):
+                    self._post_fused(pass_id, start, m_eff, losses, stats,
+                                     step_after, handler, costs, log_period,
+                                     health_np=health_np)
+                replay_ok = True
+            finally:
+                # the record is emitted (and the anomaly detector fed) even
+                # when nan_check's FloatingPointError unwinds _post_fused —
+                # the plain loop observes before its raise, and a poisoned
+                # run is EXACTLY when the flight recorder must fire. While
+                # unwinding, a secondary failure here (a raising handler,
+                # a dead transport under the loss fetch) must NOT mask the
+                # original error and its nonfinite-leaves diagnostic.
+                if tel is not None and rec is not None:
+                    # success sentinel, NOT sys.exc_info(): the latter is
+                    # non-None for the whole call when train() itself runs
+                    # inside a caller's except block, which would silently
+                    # swallow healthy-path handler bugs
+                    unwinding = not replay_ok
+                    try:
+                        if health_np is not None:
+                            tel.update_health(
+                                {k: v[-1] for k, v in health_np.items()})
+                        rec["pass"] = pass_id
+                        rec["step"] = step_after
+                        rec["loss"] = float(np.asarray(
+                            jax.device_get(losses)).ravel()[-1])
+                        rec["replay_ms"] = round(
+                            (time.perf_counter() - t_replay) * 1e3, 3)
+                        rec = tel.emit_step(rec)
+                        handler(ev.TelemetryRecord(record=rec))
+                        self._anomaly_observe(rec)
+                    except Exception:
+                        if not unwinding:
+                            raise
+                        _log.exception(
+                            "telemetry emit failed during exception unwind "
+                            "(the original error propagates)")
 
     def _post_fused(self, pass_id, start_index, m_eff, losses, stats,
                     step_after, handler, costs, log_period, health_np=None):
@@ -1232,12 +1395,13 @@ class Trainer:
             self.evaluator.reset()
         ts = self.train_state
         costs = []
-        for host_batch in reader():
-            batch = self._shard(host_batch)
-            loss, stats = self._eval_step(ts.params, ts.state, batch)
-            costs.append(float(loss))
-            if self.evaluator is not None:
-                self.evaluator.update(jax.device_get(stats))
+        with tspan(self.tracer, "eval"):
+            for host_batch in reader():
+                batch = self._shard(host_batch)
+                loss, stats = self._eval_step(ts.params, ts.state, batch)
+                costs.append(float(loss))
+                if self.evaluator is not None:
+                    self.evaluator.update(jax.device_get(stats))
         metrics = self.evaluator.result() if self.evaluator is not None else {}
         return float(np.mean(costs)) if costs else 0.0, metrics
 

@@ -42,6 +42,18 @@ def test_bench_smoke_cpu_green_and_equal():
     assert tel["hlo_flops_per_call"] and tel["hlo_flops_per_call"] > 0
     assert tel["tokens_per_sec"] > 0
     assert tel["grad_norm"] > 0
+    # ISSUE 4: the structured-trace gate ran — the traced pipelined run
+    # serialized a valid Chrome trace with spans from >=2 threads, every
+    # staging flow paired with its drain, sane monotonic timestamps, a
+    # staging span provably concurrent with a main-thread span, and no
+    # math perturbation
+    trace = out["trace"]
+    assert trace["trace_ok"] is True, trace
+    assert trace["threads"] >= 2 and trace["spans"] > 0
+    assert trace["flows"] >= 1 and trace["flows_paired"] is True
+    assert trace["ts_monotonic"] is True and trace["ts_valid"] is True
+    assert trace["stage_concurrent_with_main"] is True
+    assert trace["losses_equal_with_tracer"] is True
 
 
 def test_bench_prep_transformer_fused_builds():

@@ -1,7 +1,12 @@
 """Telemetry subsystem tests (ISSUE 2): JSONL sink schema round-trip,
 retrace counter keyed by step fingerprint, health monitors flagging an
 injected NaN, and the telemetry-off zero-overhead invariant (no extra
-dispatches, no fences, no health outputs, bit-identical params)."""
+dispatches, no fences, no health outputs, bit-identical params).
+
+ISSUE 4 satellites ride here too: thread-safe sink emit, the final
+`summary` record at Telemetry.close(), and the PEAK_FLOPS v6e entry +
+one-shot unknown-TPU-kind log (the tracer/anomaly layer itself is
+tests/test_trace.py, including the tracing-off zero-overhead pin)."""
 
 import json
 import logging
@@ -71,6 +76,99 @@ def test_jsonl_sink_schema_roundtrip(tmp_path):
     for r in compiles:
         assert r["wall_s"] > 0
         assert "hlo_flops" in r
+
+
+def test_sink_emit_thread_safe(tmp_path):
+    """ISSUE 4 satellite: tracer spans finish on the stager thread, so
+    sinks are written from two threads — concurrent emits must all land
+    (InMemorySink) and JSONL lines must never interleave (JsonlSink)."""
+    import threading
+    path = str(tmp_path / "conc.jsonl")
+    mem, jsonl = InMemorySink(), JsonlSink(path)
+    n_threads, per_thread = 8, 200
+
+    def worker(tid):
+        for i in range(per_thread):
+            rec = {"kind": "step", "tid": tid, "i": i,
+                   "pad": "x" * 200}            # long enough to tear
+            mem.emit(rec)
+            jsonl.emit(rec)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    jsonl.close()
+    assert len(mem.records) == n_threads * per_thread
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert len(lines) == n_threads * per_thread   # every line parses whole
+    for tid in range(n_threads):
+        assert [r["i"] for r in lines if r["tid"] == tid] == \
+            list(range(per_thread))
+
+
+def test_telemetry_close_emits_summary_record(tmp_path):
+    """ISSUE 4 satellite: close() writes one final `summary` record so the
+    JSONL is self-contained; a second close neither re-emits nor fails."""
+    path = str(tmp_path / "tel.jsonl")
+    mem = InMemorySink()
+    tel = Telemetry(sinks=[mem, JsonlSink(path)])
+    run_fused(make_trainer(telemetry=tel), make_batches(2 * 2 * 2))
+    tel.close()
+    tel.close()                                   # idempotent
+    on_disk = JsonlSink.read(path)
+    summaries = [r for r in on_disk if r["kind"] == "summary"]
+    assert len(summaries) == 1 and on_disk[-1]["kind"] == "summary"
+    s = summaries[0]
+    assert s["steps_emitted"] == 2 and s["compile_count"] >= 1
+    assert s["stager_leaked"] is False
+    assert "mean_dispatch_ms" in s                # the aggregate view
+    assert mem.by_kind("summary") == summaries    # every sink got it
+
+
+def test_profiled_records_excluded_from_rates_and_means():
+    """A profiled call (anomaly-armed jax.profiler capture) fences inside
+    its dispatch window — emit_step must not derive a rate from it and
+    summary() must not average its breakdown."""
+    mem = InMemorySink()
+    tel = Telemetry(sinks=[mem], tokens_per_step=100, peak_flops=1e12,
+                    flops_per_step=1e9)
+    tel.emit_step({"k_steps": 1, "dispatch_ms": 1.0, "device_ms": 1.0})
+    tel.emit_step({"k_steps": 1, "dispatch_ms": 1.0, "device_ms": 1.0})
+    rec = tel.emit_step({"k_steps": 1, "dispatch_ms": 5000.0,
+                         "profiled": True})
+    assert rec.get("tokens_per_sec") is None     # no rate from a fenced
+    assert rec.get("est_mfu_pct") is None        # dispatch window
+    s = tel.summary()
+    assert s["mean_dispatch_ms"] == 1.0          # profiled not averaged
+    # unprofiled records carry profiled=False in the fixed schema
+    assert mem.by_kind("step")[0]["profiled"] is False
+
+
+def test_peak_flops_v6e_and_unknown_kind_one_shot_log(caplog):
+    """ISSUE 4 satellite: TPU v6e is in the MFU table, and an unknown TPU
+    kind logs a one-shot WARNING instead of silently returning None."""
+    from paddle_tpu.obs import PEAK_FLOPS, device_peak_flops
+    from paddle_tpu.obs import telemetry as tel_mod
+    assert PEAK_FLOPS["TPU v6 lite"] == PEAK_FLOPS["TPU v6e"] == 918e12
+
+    class FakeDev:
+        device_kind = "TPU v99 hyper"
+
+    tel_mod._unknown_kinds_logged.discard("TPU v99 hyper")
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.telemetry"):
+        assert device_peak_flops(FakeDev()) is None
+        assert device_peak_flops(FakeDev()) is None   # second call silent
+    hits = [r for r in caplog.records if "TPU v99 hyper" in r.getMessage()]
+    assert len(hits) == 1
+    assert "PEAK_FLOPS" in hits[0].getMessage()
+
+    class Known:
+        device_kind = "TPU v6e"
+
+    assert device_peak_flops(Known()) == 918e12
 
 
 def test_logging_sink_emits(caplog):
