@@ -851,52 +851,100 @@ def run_smoke(K=4, M=2, timing_passes=3):
     tr_traced = make(K, telemetry=Telemetry(sinks=[InMemorySink()]),
                      pipeline_depth=2, tracer=Tracer())
     l_traced = run(tr_traced)
-    # gate on a FRESH tracer over a second, post-compile pass: in pass 1
-    # the tiny stream stages every group before the compile-dominated
-    # first dispatch even starts, so the steady-state interleaving the
-    # concurrency gate checks only exists from pass 2 on
-    tracer = Tracer()
-    tr_traced.tracer = tracer
-    run(tr_traced)
+    # gate on a FRESH tracer over a post-compile pass: in pass 1 the tiny
+    # stream stages every group before the compile-dominated first
+    # dispatch even starts, so the steady-state interleaving the
+    # concurrency gate checks only exists from pass 2 on. The
+    # stage-concurrent-with-main property is real but SCHEDULING-
+    # dependent on a fast host (the stager can finish staging between
+    # two main-thread spans in any one pass), so the gate takes up to
+    # `attempts` post-compile passes and passes when ANY exhibits the
+    # concurrency — the format/flow/clock invariants are re-checked on
+    # every attempt and must hold on the last one regardless.
     trace_path = os.path.join(os.path.dirname(jsonl_path), "trace.json")
-    tracer.save(trace_path)
     trace_ok, trace = False, {"path": trace_path,
                               "losses_equal_with_tracer": l_traced == l_fused}
+    attempts = 6
+    for attempt in range(attempts):
+        tracer = Tracer()
+        tr_traced.tracer = tracer
+        run(tr_traced)
+        tracer.save(trace_path)
+        try:
+            with open(trace_path) as f:
+                tdata = json.load(f)
+            evs = tdata["traceEvents"]
+            xs = [e for e in evs if e.get("ph") == "X"]
+            s_ids = {e["id"] for e in evs if e.get("ph") == "s"}
+            f_ids = {e["id"] for e in evs if e.get("ph") == "f"}
+            ts_list = [e.get("ts", -1.0) for e in evs]
+            # ts_monotonic alone only validates the serializer's sort;
+            # the clock invariant is every span ts >= 0 (relative to
+            # tracer construction) with a positive duration
+            ts_valid = all(e["ts"] >= 0 and e["dur"] > 0 for e in xs)
+            disp = [e for e in xs if e["name"] == "dispatch"]
+            stage = [e for e in xs if e["name"] == "stage"]
+            stage_tids = {e["tid"] for e in stage}
+            cross_thread = bool(stage and disp and
+                                not (stage_tids & {e["tid"] for e in disp}))
+            main = [e for e in xs if e["tid"] not in stage_tids]
+            stage_concurrent_with_main = any(
+                s["ts"] < m["ts"] + m["dur"] and s["ts"] + s["dur"] > m["ts"]
+                for s in stage for m in main)
+            trace_ok = (len({e["tid"] for e in xs}) >= 2 and cross_thread
+                        and bool(s_ids) and s_ids == f_ids
+                        and ts_list == sorted(ts_list) and ts_valid
+                        and stage_concurrent_with_main)
+            trace.update({
+                "trace_ok": trace_ok, "spans": len(xs),
+                "threads": len({e["tid"] for e in xs}),
+                "flows": len(s_ids), "flows_paired": s_ids == f_ids,
+                "ts_monotonic": ts_list == sorted(ts_list),
+                "ts_valid": ts_valid,
+                "stage_concurrent_with_main": stage_concurrent_with_main,
+                "concurrency_attempts": attempt + 1,
+            })
+        except Exception as e:                   # malformed file IS the bug
+            trace.update({"trace_ok": False,
+                          "error": f"{type(e).__name__}: {e}"})
+            break
+        if trace_ok:
+            break
+
+    # -- attribution gate (ISSUE 6): run the static HLO analyzer over the
+    # CPU fused transformer step on a SIMULATED dp mesh and assert the
+    # acceptance trio — >=4 named scopes with nonzero FLOPs, parsed total
+    # FLOPs within 5% of cost_analysis(), and an exposed-communication
+    # estimate for the grad all-reduce. Own subprocess: the forced
+    # 2-device platform must exist before jax initializes.
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    aflags = [f for f in env.get("XLA_FLAGS", "").split()
+              if "xla_force_host_platform_device_count" not in f]
+    aflags.append("--xla_force_host_platform_device_count=2")
+    env["XLA_FLAGS"] = " ".join(aflags)
+    repo = os.path.dirname(os.path.abspath(__file__))
     try:
-        with open(trace_path) as f:
-            tdata = json.load(f)
-        evs = tdata["traceEvents"]
-        xs = [e for e in evs if e.get("ph") == "X"]
-        s_ids = {e["id"] for e in evs if e.get("ph") == "s"}
-        f_ids = {e["id"] for e in evs if e.get("ph") == "f"}
-        ts_list = [e.get("ts", -1.0) for e in evs]
-        # ts_monotonic alone only validates the serializer's sort; the
-        # clock invariant is every span ts >= 0 (relative to tracer
-        # construction) with a positive duration
-        ts_valid = all(e["ts"] >= 0 and e["dur"] > 0 for e in xs)
-        disp = [e for e in xs if e["name"] == "dispatch"]
-        stage = [e for e in xs if e["name"] == "stage"]
-        stage_tids = {e["tid"] for e in stage}
-        cross_thread = bool(stage and disp and
-                            not (stage_tids & {e["tid"] for e in disp}))
-        main = [e for e in xs if e["tid"] not in stage_tids]
-        stage_concurrent_with_main = any(
-            s["ts"] < m["ts"] + m["dur"] and s["ts"] + s["dur"] > m["ts"]
-            for s in stage for m in main)
-        trace_ok = (len({e["tid"] for e in xs}) >= 2 and cross_thread
-                    and bool(s_ids) and s_ids == f_ids
-                    and ts_list == sorted(ts_list) and ts_valid
-                    and stage_concurrent_with_main)
-        trace.update({
-            "trace_ok": trace_ok, "spans": len(xs),
-            "threads": len({e["tid"] for e in xs}),
-            "flows": len(s_ids), "flows_paired": s_ids == f_ids,
-            "ts_monotonic": ts_list == sorted(ts_list),
-            "ts_valid": ts_valid,
-            "stage_concurrent_with_main": stage_concurrent_with_main,
-        })
-    except Exception as e:                       # malformed file IS the bug
-        trace.update({"trace_ok": False, "error": f"{type(e).__name__}: {e}"})
+        res = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--attribution-child", "1"],
+            cwd=repo, env=env, capture_output=True, text=True, timeout=600)
+        # the child prints its full verdict JSON (which acceptance
+        # criterion failed, scopes found, agreement pct) even when it
+        # exits 1 — keep that diagnosis; synthesize an error dict only
+        # when there is no parseable line (a crash before printing),
+        # and then carry the stderr tail so the traceback isn't lost
+        try:
+            attribution = json.loads(res.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            attribution = {"ok": False,
+                           "error": f"no verdict on stdout; "
+                                    f"stderr: {res.stderr[-400:]}"}
+        if res.returncode != 0:
+            attribution["ok"] = False
+            attribution.setdefault("rc", res.returncode)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        attribution = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    attribution_ok = attribution.get("ok") is True
 
     out = {
         "metric": "fused_vs_plain_smoke",
@@ -911,13 +959,152 @@ def run_smoke(K=4, M=2, timing_passes=3):
         "telemetry": telemetry,
         "pipeline": pipeline,
         "trace": trace,
+        "attribution": attribution,
     }
     print(json.dumps(out))
     ok = (out["equal"] and jsonl_ok
           and telemetry["losses_equal_with_telemetry"]
           and pipeline["losses_equal"] and pipeline["overlap_keys_ok"]
-          and trace_ok and trace["losses_equal_with_tracer"])
+          and trace_ok and trace["losses_equal_with_tracer"]
+          and attribution_ok)
     return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# MFU-gap attribution gate child (ISSUE 6): static HLO analyzer on the
+# fused transformer step over a simulated dp mesh
+# ---------------------------------------------------------------------------
+
+def run_attribution_child(K=2, M=2):
+    """Build the same tiny fused transformer trainer run_smoke gates, on
+    the dp mesh this process was forced onto
+    (xla_force_host_platform_device_count), run
+    ``Trainer.attribution_report`` over it, and print the gate verdict as
+    one JSON line: >=4 named scopes with nonzero FLOPs, parsed-vs-
+    cost_analysis FLOPs agreement within 5%, a collective inventory with
+    an exposed-communication estimate for the grad all-reduce, and the
+    ``kind="attribution"`` telemetry record landing in the sink."""
+    from paddle_tpu import optim
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.nn import costs
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    from paddle_tpu.train import Trainer
+
+    V, T, bs = 64, 16, 8
+    rng = np.random.RandomState(0)
+    batches = [{"x": rng.randint(0, V, (bs, T)).astype(np.int32),
+                "y": rng.randint(0, V, (bs, T)).astype(np.int32)}
+               for _ in range(K * M)]
+    mem = InMemorySink()
+    tr = Trainer(
+        model=TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
+                            ffn_hidden=64, max_len=T, remat="dots"),
+        loss_fn=lambda out, b: costs.softmax_cross_entropy(
+            out.reshape(-1, V), b["y"].reshape(-1)),
+        optimizer=optim.adam(1e-3), steps_per_call=K, grad_accum=M,
+        telemetry=Telemetry(sinks=[mem]))
+    tr.init(jax.random.PRNGKey(0), batches[0])
+    report = tr.attribution_report(batches)
+    named = sorted(k for k, v in report["scope_rollup"].items()
+                   if v > 0 and k != "(unscoped)")
+    agree = report["flops_vs_cost_analysis_pct"]
+    gar = (report.get("comm") or {}).get("grad_allreduce")
+    emitted = len(mem.by_kind("attribution"))
+    ok = (len(named) >= 4
+          and agree is not None and abs(agree) <= 5.0
+          and bool(report["collectives"])
+          and gar is not None
+          and gar.get("exposed_ms_if_overlapped") is not None
+          and emitted == 1)
+    print(json.dumps({
+        "child": "attribution", "ok": bool(ok),
+        "n_devices": int(jax.device_count()),
+        "scopes_nonzero": len(named), "scopes": named[:16],
+        "flops_vs_cost_analysis_pct": agree,
+        "flops_static": report["flops_static"],
+        "cost_analysis_flops": report["cost_analysis_flops"],
+        "collectives": len(report["collectives"]),
+        "grad_allreduce": gar,
+        "exposed_comm_ms": report["comm"]["exposed_ms"],
+        "est_mfu_pct": report["est_mfu_pct"],
+        "emitted_records": emitted,
+        "mfu_gap_top": (report["mfu_gap_rank"][0]["scope"]
+                        if report["mfu_gap_rank"] else None),
+    }))
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# bench regression diff (ISSUE 6 satellite): gate perf on the BENCH
+# trajectory in CI
+# ---------------------------------------------------------------------------
+
+def _bench_rows(doc):
+    """Per-metric {value, unit, mfu_pct} rows from any bench record
+    shape: the full/sidecar format (``all_metrics``), the compact
+    final-line record (``metrics`` rows with v/u/mfu), or the driver's
+    committed BENCH_r*.json wrapper (compact record under ``parsed``)."""
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    rows = {}
+    for m, r in (doc.get("all_metrics") or {}).items():
+        rows[m] = {"value": r.get("value"), "unit": r.get("unit"),
+                   "mfu_pct": r.get("mfu_pct")}
+    if not rows:
+        for m, r in (doc.get("metrics") or {}).items():
+            rows[m] = {"value": r.get("v"), "unit": r.get("u"),
+                       "mfu_pct": r.get("mfu")}
+    if not rows and doc.get("metric"):
+        rows[doc["metric"]] = {"value": doc.get("value"),
+                               "unit": doc.get("unit"),
+                               "mfu_pct": doc.get("mfu_pct")}
+    return rows
+
+
+def compare_bench(old_path, new_path, threshold_pct=5.0):
+    """Per-metric regression diff between two bench JSON records
+    (``bench.py --compare OLD NEW``). Direction comes from the unit
+    (``ms``-denominated metrics: lower is better; rates: higher is
+    better); a metric whose value worsened by more than
+    ``threshold_pct`` lands in ``regressions`` and the CLI exits
+    non-zero, so CI can gate on the BENCH_r* trajectory."""
+    with open(old_path) as f:
+        old = json.load(f)
+    with open(new_path) as f:
+        new = json.load(f)
+    o_rows, n_rows = _bench_rows(old), _bench_rows(new)
+    rows, regressions = {}, []
+    for m in sorted(set(o_rows) | set(n_rows)):
+        o, n = o_rows.get(m), n_rows.get(m)
+        if o is None:
+            rows[m] = {"status": "new", "new": n.get("value")}
+            continue
+        if n is None:
+            rows[m] = {"status": "missing", "old": o.get("value")}
+            regressions.append(m)          # a vanished metric IS a regression
+            continue
+        if not o.get("value") or n.get("value") is None:
+            rows[m] = {"status": "incomparable", "old": o.get("value"),
+                       "new": n.get("value")}
+            continue
+        unit = n.get("unit") or o.get("unit") or ""
+        lower_better = "ms" in unit
+        delta = 100.0 * (n["value"] - o["value"]) / o["value"]
+        worsened = (delta > threshold_pct if lower_better
+                    else delta < -threshold_pct)
+        improved = (delta < -threshold_pct if lower_better
+                    else delta > threshold_pct)
+        rows[m] = {"old": o["value"], "new": n["value"], "unit": unit,
+                   "delta_pct": round(delta, 2),
+                   "direction": "lower-better" if lower_better
+                   else "higher-better",
+                   "status": ("regressed" if worsened
+                              else "improved" if improved else "ok")}
+        if worsened:
+            regressions.append(m)
+    return {"metric": "bench_compare", "threshold_pct": threshold_pct,
+            "old": old_path, "new": new_path, "rows": rows,
+            "regressions": regressions, "ok": not regressions}
 
 
 # ---------------------------------------------------------------------------
@@ -1187,7 +1374,8 @@ DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_fused",
 
 
 _KNOWN_FLAGS = ("--metric", "--child", "--probe", "--n", "--k",
-                "--timed-steps", "--steps-per-call", "--smoke")
+                "--timed-steps", "--steps-per-call", "--smoke",
+                "--attribution-child", "--compare", "--threshold")
 
 
 def main():
@@ -1208,6 +1396,26 @@ def main():
         print(json.dumps({"error": f"unknown flags {unknown}; "
                                    f"known: {list(_KNOWN_FLAGS)}"}))
         sys.exit(2)
+
+    if "--compare" in args:
+        # bench.py --compare OLD.json NEW.json [--threshold PCT]
+        i = args.index("--compare")
+        if len(args) < i + 3 or args[i + 1].startswith("--") \
+                or args[i + 2].startswith("--"):
+            print(json.dumps({"error": "--compare needs OLD.json NEW.json"}))
+            sys.exit(2)
+        try:
+            out = compare_bench(args[i + 1], args[i + 2],
+                                flag("--threshold", 5.0, float))
+        except (OSError, ValueError) as e:
+            print(json.dumps({"metric": "bench_compare",
+                              "error": f"{type(e).__name__}: {e}"}))
+            sys.exit(2)
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
+
+    if flag("--attribution-child", cast=int):
+        sys.exit(run_attribution_child())
 
     if "--smoke" in args or flag("--smoke", cast=int):
         # CPU mode: the gate must be deterministic and CI-runnable — on any

@@ -49,12 +49,29 @@ Reference anchor: the 3.85x-at-4-GPUs table,
 
 import json
 import os
-import re
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ISSUE 6: the collective-parsing machinery this experiment pioneered is
+# now the library's (paddle_tpu/obs/hloprof.py — the same regexes, shape
+# rules, and ring factors, verbatim). tests/test_hloprof.py pins the
+# aggregate's variadic/iota-group/async-start/ring-factor behaviors and
+# its totals against the structured inventory, so the committed
+# SCALING_* numbers cannot drift. Loaded by FILE PATH, not through the
+# paddle_tpu package: hloprof.py is deliberately stdlib-only, and this
+# driver does all jax work in env-controlled subprocesses — importing
+# the package here would eagerly initialize jax in the parent.
+import importlib.util as _ilu  # noqa: E402
+
+_spec = _ilu.spec_from_file_location(
+    "_hloprof", os.path.join(REPO, "paddle_tpu", "obs", "hloprof.py"))
+_hloprof = _ilu.module_from_spec(_spec)
+sys.modules["_hloprof"] = _hloprof      # dataclasses resolve via sys.modules
+_spec.loader.exec_module(_hloprof)
+parse_collectives = _hloprof.parse_collectives
 
 # Public per-chip interconnect specs (cloud.google.com/tpu/docs spec
 # sheets): v5e ICI 1,600 Gbit/s per chip aggregate -> 200 GB/s; one-way
@@ -413,95 +430,6 @@ def _collect_hlo(n_devices: int, workload: str):
     else:
         body = body.split("=====HLO=====", 1)[1]
     return pre_counts, body
-
-
-_DTYPE_BYTES = {"f64": 8, "f32": 4, "s32": 4, "u32": 4, "bf16": 2,
-                "f16": 2, "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8}
-
-# XLA aggregates gradients into VARIADIC collectives whose result is a
-# tuple: `(f32[64]{0}, f32[128,3]{1,0}) all-reduce(...)` — the shape group
-# must accept both single shapes and tuples.
-_SHAPE = r"\w+\[[\d,]*\](?:\{[^}]*\})?"
-_COLL_RE = re.compile(
-    r"((?:" + _SHAPE + r")|\((?:" + _SHAPE + r")(?:,\s*(?:" + _SHAPE +
-    r"))*\))\s+"
-    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
-    r"(-start)?\(")
-
-
-def _shape_bytes(shape_s: str, kind: str = "", is_start: bool = False) -> int:
-    """Total bytes of a shape or tuple-of-shapes string, counting only the
-    RESULT buffers for async '*-start' forms. Per-kind, per XLA's HLO:
-    all-gather-start and collective-permute-start carry
-    ``(operand..., result..., [u32 contexts])`` tuples (count the trailing
-    result half after dropping the dimensionless context scalars);
-    all-reduce/reduce-scatter/all-to-all '-start' shapes are already
-    results-only (count everything). The n=8 sync-HLO cross-check in this
-    experiment guards this assumption against XLA lowering drift."""
-    shapes = list(re.finditer(r"(\w+)\[([\d,]*)\]", shape_s))
-    if is_start:
-        shapes = [m for m in shapes
-                  if not (m.group(1) in ("u32", "s32") and not m.group(2))]
-        if kind in ("all-gather", "collective-permute") \
-                and len(shapes) >= 2 and len(shapes) % 2 == 0:
-            shapes = shapes[len(shapes) // 2:]
-    total = 0
-    for m in shapes:
-        dt, dims = m.group(1), m.group(2)
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES.get(dt, 4)
-    return total
-
-
-def _group_size(op_line: str, default: int) -> int:
-    """Replica-group size of one collective op: the ring factor must use
-    the GROUP the op actually spans (a tp=4 activation all-reduce on a
-    dp x tp mesh rings over 4 devices, not the whole mesh)."""
-    m = re.search(r"replica_groups=\{\{([\d,]+)\}", op_line)
-    if m:                          # explicit form {{0,1,2,3},{4,...}}
-        return len(m.group(1).split(","))
-    m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=", op_line)
-    if m:                          # iota form [groups, group_size]<=[...]
-        return int(m.group(2))
-    return default
-
-
-def parse_collectives(hlo: str, n_devices: int):
-    """Per-device wire bytes by collective kind (ring-algorithm factors
-    over each op's replica group)."""
-    # XLA interleaves /*index=N*/ comments inside big variadic tuples —
-    # strip them or the tuple regex stops at the first comment
-    hlo = re.sub(r"/\*.*?\*/", "", hlo)
-    by_kind = {}
-    for line in hlo.splitlines():
-        m = _COLL_RE.search(line)
-        if not m:
-            continue
-        shape_s, kind = m.group(1), m.group(2)
-        b = _shape_bytes(shape_s, kind=kind, is_start=bool(m.group(3)))
-        g = _group_size(line, n_devices)
-        if g <= 1:                 # degenerate 1-device group moves nothing
-            continue
-        if kind == "all-reduce":
-            wire = 2.0 * b * (g - 1) / g
-        elif kind == "reduce-scatter":
-            wire = 1.0 * b * (g - 1)     # result is the 1/g shard
-        elif kind in ("all-gather", "all-to-all"):
-            wire = 1.0 * b * (g - 1) / g
-        else:                      # collective-permute
-            wire = float(b)
-        e = by_kind.setdefault(kind, {"ops": 0, "buffer_bytes": 0,
-                                      "wire_bytes_per_device": 0.0,
-                                      "group_sizes": []})
-        e["ops"] += 1
-        e["buffer_bytes"] += b
-        e["wire_bytes_per_device"] += wire
-        if g not in e["group_sizes"]:
-            e["group_sizes"].append(g)
-    return by_kind
 
 
 def _row(cfg, n, wire, colls=None, extrapolated_from=None,

@@ -2,7 +2,7 @@
 for the fused hot loop (ISSUE 2) plus structured tracing and the
 anomaly-triggered flight recorder (ISSUE 4).
 
-Five layers:
+The layers:
 
 - :mod:`~paddle_tpu.obs.sinks` — pluggable record consumers (in-memory,
   JSONL file, logging); ``emit`` is thread-safe (stager/fill threads
@@ -24,6 +24,16 @@ Five layers:
   retrace bursts, drain stalls, memory high-water, the NaN sentinel);
   on trigger, a one-shot forensics bundle (telemetry ring + trace tail +
   config/env/mesh snapshot + verdict) lands on disk.
+- :mod:`~paddle_tpu.obs.hloprof` + :mod:`~paddle_tpu.obs.attribution`
+  (ISSUE 6) — the device-side attribution layer: a structured parser
+  over the compiled step's optimized HLO (per-op FLOPs/bytes, named-
+  scope paths, loop trip counts, collective inventory) feeding a
+  per-scope roofline / MFU-gap report with an exposed-vs-overlappable
+  communication estimate, joined with measured ``jax.profiler``
+  device lanes when a capture exists (``Trainer.attribution_report``).
+- :mod:`~paddle_tpu.obs.report` — ``python -m paddle_tpu.obs.report
+  run.jsonl``: run-summary table (throughput, MFU, retraces, overlap,
+  anomalies) from a telemetry JSONL.
 
 Attach with ``Trainer(..., telemetry=Telemetry(sinks=[JsonlSink(path)]),
 tracer=Tracer(), anomaly=AnomalyDetector(out_dir))``. With none attached
@@ -31,7 +41,11 @@ the hot loop is unchanged: same traced step, same dispatch count, same
 donation, zero extra device fetches.
 """
 
+from . import attribution, hloprof
 from .anomaly import ANOMALY_KINDS, AnomalyDetector, Verdict
+from .attribution import build_report, format_report, parse_profile_trace
+from .hloprof import (DCN_BYTES_PER_S, HBM_BANDWIDTH, ICI_BANDWIDTH,
+                      collective_inventory, parse_collectives, parse_module)
 from .health import (HEALTH_KEYS, health_scalars, tree_l2_norm,
                      tree_nonfinite_count)
 from .sinks import InMemorySink, JsonlSink, LoggingSink, Sink
@@ -46,4 +60,8 @@ __all__ = [
     "device_memory_stats",
     "Tracer", "tspan", "jax_profile",
     "AnomalyDetector", "Verdict", "ANOMALY_KINDS",
+    "hloprof", "attribution",
+    "parse_module", "collective_inventory", "parse_collectives",
+    "build_report", "format_report", "parse_profile_trace",
+    "ICI_BANDWIDTH", "DCN_BYTES_PER_S", "HBM_BANDWIDTH",
 ]

@@ -1,0 +1,615 @@
+"""Structured HLO analyzer — the device-side half of the observability
+stack (ISSUE 6 tentpole, part 1).
+
+PR 2 gave the compiled step ONE number (``cost_analysis()`` FLOPs feeding
+``est_mfu_pct``). This module opens the program up: it parses the
+optimized (post-SPMD) HLO text into a computation-aware op inventory so
+the attribution layer (:mod:`.attribution`) can answer *where* the FLOPs,
+bytes, and collective traffic go — per ``jax.named_scope`` region, per
+collective op, forward vs backward.
+
+What the parser extracts, and why each piece exists:
+
+- **Instructions with metadata.** Every HLO op carries
+  ``metadata={op_name="jit(f)/jit(main)/transpose(jvp(block))/attn/dot"}``
+  — the traced path through ``jax.named_scope`` regions, with autodiff
+  transforms wrapped around the outermost scope (``jvp(x)`` = forward,
+  ``transpose(jvp(x))`` = backward). :func:`scope_of` unwraps the
+  transforms and filters the non-scope components (jit frames, while/body
+  machinery, einsum specs, arg path labels), recovering the scope tree
+  PR 2 annotated into the models.
+- **FLOPs for the ops that carry them.** ``dot`` FLOPs are exact from the
+  printed shapes (2 x result elements x contracted elements — the same
+  convention XLA's own HloCostAnalysis uses), ``convolution`` from the
+  kernel size and ``dim_labels``; everything else contributes its buffer
+  traffic but no FLOPs (elementwise is noise next to the MXU work on any
+  real model; ``bench.py --smoke`` gates the total against
+  ``cost_analysis()`` within 5%).
+- **The call graph, with loop trip counts.** ``cost_analysis()`` counts a
+  ``while`` body ONCE — so does the static op inventory (that is what
+  makes the two comparable) — but the fused step *executes* the body K
+  times (steps-per-call scan), each step M times (grad-accum scan), each
+  microbatch L times (remat scan-over-layers). The analyzer recovers each
+  loop's trip count from its condition computation (``compare(iv,
+  constant(K)), direction=LT``) and propagates multipliers through the
+  call graph, so every op carries both its static count and its true
+  per-dispatch execution count.
+- **A structured collective inventory.** Every collective op with kind,
+  dtype, payload bytes (variadic tuples aggregated; async ``-start``
+  forms counted once, results only), replica-group size, scope, and
+  forward/backward direction — generalized from the regex pass that
+  lived in ``experiments/scaling_projection.py``. The legacy
+  :func:`parse_collectives` aggregate (ring-factor wire bytes by kind) is
+  promoted here VERBATIM as the single source of truth; the projection
+  experiment now imports it, and its numbers are pinned unchanged.
+
+Bandwidth tables (``ICI_BANDWIDTH`` / ``DCN_BYTES_PER_S`` /
+``HBM_BANDWIDTH``) follow the ``PEAK_FLOPS`` pattern: public spec-sheet
+numbers keyed by ``device_kind``, used as the cost model for the
+exposed-communication estimate and the per-scope roofline. Provenance:
+ICI one-way bytes/s = aggregate spec Gbit/s / 8 / 2 (half the
+bidirectional aggregate), e.g. v5e 1600 Gbit/s -> 100 GB/s one-way — the
+same constant SCALING_r05 used; HBM from the published GB/s figures. On
+unknown kinds (CPU test meshes) the attribution layer substitutes the
+``DEFAULT_DEVICE`` entry and labels the report ``bandwidth_assumed`` —
+a static what-if for the hardware the step is destined for, never a
+silent guess.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "DTYPE_BYTES", "ICI_BANDWIDTH", "DCN_BYTES_PER_S", "HBM_BANDWIDTH",
+    "DEFAULT_DEVICE", "HloOp", "CollectiveOp", "ModuleAnalysis",
+    "parse_module", "collective_inventory", "parse_collectives",
+    "scope_of", "COLLECTIVE_KINDS",
+]
+
+DTYPE_BYTES = {"f64": 8, "f32": 4, "s32": 4, "u32": 4, "bf16": 2,
+               "f16": 2, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+               "s64": 8, "u64": 8, "c64": 8, "c128": 16,
+               "f8e4m3fn": 1, "f8e5m2": 1}
+
+# One-way per-chip ICI bytes/s (public spec sheets: aggregate Gbit/s / 8
+# / 2). v5e matches the SCALING_r05 constant exactly.
+ICI_BANDWIDTH = {
+    "TPU v4": 150e9,         # 2400 Gbit/s aggregate
+    "TPU v5 lite": 100e9,    # v5e: 1600 Gbit/s aggregate
+    "TPU v5e": 100e9,
+    "TPU v5p": 300e9,        # 4800 Gbit/s aggregate
+    "TPU v6 lite": 224e9,    # v6e (Trillium): 3584 Gbit/s aggregate
+    "TPU v6e": 224e9,
+}
+
+# Per-chip DCN share when 8 chips sit behind one host NIC (the
+# SCALING_r05 constant — inter-slice traffic crosses the data-center
+# network, not ICI).
+DCN_BYTES_PER_S = 25e9 / 8
+
+# HBM bytes/s per chip (public spec sheets) — the roofline's memory axis.
+HBM_BANDWIDTH = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1638e9,   # Trillium: 2x v5e
+    "TPU v6e": 1638e9,
+}
+
+# The what-if device substituted when the local device kind has no table
+# entries (CPU test meshes): the fleet's v5e, labelled `bandwidth_assumed`.
+DEFAULT_DEVICE = "TPU v5e"
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+# ---------------------------------------------------------------------------
+# legacy line-based collective parse — promoted VERBATIM from
+# experiments/scaling_projection.py (single source of truth; the
+# projection numbers are pinned against this exact arithmetic)
+# ---------------------------------------------------------------------------
+
+# XLA aggregates gradients into VARIADIC collectives whose result is a
+# tuple: `(f32[64]{0}, f32[128,3]{1,0}) all-reduce(...)` — the shape group
+# must accept both single shapes and tuples.
+_SHAPE = r"\w+\[[\d,]*\](?:\{[^}]*\})?"
+_COLL_RE = re.compile(
+    r"((?:" + _SHAPE + r")|\((?:" + _SHAPE + r")(?:,\s*(?:" + _SHAPE +
+    r"))*\))\s+"
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+    r"(-start)?\(")
+
+
+def _shape_bytes(shape_s: str, kind: str = "", is_start: bool = False) -> int:
+    """Total bytes of a shape or tuple-of-shapes string, counting only the
+    RESULT buffers for async '*-start' forms. Per-kind, per XLA's HLO:
+    all-gather-start and collective-permute-start carry
+    ``(operand..., result..., [u32 contexts])`` tuples (count the trailing
+    result half after dropping the dimensionless context scalars);
+    all-reduce/reduce-scatter/all-to-all '-start' shapes are already
+    results-only (count everything). The n=8 sync-HLO cross-check in
+    ``experiments/scaling_projection.py`` guards this assumption against
+    XLA lowering drift."""
+    shapes = list(re.finditer(r"(\w+)\[([\d,]*)\]", shape_s))
+    if is_start:
+        shapes = [m for m in shapes
+                  if not (m.group(1) in ("u32", "s32") and not m.group(2))]
+        if kind in ("all-gather", "collective-permute") \
+                and len(shapes) >= 2 and len(shapes) % 2 == 0:
+            shapes = shapes[len(shapes) // 2:]
+    total = 0
+    for m in shapes:
+        dt, dims = m.group(1), m.group(2)
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+def _group_size(op_line: str, default: int) -> int:
+    """Replica-group size of one collective op: the ring factor must use
+    the GROUP the op actually spans (a tp=4 activation all-reduce on a
+    dp x tp mesh rings over 4 devices, not the whole mesh)."""
+    m = re.search(r"replica_groups=\{\{([\d,]+)\}", op_line)
+    if m:                          # explicit form {{0,1,2,3},{4,...}}
+        return len(m.group(1).split(","))
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=", op_line)
+    if m:                          # iota form [groups, group_size]<=[...]
+        return int(m.group(2))
+    return default
+
+
+def _ring_wire_bytes(kind: str, buffer_bytes: float, group: int) -> float:
+    """Per-device wire bytes of one collective under the standard ring
+    algorithm (the factor set SCALING_r05 is built on)."""
+    if kind == "all-reduce":
+        return 2.0 * buffer_bytes * (group - 1) / group
+    if kind == "reduce-scatter":
+        return 1.0 * buffer_bytes * (group - 1)   # result is the 1/g shard
+    if kind in ("all-gather", "all-to-all"):
+        return 1.0 * buffer_bytes * (group - 1) / group
+    return float(buffer_bytes)                    # collective-permute
+
+
+def parse_collectives(hlo: str, n_devices: int):
+    """Per-device wire bytes by collective kind (ring-algorithm factors
+    over each op's replica group). This is the exact aggregate
+    ``experiments/scaling_projection.py`` always computed — kept
+    line-based and byte-for-byte compatible so the committed SCALING_*
+    projections reproduce."""
+    # XLA interleaves /*index=N*/ comments inside big variadic tuples —
+    # strip them or the tuple regex stops at the first comment
+    hlo = re.sub(r"/\*.*?\*/", "", hlo)
+    by_kind: Dict[str, Dict[str, Any]] = {}
+    for line in hlo.splitlines():
+        m = _COLL_RE.search(line)
+        if not m:
+            continue
+        shape_s, kind = m.group(1), m.group(2)
+        b = _shape_bytes(shape_s, kind=kind, is_start=bool(m.group(3)))
+        g = _group_size(line, n_devices)
+        if g <= 1:                 # degenerate 1-device group moves nothing
+            continue
+        wire = _ring_wire_bytes(kind, b, g)
+        e = by_kind.setdefault(kind, {"ops": 0, "buffer_bytes": 0,
+                                      "wire_bytes_per_device": 0.0,
+                                      "group_sizes": []})
+        e["ops"] += 1
+        e["buffer_bytes"] += b
+        e["wire_bytes_per_device"] += wire
+        if g not in e["group_sizes"]:
+            e["group_sizes"].append(g)
+    return by_kind
+
+
+# ---------------------------------------------------------------------------
+# scope extraction from op_name metadata
+# ---------------------------------------------------------------------------
+
+# Transform wrappers jax prints around the outermost scope component:
+# jvp(x) marks the forward pass, transpose(jvp(x)) the backward.
+_WRAP_RE = re.compile(
+    r"^(jvp|transpose|custom_jvp|custom_vjp|vmap|pmap|shard_map|remat|"
+    r"checkpoint|named)\((.*)\)$")
+
+# Path machinery that is NOT a named scope: control-flow frames and the
+# checkpoint/remat markers the layer scan inserts.
+_SKIP_COMPONENTS = {"", "while", "body", "cond", "branch", "scan",
+                    "checkpoint", "remat", "rematted_computation"}
+
+# A scope component as jax.named_scope would have produced it — filters
+# einsum specs ('bqhd,bkhd->bhqk'), arg-path labels ("batches['x']",
+# "opt_state.m[...]"), and primitive suffixes.
+_SCOPE_NAME_RE = re.compile(r"^[A-Za-z_][\w.\-]*$")
+
+
+def scope_of(op_name: str) -> Tuple[Tuple[str, ...], bool]:
+    """``(scope_path, backward)`` of one ``metadata op_name`` string.
+
+    The last path component is the primitive (dropped); ``jit(...)``
+    frames and control-flow machinery are dropped; transform wrappers are
+    unwrapped (``transpose(...)`` anywhere marks the op backward). What
+    survives is the ``jax.named_scope`` nesting, e.g.
+    ``('block_scan', 'attn')``."""
+    comps: List[str] = []
+    backward = False
+    parts = op_name.split("/")
+    for part in parts[:-1]:
+        if part.startswith("jit("):
+            continue
+        inner = part
+        m = _WRAP_RE.match(inner)
+        while m:
+            if m.group(1) == "transpose":
+                backward = True
+            inner = m.group(2)
+            m = _WRAP_RE.match(inner)
+        if inner in _SKIP_COMPONENTS:
+            continue
+        if not _SCOPE_NAME_RE.match(inner):
+            continue
+        comps.append(inner)
+    return tuple(comps), backward
+
+
+# ---------------------------------------------------------------------------
+# computation-aware module parse
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class HloOp:
+    """One parsed HLO instruction."""
+    name: str
+    opcode: str
+    computation: str
+    result_shapes: List[Tuple[str, Tuple[int, ...]]]
+    operand_shapes: List[Tuple[str, Tuple[int, ...]]]
+    operand_names: List[str]
+    attrs: str
+    op_name: str
+    scope: Tuple[str, ...]
+    backward: bool
+    is_root: bool
+    flops: float = 0.0           # one execution of the enclosing computation
+    bytes: float = 0.0           # operand + result buffer bytes
+    multiplier: float = 1.0      # executions per dispatch (loop-aware)
+    fusion_internal: bool = False
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    """One collective in the inventory (structured form)."""
+    kind: str
+    name: str
+    computation: str
+    dtypes: List[str]
+    payload_bytes: int
+    group_size: int
+    variadic: int                # result buffers aggregated into the op
+    is_async: bool               # '-start' form
+    scope: Tuple[str, ...]
+    backward: bool
+    multiplier: float
+    wire_bytes: float            # per execution, ring factors
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["scope"] = "/".join(self.scope) or "(unscoped)"
+        return d
+
+
+@dataclasses.dataclass
+class ModuleAnalysis:
+    """The parsed module: every instruction, grouped by computation, with
+    loop-aware execution multipliers resolved."""
+    ops: List[HloOp]
+    computations: Dict[str, List[HloOp]]
+    entry: str
+    trip_counts: Dict[str, float]        # while-op name -> trips
+    unknown_trip_loops: int = 0
+
+    def flops_static(self) -> float:
+        return float(sum(op.flops for op in self.ops))
+
+    def flops_loop_aware(self) -> float:
+        return float(sum(op.flops * op.multiplier for op in self.ops))
+
+
+_COMP_HEAD_RE = re.compile(
+    r"^(ENTRY\s+)?%?([\w.\-]+)\s*(?:\(.*\))?\s*->\s*.*\{\s*$")
+_INSTR_RE = re.compile(
+    r"^\s*(ROOT\s+)?%?([\w.\-]+)\s*=\s*"
+    r"(\([^=]*?\)|\S+)\s+"       # result type: tuple or single shape
+    r"([\w\-]+)\(")              # opcode
+_SHAPE_FIND_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def _parse_shapes(text: str) -> List[Tuple[str, Tuple[int, ...]]]:
+    out = []
+    for m in _SHAPE_FIND_RE.finditer(text):
+        dims = tuple(int(d) for d in m.group(2).split(",") if d)
+        out.append((m.group(1), dims))
+    return out
+
+
+def _numel(dims: Tuple[int, ...]) -> int:
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+def _shapes_bytes(shapes: List[Tuple[str, Tuple[int, ...]]]) -> float:
+    return float(sum(_numel(dims) * DTYPE_BYTES.get(dt, 4)
+                     for dt, dims in shapes))
+
+
+def _balanced_paren_span(text: str, open_idx: int) -> int:
+    """Index one past the ')' matching the '(' at ``open_idx``."""
+    depth = 0
+    for i in range(open_idx, len(text)):
+        c = text[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(text)
+
+
+def _dot_flops(op: HloOp) -> float:
+    """2 x result elements x contracted elements — XLA's own dot count."""
+    if not op.result_shapes or not op.operand_shapes:
+        return 0.0
+    m = re.search(r"lhs_contracting_dims=\{([\d,]*)\}", op.attrs)
+    lhs = op.operand_shapes[0][1]
+    contracted = 1
+    if m:
+        for d in m.group(1).split(","):
+            if d and int(d) < len(lhs):
+                contracted *= lhs[int(d)]
+    return 2.0 * _numel(op.result_shapes[0][1]) * contracted
+
+
+def _conv_flops(op: HloOp) -> float:
+    """2 x result elements x (kernel elements / output features): per
+    output element the kernel's spatial x input-feature window multiplies
+    in once (grouped convs fall out — the kernel already holds
+    in-features-per-group)."""
+    if len(op.operand_shapes) < 2 or not op.result_shapes:
+        return 0.0
+    rhs_dt, rhs = op.operand_shapes[1]
+    m = re.search(r"dim_labels=[\w?]+_([\w?]+)->", op.attrs)
+    out_features = 1
+    if m and "o" in m.group(1):
+        o_idx = m.group(1).index("o")
+        if o_idx < len(rhs):
+            out_features = rhs[o_idx]
+    return (2.0 * _numel(op.result_shapes[0][1]) * _numel(rhs)
+            / max(out_features, 1))
+
+
+# Opcodes XLA's HloCostAnalysis charges 1 flop per OUTPUT element
+# (calibrated against the CPU backend: add/mul/div/select/compare/convert
+# each count; exp/tanh/rsqrt land in the separate `transcendentals`
+# counter and are deliberately NOT flops here either).
+ELEMENTWISE_FLOP_OPS = frozenset((
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "select", "compare", "convert", "negate", "abs", "sign", "floor",
+    "ceil", "round-nearest-afz", "round-nearest-even", "power",
+    "remainder", "clamp", "and", "or", "xor", "not", "shift-left",
+    "shift-right-arithmetic", "shift-right-logical", "is-finite",
+    "atan2"))
+
+# Pure data movement / control flow: no flops, and their buffer bytes are
+# plumbing (a while op's operand tuple is the whole carried state), so
+# they are excluded from the roofline's memory-traffic proxy too.
+PLUMBING_OPS = frozenset((
+    "while", "conditional", "call", "tuple", "get-tuple-element",
+    "parameter", "constant", "iota", "after-all", "bitcast",
+    "partition-id", "replica-id", "get-dimension-size", "opt-barrier",
+    "domain"))
+
+
+def _elementwise_flops(op: "HloOp") -> float:
+    if not op.result_shapes:
+        return 0.0
+    return float(_numel(op.result_shapes[0][1]))
+
+
+def _reduce_flops(op: "HloOp") -> float:
+    """~(input - output) elements per reduction body op — approximated as
+    the non-scalar operand elements (the init scalars contribute ~0)."""
+    return float(sum(_numel(dims) for _, dims in op.operand_shapes if dims))
+
+
+_CALL_ATTR_RES = (
+    ("body", re.compile(r"body=%?([\w.\-]+)")),
+    ("condition", re.compile(r"condition=%?([\w.\-]+)")),
+    ("calls", re.compile(r"calls=%?([\w.\-]+)")),
+    ("to_apply", re.compile(r"to_apply=%?([\w.\-]+)")),
+)
+_BRANCH_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+
+
+def _op_calls(op: HloOp) -> List[Tuple[str, str]]:
+    """(kind, computation) references this op makes."""
+    refs = []
+    for kind, rx in _CALL_ATTR_RES:
+        m = rx.search(op.attrs)
+        if m:
+            refs.append((kind, m.group(1)))
+    m = _BRANCH_RE.search(op.attrs)
+    if m:
+        for name in re.findall(r"%?([\w.\-]+)", m.group(1)):
+            refs.append(("branch", name))
+    return refs
+
+
+def parse_module(hlo: str) -> ModuleAnalysis:
+    """Parse optimized HLO text into a computation-aware op inventory with
+    loop-aware execution multipliers (see the module docstring)."""
+    hlo = re.sub(r"/\*.*?\*/", "", hlo)
+    computations: Dict[str, List[HloOp]] = {}
+    order: List[str] = []
+    entry = None
+    current: Optional[str] = None
+    constants: Dict[str, int] = {}    # %name -> scalar int value (s32/u32)
+
+    for line in hlo.splitlines():
+        head = _COMP_HEAD_RE.match(line)
+        if head and not line.startswith(" "):
+            current = head.group(2)
+            computations[current] = []
+            order.append(current)
+            if head.group(1):
+                entry = current
+            continue
+        if line.strip() == "}":
+            current = None
+            continue
+        if current is None:
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        is_root, name, rtype, opcode = (bool(m.group(1)), m.group(2),
+                                        m.group(3), m.group(4))
+        open_idx = m.end() - 1
+        close = _balanced_paren_span(line, open_idx)
+        operands = line[open_idx + 1:close - 1]
+        attrs = line[close:]
+        meta = re.search(r'op_name="([^"]*)"', attrs)
+        op_name = meta.group(1) if meta else ""
+        scope, backward = scope_of(op_name)
+        op = HloOp(
+            name=name, opcode=opcode, computation=current,
+            result_shapes=_parse_shapes(rtype),
+            operand_shapes=_parse_shapes(operands),
+            operand_names=re.findall(r"%([\w.\-]+)", operands),
+            attrs=attrs, op_name=op_name, scope=scope, backward=backward,
+            is_root=is_root)
+        if opcode == "constant":
+            cm = re.match(r"\s*(-?\d+)\s*$", operands)
+            if cm and op.result_shapes and not op.result_shapes[0][1]:
+                constants[name] = int(cm.group(1))
+        if opcode == "dot":
+            op.flops = _dot_flops(op)
+        elif opcode == "convolution":
+            op.flops = _conv_flops(op)
+        elif opcode in ELEMENTWISE_FLOP_OPS:
+            op.flops = _elementwise_flops(op)
+        elif opcode in ("reduce", "reduce-window"):
+            op.flops = _reduce_flops(op)
+        if opcode not in PLUMBING_OPS:
+            op.bytes = (_shapes_bytes(op.operand_shapes)
+                        + _shapes_bytes(op.result_shapes))
+        computations[current].append(op)
+
+    if entry is None and order:
+        entry = order[-1]
+
+    # -- trip counts: while condition = compare(iv, constant, LT) ----------
+    analysis = ModuleAnalysis(ops=[], computations=computations,
+                              entry=entry or "", trip_counts={})
+
+    def trip_of(cond_comp: str) -> Optional[float]:
+        for op in computations.get(cond_comp, []):
+            if op.is_root and op.opcode == "compare":
+                direction = re.search(r"direction=(\w+)", op.attrs)
+                names = op.operand_names
+                if direction and names:
+                    if direction.group(1) == "LT" and names[-1] in constants:
+                        return float(constants[names[-1]])
+                    if direction.group(1) == "GT" and names[0] in constants:
+                        return float(constants[names[0]])
+        return None
+
+    # -- propagate execution multipliers through the call DAG --------------
+    comp_mult: Dict[str, float] = {}
+    fusion_targets: set = set()
+
+    def walk(comp: str, mult: float) -> None:
+        comp_mult[comp] = comp_mult.get(comp, 0.0) + mult
+        for op in computations.get(comp, []):
+            for kind, target in _op_calls(op):
+                if target not in computations:
+                    continue
+                factor = 1.0
+                if kind in ("body", "condition"):
+                    cond = None
+                    for k, t in _op_calls(op):
+                        if k == "condition":
+                            cond = t
+                    trips = trip_of(cond) if cond else None
+                    if trips is None:
+                        if kind == "body":      # count each loop once
+                            analysis.unknown_trip_loops += 1
+                        trips = 1.0
+                    else:
+                        analysis.trip_counts[op.name] = trips
+                    factor = trips
+                elif kind in ("calls", "to_apply"):
+                    fusion_targets.add(target)
+                walk(target, mult * factor)
+
+    if entry:
+        walk(entry, 1.0)
+
+    ops: List[HloOp] = []
+    for comp, comp_ops in computations.items():
+        mult = comp_mult.get(comp, 1.0)
+        internal = comp in fusion_targets
+        for op in comp_ops:
+            op.multiplier = mult
+            op.fusion_internal = internal
+            ops.append(op)
+    analysis.ops = ops
+    return analysis
+
+
+def collective_inventory(analysis: ModuleAnalysis,
+                         default_group: int = 1) -> List[CollectiveOp]:
+    """Structured per-op collective inventory from a parsed module:
+    kind, dtype(s), payload bytes (variadic-aggregated; '-start' forms
+    results-only), replica-group size, named-scope attribution,
+    forward/backward direction, and the loop-aware execution multiplier.
+    Degenerate 1-device groups are dropped (they move nothing), matching
+    :func:`parse_collectives`."""
+    out = []
+    for op in analysis.ops:
+        base = op.opcode[:-6] if op.opcode.endswith("-start") else op.opcode
+        if base not in COLLECTIVE_KINDS:
+            continue
+        is_async = op.opcode.endswith("-start")
+        # reconstruct the result-type string for the shared payload rule
+        rtype = ", ".join(
+            f"{dt}[{','.join(str(d) for d in dims)}]"
+            for dt, dims in op.result_shapes)
+        payload = _shape_bytes(rtype, kind=base, is_start=is_async)
+        group = _group_size(op.attrs, default_group)
+        if group <= 1:
+            continue
+        shapes = op.result_shapes
+        if is_async:
+            # mirror _shape_bytes' result-half selection for dtype listing
+            shapes = [s for s in shapes
+                      if not (s[0] in ("u32", "s32") and not s[1])]
+            if base in ("all-gather", "collective-permute") \
+                    and len(shapes) >= 2 and len(shapes) % 2 == 0:
+                shapes = shapes[len(shapes) // 2:]
+        out.append(CollectiveOp(
+            kind=base, name=op.name, computation=op.computation,
+            dtypes=sorted({dt for dt, _ in shapes}),
+            payload_bytes=int(payload), group_size=group,
+            variadic=len(shapes), is_async=is_async, scope=op.scope,
+            backward=op.backward, multiplier=op.multiplier,
+            wire_bytes=_ring_wire_bytes(base, payload, group)))
+    return out

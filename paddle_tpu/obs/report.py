@@ -1,0 +1,213 @@
+"""Run-summary CLI over a telemetry JSONL (ISSUE 6 satellite).
+
+``python -m paddle_tpu.obs.report run.jsonl`` prints one table a human
+can read off a finished (or crashed) run's telemetry file: throughput,
+MFU, compiles/retraces, pipeline overlap, anomalies, and the step-time
+breakdown — the ``printAllStatus`` successor for files instead of
+processes.
+
+The PR-4 final ``summary`` record (``Telemetry.close()``) is the
+preferred source when present — it already aggregates the run the way
+``Telemetry.summary()`` does (honest pipelined rates from record
+timestamps, profiled records excluded). Without one (a crashed run that
+never closed), the CLI falls back to aggregating the step records
+directly, so a truncated JSONL still reports. ``kind="anomaly"`` records
+(the Trainer echoes every detector verdict into the stream) and
+NaN-sanitized losses feed the anomalies row.
+
+``--json`` prints the summary dict instead of the table (machine
+consumers); a rotated ``<path>.1`` sibling (``JsonlSink(max_bytes=...)``)
+is read first automatically so the window spans both files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+__all__ = ["load_records", "summarize", "format_summary", "main"]
+
+
+def load_records(path: str, include_rotated: bool = True
+                 ) -> List[Dict[str, Any]]:
+    """Parse a telemetry JSONL back into record dicts; a rotated
+    ``<path>.1`` sibling is prepended when present (oldest first).
+    Truncated trailing lines (a crash mid-write is the use case) are
+    skipped, not fatal."""
+    paths = []
+    if include_rotated and os.path.exists(path + ".1"):
+        paths.append(path + ".1")
+    paths.append(path)
+    out: List[Dict[str, Any]] = []
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return out
+
+
+def _mean(vals: List[float]) -> Optional[float]:
+    vals = [v for v in vals if v is not None]
+    return round(sum(vals) / len(vals), 4) if vals else None
+
+
+def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate a record stream into the run-summary dict the table
+    renders. Prefers the final ``summary`` record; derives everything it
+    can from the step records otherwise."""
+    steps = [r for r in records if r.get("kind") == "step"]
+    compiles = [r for r in records if r.get("kind") == "compile"]
+    anomalies = [r for r in records if r.get("kind") == "anomaly"]
+    attributions = [r for r in records if r.get("kind") == "attribution"]
+    summary_rec = next((r for r in reversed(records)
+                        if r.get("kind") == "summary"), None)
+
+    out: Dict[str, Any] = {
+        "records": len(records),
+        "steps": len(steps),
+        "optimizer_steps": sum(int(r.get("k_steps") or 1) for r in steps),
+        "compiles": len(compiles),
+        "compile_wall_s": round(sum(r.get("wall_s") or 0.0
+                                    for r in compiles), 3),
+        "anomalies": len(anomalies),
+        # the Trainer echoes each Verdict with its trigger kind renamed
+        # to anomaly_kind (the record "kind" slot holds "anomaly")
+        "anomaly_kinds": sorted({a.get("anomaly_kind") or "?"
+                                 for a in anomalies}),
+        "attribution_reports": len(attributions),
+        "from_summary_record": summary_rec is not None,
+    }
+
+    nan_steps = sum(1 for r in steps
+                    if (r.get("nonfinite_count") or 0) > 0
+                    or ("loss" in r and r.get("loss") is None))
+    out["nonfinite_steps"] = nan_steps
+
+    if steps:
+        last = steps[-1]
+        out["last_step"] = last.get("step")
+        out["last_loss"] = last.get("loss")
+        out["retraces"] = last.get("retrace_count")
+        span = steps[-1].get("ts", 0) - steps[0].get("ts", 0)
+        done = sum(int(r.get("k_steps") or 1) for r in steps[1:])
+        if span > 0 and done:
+            out["steps_per_sec"] = round(done / span, 3)
+        out["est_mfu_pct"] = next(
+            (r.get("est_mfu_pct") for r in reversed(steps)
+             if r.get("est_mfu_pct") is not None), None)
+        out["tokens_per_sec"] = next(
+            (r.get("tokens_per_sec") for r in reversed(steps)
+             if r.get("tokens_per_sec") is not None), None)
+        for key in ("host_stack_ms", "shard_ms", "dispatch_ms", "device_ms",
+                    "replay_ms", "stage_ms", "drain_wait_ms",
+                    "overlap_frac"):
+            m = _mean([r.get(key) for r in steps if not r.get("profiled")])
+            if m is not None:
+                out[f"mean_{key}"] = m
+        out["peak_bytes"] = max((r.get("peak_bytes") or 0 for r in steps),
+                                default=0) or None
+
+    if summary_rec is not None:
+        # the close-time aggregate wins where it exists (it excludes
+        # profiled records and derives pipelined rates honestly)
+        for key, val in summary_rec.items():
+            if key in ("kind", "ts"):
+                continue
+            if val is not None:
+                out[key] = val
+    if attributions:
+        att = attributions[-1]
+        out["attribution_est_mfu_pct"] = att.get("est_mfu_pct")
+        comm = att.get("comm") or {}
+        out["attribution_exposed_comm_ms"] = comm.get("exposed_ms")
+    return out
+
+
+_ROWS = (
+    ("records", "records"),
+    ("steps (records / optimizer)", None),        # composite
+    ("steps/sec", "steps_per_sec"),
+    ("pipelined steps/sec", "pipelined_steps_per_sec"),
+    ("tokens/sec", "tokens_per_sec"),
+    ("est MFU %", "est_mfu_pct"),
+    ("static-attribution MFU %", "attribution_est_mfu_pct"),
+    ("exposed comm ms (static)", "attribution_exposed_comm_ms"),
+    ("compiles / retraces", None),                # composite
+    ("compile wall s", "compile_wall_s"),
+    ("mean dispatch ms", "mean_dispatch_ms"),
+    ("mean device ms", "mean_device_ms"),
+    ("mean stage ms", "mean_stage_ms"),
+    ("mean drain wait ms", "mean_drain_wait_ms"),
+    ("mean overlap frac", "mean_overlap_frac"),
+    ("peak device bytes", "peak_bytes"),
+    ("last step / loss", None),                   # composite
+    ("nonfinite steps", "nonfinite_steps"),
+    ("anomalies", None),                          # composite
+    ("stager leaked", "stager_leaked"),
+)
+
+
+def format_summary(s: Dict[str, Any]) -> str:
+    """Fixed-width table of one run summary."""
+    lines = ["telemetry run summary"
+             + ("  (from final summary record)"
+                if s.get("from_summary_record") else "  (no summary record"
+                " — aggregated from step records)")]
+    for label, key in _ROWS:
+        if key is None:
+            if label.startswith("steps "):
+                val = f"{s.get('steps')} / {s.get('optimizer_steps')}"
+            elif label.startswith("compiles"):
+                val = f"{s.get('compiles')} / {s.get('retraces', 0)}"
+            elif label.startswith("last step"):
+                if s.get("last_step") is None:
+                    continue
+                val = f"{s.get('last_step')} / {s.get('last_loss')}"
+            else:
+                n = s.get("anomalies", 0)
+                if not n:
+                    val = "0"
+                else:
+                    val = f"{n} ({', '.join(s.get('anomaly_kinds', []))})"
+        else:
+            val = s.get(key)
+            if val is None:
+                continue
+        lines.append(f"  {label:<28}{val}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.obs.report",
+        description="Run-summary table from a telemetry JSONL "
+                    "(throughput, MFU, retraces, overlap, anomalies).")
+    p.add_argument("jsonl", help="telemetry JSONL path (a rotated "
+                                 "<path>.1 sibling is read automatically)")
+    p.add_argument("--json", action="store_true",
+                   help="print the summary dict as JSON instead")
+    args = p.parse_args(argv)
+    try:
+        records = load_records(args.jsonl)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print("error: no records parsed", file=sys.stderr)
+        return 2
+    s = summarize(records)
+    print(json.dumps(s) if args.json else format_summary(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

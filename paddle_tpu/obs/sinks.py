@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -60,19 +61,45 @@ class JsonlSink(Sink):
     """One JSON object per line, flushed per emit (a record is never half
     on disk after a crash — the CRC'd-checkpoint philosophy applied to
     telemetry). The file opens lazily on first emit so constructing a
-    Telemetry never touches the filesystem."""
+    Telemetry never touches the filesystem.
 
-    def __init__(self, path: str):
+    ``max_bytes`` (ISSUE 6 satellite) bounds the file: when the next
+    record would push past it, the current file rotates to ``<path>.1``
+    (replacing any previous ``.1``) and writing continues fresh — a
+    week-long run keeps at most ~2x ``max_bytes`` of telemetry on disk
+    instead of growing unboundedly, and the most recent window (the one
+    a postmortem reads) is always intact. Rotation happens BEFORE the
+    write, so no emitted record is ever split across files; a single
+    record larger than ``max_bytes`` still lands whole. Default None:
+    unbounded, the pre-rotation behavior."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        self.max_bytes = max_bytes
+        self.rotations = 0
         self._f = None
+        self._written = 0
         self._lock = threading.Lock()
 
     def emit(self, record: Dict[str, Any]) -> None:
         with self._lock:
             if self._f is None:
                 self._f = open(self.path, "a")
-            self._f.write(json.dumps(record) + "\n")
+                try:                               # append-mode resume
+                    self._written = os.path.getsize(self.path)
+                except OSError:
+                    self._written = 0
+            line = json.dumps(record) + "\n"
+            if (self.max_bytes is not None and self._written > 0
+                    and self._written + len(line) > self.max_bytes):
+                self._f.close()
+                os.replace(self.path, self.path + ".1")
+                self.rotations += 1
+                self._f = open(self.path, "a")
+                self._written = 0
+            self._f.write(line)
             self._f.flush()
+            self._written += len(line)
 
     def close(self) -> None:
         with self._lock:

@@ -324,6 +324,17 @@ class Telemetry:
         self._emit(rec)
         return rec
 
+    def emit_event(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        """Emit one non-step record to every sink — the carrier for
+        ``kind="attribution"`` reports (``Trainer.attribution_report``)
+        and ``kind="anomaly"`` verdicts, so a run's JSONL holds the whole
+        story (``obs.report`` reads these back). Stamps ``ts`` and a
+        ``kind`` (default ``"event"``) when absent."""
+        rec = {"kind": record.get("kind", "event"), "ts": time.time()}
+        rec.update(record)
+        self._emit(rec)
+        return rec
+
     def update_health(self, health_host: Dict[str, Any]) -> Dict[str, float]:
         """Record the latest fetched health scalars (host values for ONE
         optimizer step). Returns the JSON-safe dict it stored."""

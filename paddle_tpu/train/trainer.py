@@ -252,13 +252,25 @@ class Trainer:
     def _anomaly_observe(self, rec) -> None:
         """Feed one finalized telemetry record to the anomaly detector.
         Detection is observation: a detector crash must never kill the
-        run it watches, so failures log and training continues."""
+        run it watches, so failures log and training continues. Verdicts
+        are echoed into the telemetry stream as ``kind="anomaly"``
+        records (ISSUE 6: the run's JSONL is self-contained — the report
+        CLI counts anomalies without reading bundle directories)."""
         if self.anomaly is None or rec is None:
             return
         try:
-            self.anomaly.observe(rec)
+            verdicts = self.anomaly.observe(rec)
         except Exception:
             _log.exception("anomaly detector failed (training continues)")
+            return
+        if verdicts and self.telemetry is not None:
+            for v in verdicts:
+                try:
+                    vd = v.to_dict()
+                    vd["anomaly_kind"] = vd.pop("kind")
+                    self.telemetry.emit_event({"kind": "anomaly", **vd})
+                except Exception:
+                    _log.exception("anomaly telemetry emit failed")
 
     def _maybe_profiled_call(self, fn, *args):
         """Run ONE compiled dispatch, wrapped in an anomaly-armed
@@ -1404,6 +1416,97 @@ class Trainer:
                     self.evaluator.update(jax.device_get(stats))
         metrics = self.evaluator.result() if self.evaluator is not None else {}
         return float(np.mean(costs)) if costs else 0.0, metrics
+
+    # -- device-side attribution (ISSUE 6) -----------------------------------
+
+    def attribution_report(self, sample_batches, rng: Optional[Any] = None,
+                           profile_dir: Optional[str] = None,
+                           emit: bool = True) -> Dict[str, Any]:
+        """MFU-gap attribution of the compiled train step: parse the
+        optimized (post-SPMD) HLO into per-``jax.named_scope`` FLOPs/bytes
+        rooflines, a structured collective inventory, and an
+        exposed-vs-overlappable communication estimate
+        (:mod:`paddle_tpu.obs.hloprof` / :mod:`~paddle_tpu.obs.attribution`).
+
+        PULL-BASED, OFF THE HOT LOOP: nothing here runs unless this method
+        is called — a Trainer that never calls it is byte-identical to the
+        pre-attribution build (same traced step, dispatch count, donation,
+        zero fences; pinned by tests/test_hloprof.py in the PR-2/4 style).
+        The report costs one AOT ``lower().compile()`` of the step (the
+        live jit executable's text is not exposed) and zero executions:
+        ``train_state`` and the host step mirror are untouched.
+
+        Args:
+          sample_batches: host batches fixing the step's input shapes —
+            a list of ``steps_per_call * grad_accum`` batches in fused
+            mode (``compile_fused``'s contract), one batch (or a
+            one-element list) in plain mode.
+          rng: PRNGKey for the lowering (default PRNGKey(0)).
+          profile_dir: a ``Tracer.profile_window()`` / ``jax.profiler``
+            capture directory — when it holds a device-lane Chrome trace
+            the MEASURED compute-vs-communication split joins the static
+            report under ``report["measured"]`` (absent on CPU captures:
+            static-only, degrading gracefully).
+          emit: emit the report as a ``kind="attribution"`` telemetry
+            record to every sink (no-op with ``telemetry=None``).
+        """
+        assert self.train_state is not None, "call init() first"
+        from ..obs import attribution as attr_lib
+        from ..obs import hloprof
+        from ..obs.telemetry import lowered_hlo_flops
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        fused = self.steps_per_call > 1 or self.grad_accum > 1
+        ts = self.train_state
+        if fused:
+            if not isinstance(sample_batches, (list, tuple)):
+                raise ValueError(
+                    "fused attribution needs steps_per_call*grad_accum "
+                    "host batches (compile_fused's contract)")
+            K, M = self.steps_per_call, self.grad_accum
+            if len(sample_batches) != K * M:
+                raise ValueError(
+                    f"attribution_report needs {K * M} host batches for "
+                    f"K={K}, M={M}; got {len(sample_batches)}")
+            stacked = self._stack_group(list(sample_batches), K, M)
+            if self._fused_step is None:
+                self._build_fused_step(stacked)
+            batch = self._shard_fused(stacked)
+            step_fn = self._fused_step
+        else:
+            one = (sample_batches[0]
+                   if isinstance(sample_batches, (list, tuple))
+                   else sample_batches)
+            batch = self._shard(one)
+            if self._train_step is None:
+                self._build_train_step()
+            step_fn = self._train_step
+        args = (ts.params, ts.state, ts.opt_state, ts.step, batch, rng)
+        lowered = step_fn.lower(*args)
+        compiled = lowered.compile()
+        # the agreement check must compare against the SAME optimized
+        # module we parse (lowered_hlo_flops accepts anything with
+        # cost_analysis(): here the Compiled, not the Lowered)
+        cost_flops = lowered_hlo_flops(compiled)
+        analysis = hloprof.parse_module(compiled.as_text())
+        mesh = self.mesh
+        report = attr_lib.build_report(
+            analysis,
+            device_kind=getattr(jax.devices()[0], "device_kind", ""),
+            n_devices=int(mesh.devices.size),
+            cost_analysis_flops=cost_flops,
+            meta={
+                "mesh_axes": dict(zip(mesh.axis_names, mesh.devices.shape)),
+                "steps_per_call": self.steps_per_call,
+                "grad_accum": self.grad_accum,
+                "fused": fused,
+            })
+        if profile_dir is not None:
+            measured = attr_lib.parse_profile_trace(profile_dir)
+            if measured is not None:
+                report["measured"] = measured
+        if emit and self.telemetry is not None:
+            self.telemetry.emit_event(report)
+        return report
 
     # -- checkpoint ----------------------------------------------------------
 

@@ -10,6 +10,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -54,6 +55,90 @@ def test_bench_smoke_cpu_green_and_equal():
     assert trace["ts_monotonic"] is True and trace["ts_valid"] is True
     assert trace["stage_concurrent_with_main"] is True
     assert trace["losses_equal_with_tracer"] is True
+    # ISSUE 6: the attribution gate ran on a simulated dp mesh — >= 4
+    # named scopes with nonzero FLOPs, parsed total within 5% of
+    # cost_analysis(), a collective inventory, and an exposed-
+    # communication estimate for the grad all-reduce
+    attr = out["attribution"]
+    assert attr["ok"] is True, attr
+    assert attr["n_devices"] == 2
+    assert attr["scopes_nonzero"] >= 4
+    assert abs(attr["flops_vs_cost_analysis_pct"]) <= 5.0
+    assert attr["collectives"] >= 1
+    gar = attr["grad_allreduce"]
+    assert gar["ops"] >= 1 and gar["wire_bytes_per_device"] > 0
+    assert gar["exposed_ms_if_overlapped"] is not None
+    assert attr["emitted_records"] == 1
+
+
+def _write_bench(tmp_path, name, metrics):
+    """A minimal compact-format bench record file."""
+    doc = {"metric": "x", "metrics": metrics}
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_bench_compare_detects_regressions(tmp_path):
+    """ISSUE 6 satellite: --compare diffs two bench records per metric
+    with unit-aware direction and a configurable threshold; regressions
+    exit non-zero so CI can gate on the BENCH trajectory."""
+    sys.path.insert(0, REPO)
+    import bench
+    old = _write_bench(tmp_path, "old.json", {
+        "throughput": {"v": 1000.0, "u": "images/sec"},
+        "latency": {"v": 100.0, "u": "ms/batch"},
+        "steady": {"v": 50.0, "u": "tokens/sec"},
+        "gone": {"v": 1.0, "u": "steps/sec"},
+    })
+    new = _write_bench(tmp_path, "new.json", {
+        "throughput": {"v": 900.0, "u": "images/sec"},   # -10%: regression
+        "latency": {"v": 90.0, "u": "ms/batch"},         # lower ms: improved
+        "steady": {"v": 51.0, "u": "tokens/sec"},        # +2%: ok
+        "fresh": {"v": 2.0, "u": "steps/sec"},           # new metric
+    })
+    out = bench.compare_bench(old, new, threshold_pct=5.0)
+    rows = out["rows"]
+    assert rows["throughput"]["status"] == "regressed"
+    assert rows["latency"]["status"] == "improved"
+    assert rows["latency"]["direction"] == "lower-better"
+    assert rows["steady"]["status"] == "ok"
+    assert rows["fresh"]["status"] == "new"
+    assert rows["gone"]["status"] == "missing"
+    assert sorted(out["regressions"]) == ["gone", "throughput"]
+    assert out["ok"] is False
+    # a ms-metric that RISES past threshold regresses
+    out2 = bench.compare_bench(new, old, threshold_pct=5.0)
+    assert out2["rows"]["latency"]["status"] == "regressed"
+    # threshold is configurable: 15% tolerates the -10%
+    out3 = bench.compare_bench(old, new, threshold_pct=15.0)
+    assert "throughput" not in out3["regressions"]
+
+
+def test_bench_compare_cli_exit_codes(tmp_path, capsys, monkeypatch):
+    """The --compare entry point exits 1 on regression, 0 when clean
+    (in-process through bench.main — the dispatch runs before any jax
+    work, so no subprocess is needed)."""
+    sys.path.insert(0, REPO)
+    import bench
+    old = _write_bench(tmp_path, "o.json",
+                       {"m": {"v": 100.0, "u": "tokens/sec"}})
+    bad = _write_bench(tmp_path, "b.json",
+                       {"m": {"v": 10.0, "u": "tokens/sec"}})
+    same = _write_bench(tmp_path, "s.json",
+                        {"m": {"v": 101.0, "u": "tokens/sec"}})
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--compare", old, bad])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 1
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["regressions"] == ["m"]
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--compare", old, same,
+                         "--threshold", "5"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 0
 
 
 def test_bench_prep_transformer_fused_builds():

@@ -10,6 +10,7 @@ tests/test_trace.py, including the tracing-off zero-overhead pin)."""
 
 import json
 import logging
+import os
 
 import numpy as np
 import jax
@@ -107,6 +108,116 @@ def test_sink_emit_thread_safe(tmp_path):
     for tid in range(n_threads):
         assert [r["i"] for r in lines if r["tid"] == tid] == \
             list(range(per_thread))
+
+
+def test_jsonl_sink_rotation_bounds_the_file(tmp_path):
+    """ISSUE 6 satellite: JsonlSink(max_bytes=) rotates to <path>.1 and
+    keeps writing — no record lost, no record split, both files bounded,
+    and the telemetry JSONL of a long run stops growing unboundedly."""
+    path = str(tmp_path / "tel.jsonl")
+    rec = {"kind": "step", "i": 0, "pad": "x" * 80}
+    line_len = len(json.dumps(rec)) + 1
+    sink = JsonlSink(path, max_bytes=4 * line_len)
+    n = 11
+    for i in range(n):
+        sink.emit({**rec, "i": i})
+    sink.close()
+    assert sink.rotations >= 1
+    assert os.path.exists(path + ".1")
+    main = JsonlSink.read(path)
+    rotated = JsonlSink.read(path + ".1")
+    # the retained window is the most recent records, contiguous across
+    # .1 -> live with no record split, duplicated, or reordered (older
+    # rotations are dropped by design — that IS the disk bound)
+    window = [r["i"] for r in rotated + main]
+    assert window == list(range(n - len(window), n))
+    assert len(rotated) >= 1 and main[-1]["i"] == n - 1
+    assert os.path.getsize(path) <= 4 * line_len
+    assert os.path.getsize(path + ".1") <= 4 * line_len
+    # a second sink on the same path resumes the byte count (append mode)
+    sink2 = JsonlSink(path, max_bytes=4 * line_len)
+    for i in range(n, n + 6):
+        sink2.emit({**rec, "i": i})
+    sink2.close()
+    assert os.path.getsize(path) <= 4 * line_len
+    assert JsonlSink.read(path)[-1]["i"] == n + 5
+
+
+def test_jsonl_sink_oversized_record_still_lands(tmp_path):
+    path = str(tmp_path / "big.jsonl")
+    sink = JsonlSink(path, max_bytes=16)
+    sink.emit({"kind": "step", "pad": "y" * 100})   # one line > max_bytes
+    sink.close()
+    assert len(JsonlSink.read(path)) == 1
+
+
+def test_report_cli_summarizes_run(tmp_path):
+    """ISSUE 6 satellite: `python -m paddle_tpu.obs.report run.jsonl`
+    prints throughput / MFU / retraces / overlap / anomalies, preferring
+    the final summary record, and --json round-trips."""
+    from paddle_tpu.obs import report as report_cli
+    path = str(tmp_path / "run.jsonl")
+    tel = Telemetry(sinks=[JsonlSink(path)], tokens_per_step=128,
+                    flops_per_step=1e9, peak_flops=1e12)
+    run_fused(make_trainer(telemetry=tel), make_batches(2 * 2 * 2))
+    # anomaly + attribution records ride the same stream
+    tel.emit_event({"kind": "anomaly", "anomaly_kind": "slow_step",
+                    "step": 3, "detail": "test"})
+    tel.close()
+    records = report_cli.load_records(path)
+    s = report_cli.summarize(records)
+    assert s["from_summary_record"] is True
+    assert s["steps"] > 0 and s["optimizer_steps"] >= s["steps"]
+    assert s["compiles"] >= 1
+    assert s["anomalies"] == 1 and s["anomaly_kinds"] == ["slow_step"]
+    assert s["est_mfu_pct"] is not None
+    assert s["mean_dispatch_ms"] is not None
+    table = report_cli.format_summary(s)
+    assert "est MFU" in table and "anomalies" in table
+    # CLI entry: table and --json modes both exit 0
+    assert report_cli.main([path]) == 0
+    assert report_cli.main([path, "--json"]) == 0
+    assert report_cli.main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_report_cli_without_summary_record(tmp_path):
+    """A crashed run (no close, no summary record) still reports from
+    the step records."""
+    from paddle_tpu.obs import report as report_cli
+    path = str(tmp_path / "crash.jsonl")
+    tel = Telemetry(sinks=[JsonlSink(path)])
+    run_fused(make_trainer(telemetry=tel), make_batches(2 * 2 * 2))
+    for s in tel.sinks:                        # flush without summary
+        s.close()
+    s = report_cli.summarize(report_cli.load_records(path))
+    assert s["from_summary_record"] is False
+    assert s["steps"] > 0 and s["last_loss"] is not None
+
+
+def test_anomaly_verdicts_echoed_into_telemetry_stream(tmp_path):
+    """The Trainer echoes each detector verdict as a kind="anomaly"
+    record so the JSONL is self-contained (the report CLI counts them
+    without reading bundle directories)."""
+    from paddle_tpu.models import MnistMLP
+    from paddle_tpu.nn import costs as nn_costs
+    from paddle_tpu.obs import AnomalyDetector
+    from paddle_tpu import optim as optim_lib
+    mem = InMemorySink()
+    tr = Trainer(
+        model=MnistMLP(num_classes=4, hidden=(8,)),
+        loss_fn=lambda out, b: nn_costs.softmax_cross_entropy(
+            out, b["label"]),
+        optimizer=optim_lib.adam(1e-3), steps_per_call=2, grad_accum=1,
+        telemetry=Telemetry(sinks=[mem]),
+        anomaly=AnomalyDetector(out_dir=str(tmp_path)))
+    batches = make_batches(4)
+    batches[2]["x"][0, 0] = np.nan
+    tr.init(jax.random.PRNGKey(0), batches[0])
+    tr.train(lambda: iter(batches), num_passes=1, log_period=0)
+    anomalies = mem.by_kind("anomaly")
+    assert len(anomalies) == 1
+    assert anomalies[0]["anomaly_kind"] == "nonfinite"
+    assert anomalies[0]["bundle"]
 
 
 def test_telemetry_close_emits_summary_record(tmp_path):

@@ -473,6 +473,45 @@ def test_anomaly_reset_rearms(tmp_path):
     assert len(det.bundles) == 2
 
 
+def test_anomaly_rearm_true_fires_every_onset(tmp_path):
+    """ISSUE 6 satellite: rearm=True makes every trigger of the same kind
+    dump its own bundle — no reset() needed between onsets — and the
+    bundle sequence numbers stay distinct."""
+    det = AnomalyDetector(out_dir=str(tmp_path), rearm=True)
+    det.observe(step_rec(0, nonfinite=1))
+    det.observe(step_rec(1, nonfinite=1))
+    det.observe(step_rec(2, nonfinite=1))
+    assert len(det.bundles) == 3
+    assert len(set(det.bundles)) == 3
+    assert [v.kind for v in det.verdicts] == ["nonfinite"] * 3
+    # the default (rearm=False) under the identical stream fires once
+    det2 = AnomalyDetector(out_dir=str(tmp_path / "oneshot"))
+    for i in range(3):
+        det2.observe(step_rec(i, nonfinite=1))
+    assert len(det2.bundles) == 1
+
+
+def test_anomaly_reset_clears_one_shot_and_rolling_state(tmp_path):
+    """ISSUE 6 satellite: reset() re-arms every kind AND clears the
+    rolling windows + any pending armed-profiler request; bundles on
+    disk stay."""
+    det = AnomalyDetector(out_dir=str(tmp_path), arm_profiler=True)
+    for i in range(6):
+        det.observe(step_rec(i, wall=10.0))
+    assert len(det._walls) == 6
+    det.observe(step_rec(6, nonfinite=1))
+    assert det._fired == {"nonfinite"}
+    assert det._profiler_request is not None       # armed by the trigger
+    bundles_before = list(det.bundles)
+    det.reset()
+    assert det._fired == set()
+    assert det.take_profiler_request() is None     # request cleared
+    assert len(det._walls) == 0 and len(det._ring) == 0
+    assert det.bundles == bundles_before           # evidence persists
+    det.observe(step_rec(7, nonfinite=1))          # fires again post-reset
+    assert len(det.bundles) == len(bundles_before) + 1
+
+
 # ---------------------------------------------------------------------------
 # stager-leak close() contract (ISSUE 4 satellite)
 # ---------------------------------------------------------------------------
