@@ -185,6 +185,17 @@ class TransformerLM(Module):
             return logits, aux_total
         return logits
 
+    def grad_sync_scan_paths(self):
+        """The ``parallel.overlap`` in-scan protocol: fnmatch patterns (over
+        slash-joined param paths) of the leaves this model gradient-syncs
+        PER LAYER inside its scan-over-layers stack — the Trainer's
+        bucketed grad_sync excludes them from its top-level buckets so
+        they are never double-synced. Only the remat'd stack scans, so
+        without ``remat`` there is nothing to claim."""
+        if self.remat is None:
+            return ()
+        return ("*/block*/*",)
+
     def _scan_blocks(self, x, train, segments):
         """The rematerialized stack: stack the (homogeneous) per-block param
         subtrees onto a leading [L, ...] layer axis and run ONE
@@ -201,6 +212,14 @@ class TransformerLM(Module):
 
         def body(carry, bp):
             h, aux = carry
+            # Per-layer in-scan gradient sync (no-op outside an active
+            # Trainer grad_sync="bucketed" trace): the stacked leaves'
+            # gradient only completes when the WHOLE scan transpose
+            # finishes, so the bucket marker wraps each layer's param
+            # slice HERE — its all-reduce fires inside that layer's
+            # backward iteration. Lazy import: parallel imports models.
+            from paddle_tpu.parallel import overlap as _overlap
+            bp = _overlap.sync_scan_slice(bp, tag="scan_layer")
             with jax.named_scope("block_scan"):
                 y, a = block0.apply({"params": {block0._name: bp}}, h,
                                     train=train, segments=segments)

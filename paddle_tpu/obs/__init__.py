@@ -35,13 +35,18 @@ The layers:
   run.jsonl``: run-summary table (throughput, MFU, retraces, overlap,
   anomalies) from a telemetry JSONL.
 
+:mod:`~paddle_tpu.obs.xla_flags` (ISSUE 8) assembles the opt-in TPU
+async-collective / latency-hiding XLA flag set (documented provenance +
+caveats) that lets the backend actually float the bucketed gradient
+all-reduces under the backward pass.
+
 Attach with ``Trainer(..., telemetry=Telemetry(sinks=[JsonlSink(path)]),
 tracer=Tracer(), anomaly=AnomalyDetector(out_dir))``. With none attached
 the hot loop is unchanged: same traced step, same dispatch count, same
 donation, zero extra device fetches.
 """
 
-from . import attribution, hloprof
+from . import attribution, hloprof, xla_flags
 from .anomaly import ANOMALY_KINDS, AnomalyDetector, Verdict
 from .attribution import build_report, format_report, parse_profile_trace
 from .hloprof import (DCN_BYTES_PER_S, HBM_BANDWIDTH, ICI_BANDWIDTH,
@@ -60,7 +65,7 @@ __all__ = [
     "device_memory_stats",
     "Tracer", "tspan", "jax_profile",
     "AnomalyDetector", "Verdict", "ANOMALY_KINDS",
-    "hloprof", "attribution",
+    "hloprof", "attribution", "xla_flags",
     "parse_module", "collective_inventory", "parse_collectives",
     "build_report", "format_report", "parse_profile_trace",
     "ICI_BANDWIDTH", "DCN_BYTES_PER_S", "HBM_BANDWIDTH",

@@ -50,6 +50,17 @@ from .hloprof import (DCN_BYTES_PER_S, DEFAULT_DEVICE, HBM_BANDWIDTH,
 
 __all__ = ["build_report", "parse_profile_trace", "format_report"]
 
+
+def hloprof_grad_sync_scope() -> str:
+    """The named-scope root ``parallel.overlap`` traces its explicit
+    gradient-sync psums under (imported lazily: obs must not depend on
+    the parallel package at import time)."""
+    try:
+        from ..parallel.overlap import GRAD_SYNC_SCOPE
+        return GRAD_SYNC_SCOPE
+    except Exception:
+        return "grad_sync"
+
 _UNSCOPED = "(unscoped)"
 
 
@@ -170,14 +181,20 @@ def build_report(analysis: ModuleAnalysis, *,
     collectives = []
     total_wire = exposed_base_ms = overlappable_ms = 0.0
     grad_ar_wire = grad_ar_count = 0
+    grad_rows = []
+    gs_scope = hloprof_grad_sync_scope()
     for c in inventory:
         wire_total = c.wire_bytes * c.multiplier
         t_comm_ms = wire_total / comm_bw * 1e3
         # a backward collective (the grad sync autodiff's transpose
         # emits) has the REST of the backward pass as independent
         # compute to hide behind; forward/activation collectives feed
-        # the very next op — critical path
-        overlappable = c.backward
+        # the very next op — critical path. Explicit grad-sync psums
+        # (parallel.overlap) are recognized by their named scope too:
+        # the accumulated-gradient sync is traced OUTSIDE the transpose
+        # (no backward metadata) but is still the gradient collective.
+        is_grad_sync = bool(c.scope) and c.scope[0] == gs_scope
+        overlappable = c.backward or is_grad_sync
         d = c.to_dict()
         d.update({
             "wire_bytes_total": round(wire_total),
@@ -190,9 +207,21 @@ def build_report(analysis: ModuleAnalysis, *,
             overlappable_ms += t_comm_ms
         else:
             exposed_base_ms += t_comm_ms
-        if c.kind == "all-reduce" and c.backward:
+        if c.kind == "all-reduce" and (c.backward or is_grad_sync):
             grad_ar_wire += wire_total
             grad_ar_count += 1
+            if is_grad_sync:
+                # one row per explicit sync bucket (ISSUE 8: the
+                # per-bucket comm table the smoke gate asserts)
+                grad_rows.append({
+                    "scope": d["scope"],
+                    "payload_bytes": c.payload_bytes,
+                    "wire_bytes_total": round(wire_total),
+                    "t_comm_ms": round(t_comm_ms, 6),
+                    "multiplier": c.multiplier,
+                    "is_async": c.is_async,
+                    "sched_distance": c.sched_distance,
+                })
     hidden_ms = min(overlappable_ms, bwd_compute_ms)
     exposed_ms = exposed_base_ms + (overlappable_ms - hidden_ms)
     grad_ar_ms = grad_ar_wire / comm_bw * 1e3
@@ -214,6 +243,9 @@ def build_report(analysis: ModuleAnalysis, *,
                 max(0.0, grad_ar_ms - bwd_compute_ms), 6),
             "exposed_ms_today": round(grad_ar_ms, 6),
             "hides_under_backward": bool(grad_ar_ms <= bwd_compute_ms),
+            # per-bucket rows of an explicit (parallel.overlap) sync —
+            # empty under the implicit partitioner sync
+            "buckets": grad_rows,
         } if grad_ar_count else None,
     }
 
@@ -399,6 +431,12 @@ def format_report(report: Dict[str, Any], top_n: int = 12) -> str:
             f"{gar['t_comm_ms']:.3f} ms exposed today, "
             f"{gar['exposed_ms_if_overlapped']:.3f} ms if overlapped with "
             f"backward (hides: {gar['hides_under_backward']})")
+        for row in gar.get("buckets") or []:
+            sd = row.get("sched_distance")
+            lines.append(
+                f"  {row['scope']:<32}{row['payload_bytes'] / 1e6:>8.2f} MB"
+                f"{row['t_comm_ms']:>10.4f} ms  x{row['multiplier']:g}"
+                f"  sched_distance={'-' if sd is None else sd}")
     measured = report.get("measured")
     if measured:
         lines.append(

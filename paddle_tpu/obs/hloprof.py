@@ -229,32 +229,54 @@ _SKIP_COMPONENTS = {"", "while", "body", "cond", "branch", "scan",
 _SCOPE_NAME_RE = re.compile(r"^[A-Za-z_][\w.\-]*$")
 
 
+def _split_path(op_name: str) -> List[str]:
+    """Split an op_name on '/' at paren depth 0 ONLY: a transform wrapper
+    may span several scope components (``transpose(jvp(scope_a/scope_b))``)
+    and a naive split would shear its parentheses apart — losing both the
+    inner scopes and the backward flag."""
+    parts, cur, depth = [], [], 0
+    for ch in op_name:
+        if ch == "/" and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+            continue
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(0, depth - 1)
+        cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
 def scope_of(op_name: str) -> Tuple[Tuple[str, ...], bool]:
     """``(scope_path, backward)`` of one ``metadata op_name`` string.
 
     The last path component is the primitive (dropped); ``jit(...)``
     frames and control-flow machinery are dropped; transform wrappers are
-    unwrapped (``transpose(...)`` anywhere marks the op backward). What
+    unwrapped (``transpose(...)`` anywhere marks the op backward), and a
+    wrapper whose inside spans several components
+    (``transpose(jvp(grad_sync/bucket0))``) re-expands in place. What
     survives is the ``jax.named_scope`` nesting, e.g.
     ``('block_scan', 'attn')``."""
     comps: List[str] = []
     backward = False
-    parts = op_name.split("/")
-    for part in parts[:-1]:
+    work = _split_path(op_name)[:-1]
+    while work:
+        part = work.pop(0)
         if part.startswith("jit("):
             continue
-        inner = part
-        m = _WRAP_RE.match(inner)
-        while m:
+        m = _WRAP_RE.match(part)
+        if m:
             if m.group(1) == "transpose":
                 backward = True
-            inner = m.group(2)
-            m = _WRAP_RE.match(inner)
-        if inner in _SKIP_COMPONENTS:
+            work[0:0] = _split_path(m.group(2))
             continue
-        if not _SCOPE_NAME_RE.match(inner):
+        if part in _SKIP_COMPONENTS:
             continue
-        comps.append(inner)
+        if not _SCOPE_NAME_RE.match(part):
+            continue
+        comps.append(part)
     return tuple(comps), backward
 
 
@@ -297,6 +319,14 @@ class CollectiveOp:
     backward: bool
     multiplier: float
     wire_bytes: float            # per execution, ring factors
+    # Scheduling distance of an async pair: compute ops (fusions / dots /
+    # convs / custom-calls / flop-carrying ops) between this '-start' and
+    # its matching '-done' in the printed instruction order of their
+    # computation — 0 means the collective is issued and immediately
+    # awaited (no overlap), larger means the scheduler found independent
+    # compute to hide it behind. None for synchronous collectives (no
+    # start/done pair) and unmatched starts.
+    sched_distance: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -575,14 +605,36 @@ def parse_module(hlo: str) -> ModuleAnalysis:
     return analysis
 
 
+def _sched_distance(op: HloOp, comp_ops: List[HloOp]) -> Optional[int]:
+    """Compute ops between an async '-start' and its '-done' in the
+    computation's printed instruction order (see
+    :attr:`CollectiveOp.sched_distance`)."""
+    try:
+        i = comp_ops.index(op)
+    except ValueError:
+        return None
+    for j in range(i + 1, len(comp_ops)):
+        other = comp_ops[j]
+        if op.name in other.operand_names and \
+                other.opcode.endswith("-done"):
+            return sum(
+                1 for k in range(i + 1, j)
+                if comp_ops[k].flops > 0
+                or comp_ops[k].opcode in ("fusion", "dot", "convolution",
+                                          "custom-call"))
+    return None
+
+
 def collective_inventory(analysis: ModuleAnalysis,
                          default_group: int = 1) -> List[CollectiveOp]:
     """Structured per-op collective inventory from a parsed module:
     kind, dtype(s), payload bytes (variadic-aggregated; '-start' forms
     results-only), replica-group size, named-scope attribution,
-    forward/backward direction, and the loop-aware execution multiplier.
-    Degenerate 1-device groups are dropped (they move nothing), matching
-    :func:`parse_collectives`."""
+    forward/backward direction, the loop-aware execution multiplier, and
+    — for async start/done pairs — the scheduling distance (intervening
+    compute ops), so overlap is visible in the static report, not just
+    wall clock. Degenerate 1-device groups are dropped (they move
+    nothing), matching :func:`parse_collectives`."""
     out = []
     for op in analysis.ops:
         base = op.opcode[:-6] if op.opcode.endswith("-start") else op.opcode
@@ -611,5 +663,8 @@ def collective_inventory(analysis: ModuleAnalysis,
             payload_bytes=int(payload), group_size=group,
             variadic=len(shapes), is_async=is_async, scope=op.scope,
             backward=op.backward, multiplier=op.multiplier,
-            wire_bytes=_ring_wire_bytes(base, payload, group)))
+            wire_bytes=_ring_wire_bytes(base, payload, group),
+            sched_distance=(_sched_distance(
+                op, analysis.computations.get(op.computation, []))
+                if is_async else None)))
     return out

@@ -13,10 +13,17 @@ This package owns:
     build sharded train states (``ShardingRules``, ``sharded_init``).
   - :mod:`.ring` — ring attention over ``ppermute`` for the ``seq`` mesh axis
     (sequence/context parallelism; exceeds the 2017 reference, SURVEY.md §5).
+  - :mod:`.overlap` — bucketed gradient-sync overlap: explicit, byte-budgeted
+    per-bucket grad all-reduces anchored inside the backward pass
+    (``Trainer(grad_sync="bucketed")``; the pserver gradient-pipelining
+    story, XLA-era).
 """
 
 from .sharding import (ShardingRules, spec_tree, named_shardings,
                        shard_tree, sharded_init)
+from .overlap import (Bucket, partition_buckets, sync_tangent,
+                      mark_buckets, apply_bucket_sync, sync_scan_slice,
+                      scan_sync_scope, resolve_grad_sync)
 from .ring import ring_attention, make_ring_attention
 from .ulysses import ulysses_attention, make_ulysses_attention
 from .multihost import (initialize, is_initialized,
@@ -34,4 +41,7 @@ __all__ = [
     "make_pipeline_1f1b", "pipeline_loss_apply", "make_pipeline_loss",
     "megatron_sp_rules", "make_megatron_sp_lm_apply",
     "is_initialized", "host_sharded_reader", "multihost_mesh",
+    "Bucket", "partition_buckets", "sync_tangent", "mark_buckets",
+    "apply_bucket_sync", "sync_scan_slice", "scan_sync_scope",
+    "resolve_grad_sync",
 ]

@@ -123,11 +123,10 @@ def make_megatron_sp_lm_apply(model, mesh: Mesh, data_axis: str = "data",
             return _shard_map(fn, **kw)
         # pallas_call's out_shapes carry no varying-axes info, so
         # shard_map's vma check rejects the flash path — disable it there
-        # (the einsum path keeps the check; the oracle tests pin both)
-        try:
-            return _shard_map(fn, check_vma=False, **kw)
-        except TypeError:                    # older jax spells it check_rep
-            return _shard_map(fn, check_rep=False, **kw)
+        # via the shared no-check wrapper (the einsum path keeps the
+        # check; the oracle tests pin both)
+        from .overlap import shard_map_compat
+        return shard_map_compat(fn, **kw)
 
     from ..nn import activations
     gelu = activations.get("gelu")

@@ -132,6 +132,39 @@ class Trainer:
         optimizer update — and with ``param_sharding`` the gradient
         all-reduce the partitioner hoists out of the accumulation loop —
         fires once per accumulated step, not per microbatch.
+      grad_sync: None (default) leaves the data-parallel gradient
+        all-reduce to the SPMD partitioner (one implicit collective per
+        gradient tensor, typically combined+scheduled by the backend as a
+        monolithic post-backward sync). ``"bucketed"`` takes explicit
+        ownership (:mod:`paddle_tpu.parallel.overlap`): each microbatch's
+        forward+backward runs in a manual-dp ``shard_map`` region (other
+        mesh axes stay GSPMD-auto, so tensor-parallel ``param_sharding``
+        composes), parameters are partitioned into byte-budgeted buckets
+        in reverse layer order, and a ``custom_vjp`` marker all-reduces
+        each bucket's cotangents as ONE flat psum the moment that
+        bucket's backward slice completes — the scheduler can float each
+        bucket's collective under the remaining backward compute. Models
+        with a remat scan-over-layers stack sync the per-layer slice
+        inside the scan transpose (``TransformerLM.grad_sync_scan_paths``
+        protocol). ``"fused"`` is the single-bucket baseline: one flat
+        post-backward all-reduce — bit-exact vs bucketed in f32 (same
+        elementwise reduction, different granularity). With
+        ``grad_accum > 1`` local gradients accumulate across microbatches
+        and sync once per optimizer step, never per microbatch. Degrades
+        gracefully (one warning, implicit sync) when the mesh has no
+        multi-device dp axis or ``param_sharding`` shards params over the
+        dp axis. Semantic deltas of the explicit modes: module-state
+        updates (BN running stats) happen per dp shard — torch-DDP
+        semantics, warned once when state is non-empty — and dropout
+        draws per shard from a dp-coordinate-folded key (independent
+        masks, but a different sample stream than the implicit path).
+        Forward outputs must be batch-led (the ``shard_batch`` contract):
+        a non-batch-led output leaf is either rejected at trace time
+        (leading dim not divisible by dp) or would be mis-assembled —
+        use ``grad_sync=None`` for such models.
+      bucket_mb: bucket byte budget in MiB for ``grad_sync="bucketed"``
+        (default 4.0). Smaller buckets start syncing earlier but pay more
+        per-collective latency; see README "Gradient-sync overlap".
       pipeline_depth: W > 1 turns on the async host pipeline
         (``train/host_pipeline.py``): a background stager thread stacks and
         ``device_put``-shards group N+1 (double-buffered) while call N runs
@@ -193,6 +226,7 @@ class Trainer:
                  nan_check: bool = False,
                  param_stats_period: Optional[int] = None,
                  steps_per_call: int = 1, grad_accum: int = 1,
+                 grad_sync: Optional[str] = None, bucket_mb: float = 4.0,
                  pipeline_depth: int = 1, telemetry=None, tracer=None,
                  anomaly=None):
         self.model = model
@@ -220,6 +254,20 @@ class Trainer:
             raise ValueError("pipeline_depth must be >= 1")
         self.steps_per_call = int(steps_per_call)
         self.grad_accum = int(grad_accum)
+        # grad_sync: explicit dp gradient synchronization (bucketed
+        # overlap / fused baseline) — validated eagerly, resolved against
+        # the mesh lazily at step build (parallel.overlap).
+        from ..parallel import overlap as overlap_lib
+        if grad_sync not in overlap_lib.GRAD_SYNC_MODES:
+            raise ValueError(
+                f"grad_sync must be one of "
+                f"{overlap_lib.GRAD_SYNC_MODES}, got {grad_sync!r}")
+        if bucket_mb <= 0:
+            raise ValueError(f"bucket_mb must be > 0, got {bucket_mb}")
+        self.grad_sync = grad_sync
+        self.bucket_mb = float(bucket_mb)
+        self._grad_sync_warned = False
+        self._state_sync_warned = False
         # pipeline_depth: bounded in-flight dispatch window (1 = serial).
         self.pipeline_depth = int(pipeline_depth)
         # host-side optimizer-step mirror: lets the fused replay number its
@@ -299,6 +347,7 @@ class Trainer:
             "optimizer": type(self.optimizer).__name__,
             "steps_per_call": self.steps_per_call,
             "grad_accum": self.grad_accum,
+            "grad_sync": self.grad_sync,
             "pipeline_depth": self.pipeline_depth,
             "donate": self._donate,
             "nan_check": self._nan_check,
@@ -364,6 +413,185 @@ class Trainer:
                                       jnp.zeros((), jnp.int32))
         return self.train_state
 
+    # -- explicit gradient sync (ISSUE 8) ------------------------------------
+
+    def _resolve_grad_sync(self) -> Optional[str]:
+        """Resolve the requested ``grad_sync`` mode against the mesh and
+        committed param layout; on degrade, warn ONCE and return None
+        (the implicit partitioner sync — never crash a config that
+        trains fine without the overlap)."""
+        from ..parallel import overlap as overlap_lib
+        mode, reason = overlap_lib.resolve_grad_sync(
+            self.grad_sync, self.mesh, mesh_lib.DATA_AXIS,
+            self._param_specs)
+        if self.grad_sync is not None and mode is None and \
+                not self._grad_sync_warned:
+            self._grad_sync_warned = True
+            _log.warning(
+                "grad_sync=%r requested but cannot engage: %s — degrading "
+                "to the implicit partitioner gradient sync (no-op marker)",
+                self.grad_sync, reason)
+        return mode
+
+    def _make_synced_grads(self, mode: str):
+        """Build the explicit-sync gradient path for one microbatch
+        (:mod:`paddle_tpu.parallel.overlap`): the forward+backward runs in
+        a ``shard_map`` manual over the dp axis (all other mesh axes stay
+        GSPMD-auto, so tensor-parallel ``param_sharding`` composes), each
+        device differentiates its LOCAL loss sum, and the only dp
+        gradient communication is ours — one flat psum per bucket,
+        anchored in the backward by the ``sync_tangent`` markers.
+
+        Returns ``(grads_fn, accum_sync)``:
+
+        - ``grads_fn(params, state, mb, rngs, sync_now)`` matches
+          ``microbatch_grads``' return contract
+          ``((loss, (new_state, out)), grads)``. ``sync_now=True`` (the
+          ``M == 1`` path) applies the bucket markers — grads leave the
+          region globally reduced, with each bucket's all-reduce placed
+          as-you-go inside the backward. ``sync_now=False`` (the
+          accumulation path) returns LOCAL per-device grads.
+        - ``accum_sync(grads)`` bucket-syncs an accumulated local
+          gradient tree — called once per optimizer step after the
+          microbatch scan, never per microbatch.
+
+        The loss is the same weight-normalized global mean as the
+        implicit path: local (weighted) sums are psum'd and divided by
+        the global weight/count, and the gradient is post-scaled by the
+        same denominator — mathematically the mean's gradient, with
+        bucketed-vs-fused bit-exactness guaranteed by construction (the
+        two modes differ only in all-reduce granularity, and all-reduce
+        is an elementwise sum)."""
+        from ..parallel import overlap as overlap_lib
+        assert self.train_state is not None, "call init() first"
+        mesh = self.mesh
+        axis = mesh_lib.DATA_AXIS
+        model, loss_fn, forward = self.model, self.loss_fn, self._forward
+        auto = frozenset(set(mesh.axis_names) - {axis})
+        params0 = self.train_state.params
+        if jax.tree_util.tree_leaves(self.train_state.state) and \
+                not self._state_sync_warned:
+            self._state_sync_warned = True
+            _log.warning(
+                "grad_sync=%r runs the forward per dp shard: module-state "
+                "updates (e.g. BN running stats) use each device's LOCAL "
+                "batch statistics (torch-DDP semantics), not global-batch "
+                "statistics", mode)
+        # The in-scan protocol: a model may declare param paths it syncs
+        # per-layer inside its scan-over-layers stack (the remat'd
+        # transformer) — those leaves leave the top-level buckets, and the
+        # scan hook engages only on the sync-now path (accumulation syncs
+        # once per step, so in-scan per-microbatch psums must stay off).
+        scan_paths: tuple = ()
+        if mode == "bucketed":
+            hook = getattr(model, "grad_sync_scan_paths", None)
+            if callable(hook):
+                scan_paths = tuple(hook() or ())
+        # "fused" = one bucket (per dtype — flat buffers cannot mix)
+        budget = self.bucket_mb if mode == "bucketed" else 1e9
+        buckets_now = overlap_lib.partition_buckets(
+            params0, budget, exclude=scan_paths)
+        buckets_accum = overlap_lib.partition_buckets(params0, budget)
+        in_scan = bool(scan_paths)
+
+        def _sm(fn, in_specs, out_specs):
+            kw = {"auto": auto} if auto else {}
+            return overlap_lib.shard_map_compat(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **kw)
+
+        def batch_spec(x):
+            return P() if np.ndim(x) == 0 else P(mesh_lib.DATA_AXIS)
+
+        repl_of = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+
+        def grads_fn(params, state, mb, rngs, sync_now: bool):
+            weighted = mb.get("weight") is not None
+
+            def per_device(params, state, mb, rngs):
+                # per-shard rng: fold the dp coordinate into every key so
+                # shards draw INDEPENDENT dropout masks (a replicated key
+                # would give every shard the same mask over its local
+                # batch — an undisclosed 1/dp cut in mask diversity)
+                rngs = jax.tree_util.tree_map(
+                    lambda k: jax.random.fold_in(k, lax.axis_index(axis)),
+                    rngs)
+
+                def local_loss(p):
+                    if sync_now:
+                        p = overlap_lib.mark_buckets(p, buckets_now, axis)
+                    with overlap_lib.scan_sync_scope(
+                            axis if (sync_now and in_scan) else None):
+                        out, new_state = forward(
+                            model, {"params": p, "state": state}, mb,
+                            True, rngs)
+                    per_ex = loss_fn(out, mb)
+                    w = mb.get("weight")
+                    if w is not None:
+                        lsum = jnp.sum(per_ex * w)
+                        denom_local = jnp.sum(w)
+                    else:
+                        lsum = jnp.sum(per_ex)
+                        # static local element count: psum'd into the
+                        # exact global count the implicit mean divides by
+                        denom_local = jnp.asarray(per_ex.size, jnp.float32)
+                    return lsum, (new_state, out, denom_local)
+
+                (lsum, (new_state, out, denom_local)), grads = \
+                    jax.value_and_grad(local_loss, has_aux=True)(params)
+                tot = lax.psum(
+                    jnp.stack([lsum.astype(jnp.float32),
+                               denom_local.astype(jnp.float32)]), axis)
+                denom = (jnp.maximum(tot[1], 1e-9) if weighted else tot[1])
+                loss = tot[0] / denom
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / denom.astype(g.dtype), grads)
+                return loss, new_state, out, grads
+
+            # structure-only abstract pass: out_specs need the forward's
+            # output tree (batch-led leaves rejoin the global batch
+            # layout — the same batch-led contract shard_batch applies
+            # to inputs; a non-divisible leading dim gets an actionable
+            # error instead of shard_map's shape mismatch)
+            out_s, state_s = jax.eval_shape(
+                lambda p, s, b, r: forward(
+                    model, {"params": p, "state": s}, b, True, r),
+                params, state, mb, rngs)
+            dp = dict(zip(mesh.axis_names,
+                          mesh.devices.shape))[mesh_lib.DATA_AXIS]
+
+            def out_spec(s):
+                if not getattr(s, "ndim", 0):
+                    return P()
+                if s.shape[0] % dp:
+                    raise ValueError(
+                        f"grad_sync={mode!r} requires batch-led forward "
+                        f"outputs: got an output leaf of shape {s.shape} "
+                        f"whose leading dim does not divide the dp axis "
+                        f"size {dp} (use grad_sync=None for this model, "
+                        f"or make its outputs batch-led)")
+                return P(mesh_lib.DATA_AXIS)
+
+            sm = _sm(
+                per_device,
+                in_specs=(repl_of(params), repl_of(state),
+                          jax.tree_util.tree_map(batch_spec, mb),
+                          repl_of(rngs)),
+                out_specs=(P(), repl_of(state_s),
+                           jax.tree_util.tree_map(out_spec, out_s),
+                           repl_of(params)))
+            loss, new_state, out, grads = sm(params, state, mb, rngs)
+            return (loss, (new_state, out)), grads
+
+        def accum_sync(grads):
+            def per_device(gs):
+                return overlap_lib.apply_bucket_sync(gs, buckets_accum,
+                                                     axis)
+            return _sm(per_device, in_specs=(repl_of(grads),),
+                       out_specs=repl_of(grads))(grads)
+
+        return grads_fn, accum_sync
+
     # -- compiled steps ------------------------------------------------------
 
     def _make_step_fn(self, accum_axis: bool):
@@ -408,6 +636,20 @@ class Trainer:
 
             return jax.value_and_grad(compute_loss, has_aux=True)(params)
 
+        # Explicit dp gradient sync (ISSUE 8): swap the implicit-GSPMD
+        # microbatch_grads for the manual-dp bucketed/fused path. With
+        # grad_accum the markers stay OFF per microbatch (sync_now=False:
+        # local grads accumulate) and accum_sync fires once per step.
+        sync_mode = self._resolve_grad_sync()
+        synced_grads = accum_sync = None
+        if sync_mode is not None:
+            synced_grads, accum_sync = self._make_synced_grads(sync_mode)
+
+        def grads_of(params, state, mb, rngs, sync_now):
+            if synced_grads is not None:
+                return synced_grads(params, state, mb, rngs, sync_now)
+            return microbatch_grads(params, state, mb, rngs)
+
         def step_fn(params, state, opt_state, step, batch, rng):
             M = (jax.tree_util.tree_leaves(batch)[0].shape[0]
                  if accum_axis else 1)
@@ -415,8 +657,8 @@ class Trainer:
                 batch = jax.tree_util.tree_map(lambda x: x[0], batch)
             if M == 1:
                 rngs = {"dropout": jax.random.fold_in(rng, step)}
-                (loss, (new_state, out)), grads = microbatch_grads(
-                    params, state, batch, rngs)
+                (loss, (new_state, out)), grads = grads_of(
+                    params, state, batch, rngs, True)
                 stats = (evaluator.batch_stats(out, batch)
                          if evaluator is not None else {})
             else:
@@ -426,8 +668,8 @@ class Trainer:
                     st, gacc, lacc = carry
                     mb, midx = xs
                     rngs = {"dropout": jax.random.fold_in(step_key, midx)}
-                    (l, (new_st, out)), g = microbatch_grads(
-                        params, st, mb, rngs)
+                    (l, (new_st, out)), g = grads_of(
+                        params, st, mb, rngs, False)
                     s = (evaluator.batch_stats(out, mb)
                          if evaluator is not None else {})
                     gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
@@ -439,6 +681,11 @@ class Trainer:
                     (batch, jnp.arange(M)))
                 grads = jax.tree_util.tree_map(lambda g: g / M, gacc)
                 loss = lacc / M
+                if accum_sync is not None:
+                    # sync the ACCUMULATED gradient once per optimizer
+                    # step — never per microbatch (the microbatch grads
+                    # above were local per-device sums)
+                    grads = accum_sync(grads)
             updates, new_opt = opt.update(grads, opt_state, params, step)
             new_params = apply_updates(params, updates)
             if health_on:

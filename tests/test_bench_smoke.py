@@ -69,6 +69,20 @@ def test_bench_smoke_cpu_green_and_equal():
     assert gar["ops"] >= 1 and gar["wire_bytes_per_device"] > 0
     assert gar["exposed_ms_if_overlapped"] is not None
     assert attr["emitted_records"] == 1
+    # ISSUE 8: the gradient-sync overlap gate ran on the same simulated
+    # dp mesh — bucketed and fused modes train bit-identically, the
+    # bucketed HLO carries >= 2 gradient all-reduces (vs exactly 1
+    # fused) including the per-layer in-scan sync, and the attribution
+    # record's per-bucket comm rows carry the sched_distance field
+    ovl = out["overlap"]
+    assert ovl["ok"] is True, ovl
+    assert ovl["n_devices"] == 2
+    assert ovl["losses_equal"] is True and ovl["params_equal"] is True
+    assert ovl["bucketed_grad_allreduces"] >= 2
+    assert ovl["fused_grad_allreduces"] == 1
+    assert ovl["in_scan_rows"] >= 1
+    assert ovl["sched_distance_field"] is True
+    assert ovl["emitted_records"] == 1
 
 
 def _write_bench(tmp_path, name, metrics):
@@ -159,3 +173,21 @@ def test_bench_prep_transformer_fused_builds():
     assert int(state[3]) == 3                    # K steps per call
     assert np.isfinite(float(state[-1]))
     assert meta["units_per_step"] == 3 * 8 * 16
+
+
+def test_bench_prep_transformer_dp_overlap_builds():
+    """ISSUE 8: the dp-overlap metric prep builds the bucketed-sync
+    trainer on the 8-device data mesh (explicit sync active) and one
+    call advances K optimizer steps."""
+    sys.path.insert(0, REPO)
+    import jax
+    import bench
+
+    step_body, state0, meta = bench.prep_transformer_dp_overlap(
+        batch_size=8, seq_len=16, dim=32, layers=2, heads=2, vocab=64,
+        k_steps=2, bucket_mb=0.001)
+    state = jax.jit(step_body)(state0)
+    assert int(state[3]) == 2
+    assert np.isfinite(float(state[-1]))
+    assert meta["grad_sync_active"] == "bucketed"
+    assert meta["units_per_step"] == 2 * 8 * 16

@@ -73,6 +73,54 @@ def test_scope_of_unwraps_transforms_and_filters_machinery():
     assert hloprof.scope_of("") == ((), False)
 
 
+def test_scope_of_wrapper_spanning_slashes():
+    """ISSUE 8: a transform wrapper may span SEVERAL scope components —
+    ``transpose(jvp(grad_sync/bucket0))`` — and must not be sheared
+    apart at its internal slashes (the naive split lost both the inner
+    scopes and the backward flag)."""
+    scope, bwd = hloprof.scope_of(
+        "jit(f)/jit(main)/jit(shmap_body)/"
+        "transpose(jvp(grad_sync/bucket0))/psum")
+    assert scope == ("grad_sync", "bucket0") and bwd is True
+    scope, bwd = hloprof.scope_of(
+        "jit(f)/jit(main)/while/body/"
+        "transpose(jvp(block_scan/attn/qkv_proj))/dot_general")
+    assert scope == ("block_scan", "attn", "qkv_proj") and bwd is True
+    # forward multi-component wrapper: scopes recovered, not backward
+    scope, bwd = hloprof.scope_of("jvp(embed/pos)/add")
+    assert scope == ("embed", "pos") and bwd is False
+
+
+def test_sched_distance_async_pairs():
+    """ISSUE 8 satellite: an async all-reduce start/done pair reports the
+    intervening compute ops (fusions/dots) as its scheduling distance;
+    sync collectives report None."""
+    hlo = "\n".join([
+        "ENTRY %main (p0: f32[64]) -> f32[64] {",
+        "  %p0 = f32[64]{0} parameter(0)",
+        "  %ars = f32[64]{0} all-reduce-start(f32[64]{0} %p0), "
+        "replica_groups={{0,1}}, to_apply=%add",
+        "  %f1 = f32[64]{0} fusion(f32[64]{0} %p0), kind=kLoop, "
+        "calls=%fused_computation",
+        "  %d1 = f32[64]{0} dot(f32[64]{0} %f1, f32[64]{0} %f1), "
+        "lhs_contracting_dims={}, rhs_contracting_dims={}",
+        "  %t1 = f32[64]{0} tuple(f32[64]{0} %d1)",
+        "  %ard = f32[64]{0} all-reduce-done(f32[64]{0} %ars)",
+        "  %ar2 = f32[64]{0} all-reduce(f32[64]{0} %ard), "
+        "replica_groups={{0,1}}, to_apply=%add",
+        "  ROOT %r = f32[64]{0} add(f32[64]{0} %ar2, f32[64]{0} %d1)",
+        "}",
+    ])
+    analysis = hloprof.parse_module(hlo)
+    inv = hloprof.collective_inventory(analysis, default_group=2)
+    by_name = {c.name: c for c in inv}
+    assert by_name["ars"].is_async
+    # fusion + dot between start and done; the tuple is plumbing
+    assert by_name["ars"].sched_distance == 2
+    assert by_name["ar2"].sched_distance is None        # sync op
+    assert "sched_distance" in by_name["ars"].to_dict()
+
+
 # ---------------------------------------------------------------------------
 # flops + loop multipliers vs XLA's own cost analysis
 # ---------------------------------------------------------------------------
