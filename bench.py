@@ -570,6 +570,9 @@ PLANS = {
     # Trainer-loop-level overlap differential (own child protocol:
     # run_pipelined_child; n/k unused)
     "transformer_pipelined": dict(n=0, k=1, budget=2400),
+    # serving decode throughput (own child protocol:
+    # run_serving_bench_child; n/k unused)
+    "transformer_decode": dict(n=0, k=1, budget=2400),
 }
 
 
@@ -998,6 +1001,13 @@ def run_smoke(K=4, M=2, timing_passes=3):
     overlap = run_gate_child("--overlap-child")
     overlap_ok = overlap.get("ok") is True
 
+    # serving gate (ISSUE 9): 8 ragged requests through the continuous-
+    # batching engine — all complete, zero retraces after warmup,
+    # per-request TTFT/TPOT records, continuous beats gang-static
+    # tokens/sec, decode tick classified memory-bound.
+    serving = run_gate_child("--serving-child")
+    serving_ok = serving.get("ok") is True
+
     out = {
         "metric": "fused_vs_plain_smoke",
         "equal": bool(eq_params and eq_losses),
@@ -1013,13 +1023,14 @@ def run_smoke(K=4, M=2, timing_passes=3):
         "trace": trace,
         "attribution": attribution,
         "overlap": overlap,
+        "serving": serving,
     }
     print(json.dumps(out))
     ok = (out["equal"] and jsonl_ok
           and telemetry["losses_equal_with_telemetry"]
           and pipeline["losses_equal"] and pipeline["overlap_keys_ok"]
           and trace_ok and trace["losses_equal_with_tracer"]
-          and attribution_ok and overlap_ok)
+          and attribution_ok and overlap_ok and serving_ok)
     return 0 if ok else 1
 
 
@@ -1182,6 +1193,165 @@ def run_overlap_child(K=2):
         "emitted_records": emitted,
     }))
     return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# serving gate child (ISSUE 9): continuous batching + paged KV on CPU
+# ---------------------------------------------------------------------------
+
+def run_serving_child():
+    """The serving runtime's CI gate: 8 ragged requests through a
+    4-slot engine (``paddle_tpu.serve``), once under continuous batching
+    and once under the gang-static baseline. Asserts: every request
+    completes; ZERO retraces after warmup (one compiled program per
+    entry point across all admission/eviction churn); one per-request
+    telemetry record each with the TTFT/TPOT SLO fields; continuous
+    beats static on ragged-length tokens/sec; and the decode tick's
+    attribution report classifies ``decode/*`` as memory-bound. Prints
+    the verdict as one JSON line."""
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    from paddle_tpu.serve import ContinuousBatchingScheduler, DecodeEngine
+
+    V, W = 64, 32
+    model = TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
+                          ffn_hidden=64, max_len=W)
+    vs = model.init(jax.random.PRNGKey(0), jnp.zeros((1, W), jnp.int32))
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(0, V, rng.randint(2, 8)))
+               for _ in range(8)]
+    # stragglers dominate their gang: exactly the raggedness
+    # iteration-level scheduling exists to absorb
+    maxnew = [2, 16, 2, 16, 2, 16, 2, 2]
+
+    def run_policy(policy):
+        mem = InMemorySink()
+        eng = DecodeEngine(model, vs, max_slots=4, block_size=4,
+                           telemetry=Telemetry(sinks=[mem]))
+
+        def one_run():
+            sched = ContinuousBatchingScheduler(eng, policy=policy)
+            for p, m in zip(prompts, maxnew):
+                sched.submit(p, m)
+            t0 = time.perf_counter()
+            done = sched.run()
+            return done, time.perf_counter() - t0
+
+        one_run()                          # warmup: compiles + first churn
+        warm_ticks = eng.ticks
+        done, wall = one_run()             # timed, fully warm
+        toks = sum(len(r.tokens) for r in done)
+        return {
+            "completed": len(done), "tokens": toks,
+            "ticks": eng.ticks - warm_ticks,
+            "tokens_per_sec": round(toks / wall, 2),
+            "compile_counts": eng.compile_counts(),
+            "request_records": len(mem.by_kind("request")),
+            "tick_records": len(mem.by_kind("decode_tick")),
+            "sample_request": next(
+                (r for r in mem.by_kind("request")
+                 if r.get("tpot_ms") is not None), None),
+        }, eng
+
+    cont, eng_c = run_policy("continuous")
+    stat, _ = run_policy("static")
+    report = eng_c.attribution_report(emit=False)
+    decode_block = report.get("decode") or {}
+
+    no_retrace = (cont["compile_counts"] == {"prefill": 1, "tick": 1}
+                  and stat["compile_counts"] == {"prefill": 1, "tick": 1})
+    records_ok = (cont["request_records"] == 16     # warmup + timed runs
+                  and cont["sample_request"] is not None
+                  and cont["sample_request"].get("ttft_ms") is not None)
+    ok = (cont["completed"] == 8 and stat["completed"] == 8
+          and no_retrace and records_ok
+          and cont["tokens_per_sec"] > stat["tokens_per_sec"]
+          and cont["ticks"] < stat["ticks"]
+          and decode_block.get("bound") == "memory")
+    print(json.dumps({
+        "child": "serving", "ok": bool(ok),
+        "requests": 8, "max_slots": 4, "block_size": 4,
+        "continuous": cont, "static": stat,
+        "continuous_vs_static": round(
+            cont["tokens_per_sec"] / stat["tokens_per_sec"], 3)
+        if stat["tokens_per_sec"] else None,
+        "zero_retraces_after_warmup": bool(no_retrace),
+        "decode_bound": decode_block.get("bound"),
+        "decode_intensity_flops_per_byte":
+            decode_block.get("intensity_flops_per_byte"),
+        "device": jax.devices()[0].device_kind,
+    }))
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# serving decode throughput metric (ISSUE 9): steady-state tokens/sec
+# through the compiled decode tick
+# ---------------------------------------------------------------------------
+
+def run_serving_bench_child(max_slots=8, block_size=16, seq_len=1024,
+                            dim=512, layers=6, heads=8, vocab=32000,
+                            prompt_len=128, warmup_ticks=8,
+                            timed_ticks=64):
+    """The ``transformer_decode`` device metric: fill every slot with a
+    long-running request, warm the tick, then time ``timed_ticks``
+    compiled decode steps — steady-state serving throughput with the
+    paged KV gather on the hot path (the decode-shaped attention auto-
+    selects Pallas on TPU, the XLA gather path elsewhere). Prints one
+    JSON line for the parent."""
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.serve import DecodeEngine
+
+    ffn = 4 * dim
+    model = TransformerLM(vocab=vocab, dim=dim, num_layers=layers,
+                          num_heads=heads, ffn_hidden=ffn, max_len=seq_len)
+    vs = model.init(jax.random.PRNGKey(0),
+                    jnp.zeros((1, seq_len), jnp.int32))
+    eng = DecodeEngine(model, vs, max_slots=max_slots,
+                       block_size=block_size)
+    rng = np.random.RandomState(0)
+    target = prompt_len + warmup_ticks + timed_ticks + 2
+    assert target <= eng.context_width
+    for slot in range(max_slots):
+        eng.admit(slot, list(rng.randint(0, vocab, prompt_len)),
+                  reserve_len=target)
+    for _ in range(warmup_ticks):
+        eng.decode_tick()
+    t0 = time.perf_counter()
+    for _ in range(timed_ticks):
+        eng.decode_tick()
+    wall = time.perf_counter() - t0
+    tokens = timed_ticks * max_slots
+    print(json.dumps({
+        "child": "transformer_decode",
+        "decode_tokens_per_sec": round(tokens / wall, 2),
+        "ms_per_tick": round(wall / timed_ticks * 1e3, 3),
+        "max_slots": max_slots, "block_size": block_size,
+        "context_width": eng.context_width, "prompt_len": prompt_len,
+        "timed_ticks": timed_ticks, "dim": dim, "layers": layers,
+        "vocab": vocab, "attention": eng.attention,
+        "compile_counts": eng.compile_counts(),
+        "device": jax.devices()[0].device_kind,
+    }))
+
+
+def bench_serving(budget=None):
+    """Fresh-subprocess wrapper for run_serving_bench_child (one child =
+    one tunnel session, like every other metric)."""
+    budget = budget or PLANS["transformer_decode"]["budget"]
+    r = _spawn_child("transformer_decode", 0, 1, budget)
+    return {
+        "metric": "transformer_decode_tokens_per_sec",
+        "unit": "tokens/sec",
+        "value": r["decode_tokens_per_sec"],
+        "ms_per_tick": r["ms_per_tick"],
+        "max_slots": r["max_slots"], "block_size": r["block_size"],
+        "context_width": r["context_width"],
+        "prompt_len": r["prompt_len"], "dim": r["dim"],
+        "layers": r["layers"], "attention": r["attention"],
+        "device": r["device"],
+        "baseline": None, "vs_baseline": None,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -1520,13 +1690,14 @@ def bench_scaling(per_device_batch=32, iters=2, steps_per_call=4):
 # committed artifacts are SCALING_r05.json (proxy + analytic projection).
 DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_fused",
                 "transformer_dp_overlap", "transformer_pipelined",
-                "transformer_big", "lstm", "lstm_h256", "lstm_h1280"]
+                "transformer_decode", "transformer_big", "lstm",
+                "lstm_h256", "lstm_h1280"]
 
 
 _KNOWN_FLAGS = ("--metric", "--child", "--probe", "--n", "--k",
                 "--timed-steps", "--steps-per-call", "--smoke",
-                "--attribution-child", "--overlap-child", "--compare",
-                "--threshold")
+                "--attribution-child", "--overlap-child",
+                "--serving-child", "--compare", "--threshold")
 
 
 def main():
@@ -1571,6 +1742,9 @@ def main():
     if flag("--overlap-child", cast=int):
         sys.exit(run_overlap_child())
 
+    if flag("--serving-child", cast=int):
+        sys.exit(run_serving_child())
+
     if "--smoke" in args or flag("--smoke", cast=int):
         # CPU mode: the gate must be deterministic and CI-runnable — on any
         # other backend re-launch pinned to CPU (JAX_PLATFORMS must be set
@@ -1597,6 +1771,8 @@ def main():
     if flag("--child", cast=int):
         if metric == "transformer_pipelined":
             run_pipelined_child()
+        elif metric == "transformer_decode":
+            run_serving_bench_child()
         else:
             run_timed_child(metric, flag("--timed-steps", 100, int),
                             flag("--steps-per-call", 1, int))
@@ -1605,9 +1781,10 @@ def main():
     if metric == "scaling":
         print(json.dumps(bench_scaling()))
         return
-    if metric == "transformer_pipelined":
+    if metric in ("transformer_pipelined", "transformer_decode"):
         try:
-            out = bench_pipelined()
+            out = (bench_pipelined() if metric == "transformer_pipelined"
+                   else bench_serving())
         except (RuntimeError, subprocess.TimeoutExpired, ValueError,
                 IndexError, KeyError) as e:
             print(json.dumps({"metric": metric, "error": str(e)[-800:],
@@ -1619,7 +1796,7 @@ def main():
     if metric is not None and metric not in PREPS:
         print(json.dumps(
             {"error": f"unknown metric {metric!r}; choose from "
-                      f"{sorted(PREPS) + ['scaling', 'transformer_pipelined']}"
+                      f"{sorted(PREPS) + ['scaling', 'transformer_pipelined', 'transformer_decode']}"
              }))
         sys.exit(2)
     if metric in PREPS:
@@ -1644,9 +1821,12 @@ def main():
     for name in DEFAULT_PLAN:
         for attempt in (1, 2):
             try:
-                results[name] = (bench_pipelined()
-                                 if name == "transformer_pipelined"
-                                 else bench_differential(name))
+                if name == "transformer_pipelined":
+                    results[name] = bench_pipelined()
+                elif name == "transformer_decode":
+                    results[name] = bench_serving()
+                else:
+                    results[name] = bench_differential(name)
                 errors.pop(name, None)
                 break
             except (RuntimeError, subprocess.TimeoutExpired,

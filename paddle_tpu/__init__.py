@@ -16,4 +16,5 @@ from . import obs
 from . import parallel
 from . import inference
 from .inference import export, infer, load_inference_model
+from . import serve
 from . import config_helpers

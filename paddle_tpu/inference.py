@@ -17,8 +17,9 @@ is a jit'd forward (``method=`` reaches alternative entry points, e.g. beam
 
 from __future__ import annotations
 
+import logging
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 import jax
@@ -34,6 +35,16 @@ __all__ = ["export", "load_inference_model", "InferenceModel", "infer",
 
 _MODEL_FILE = "model.json"
 _VARS_FILE = "variables.npz"
+
+_log = logging.getLogger("paddle_tpu.inference")
+
+
+def _hashable(v) -> bool:
+    try:
+        hash(v)
+        return True
+    except TypeError:
+        return False
 
 
 def export(path: str, model, variables: Dict[str, Any]) -> str:
@@ -53,18 +64,82 @@ def export(path: str, model, variables: Dict[str, Any]) -> str:
 
 
 class InferenceModel:
-    """A rebuilt model + variables with jit-cached forward entry points."""
+    """A rebuilt model + variables with jit-cached forward entry points.
+
+    Decoder-LM bundles additionally serve incrementally: ``engine()``
+    builds (once) a :class:`paddle_tpu.serve.DecodeEngine` over the
+    bundle, ``generate()`` runs continuous-batched greedy decoding, and
+    ``predict(method="prefill"|"decode_step")`` routes through the
+    engine's fixed-shape compiled programs instead of the generic jit
+    path (which would retrace per prompt shape and manage no KV)."""
 
     def __init__(self, model, variables: Dict[str, Any]):
         self.model = model
         self.variables = variables
         self._jitted: Dict[Any, Any] = {}
+        self._engine = None
+        self._unhashable_warned: set = set()
+
+    # -- serving (paddle_tpu.serve) ----------------------------------------
+
+    def engine(self, **engine_kwargs):
+        """The bundle's serving engine, built on first use (kwargs are
+        honored only on that first call — one engine per bundle)."""
+        if self._engine is None:
+            from .serve import DecodeEngine
+            self._engine = DecodeEngine(self.model, self.variables,
+                                        **engine_kwargs)
+        elif engine_kwargs:
+            _log.warning("engine() kwargs ignored — the serve engine was "
+                         "already built for this bundle")
+        return self._engine
+
+    def generate(self, prompts: List[List[int]], max_new_tokens: int,
+                 eos_id: Optional[int] = None,
+                 **engine_kwargs) -> List[List[int]]:
+        """Greedy-decode ``prompts`` through the continuous-batching
+        scheduler; returns generated token lists in submission order."""
+        from .serve import ContinuousBatchingScheduler
+        sched = ContinuousBatchingScheduler(self.engine(**engine_kwargs))
+        reqs = [sched.submit(list(p), max_new_tokens, eos_id=eos_id)
+                for p in prompts]
+        sched.run()
+        return [r.tokens for r in reqs]
+
+    def _serve_predict(self, method: str, *args, **kwargs):
+        """The ``method="prefill"|"decode_step"`` route: a stateful
+        incremental-decode session on the bundle's engine. ``prefill``
+        admits each prompt row into a free slot and returns the first
+        greedy tokens; ``decode_step`` advances one fixed-shape tick and
+        returns the new token front (inactive lanes 0)."""
+        eng = self.engine(**kwargs)
+        if method == "decode_step":
+            return eng.decode_tick()
+        prompts = [list(np.asarray(p).ravel()) for p in args[0]]
+        free = eng.free_slots()
+        if len(prompts) > len(free):
+            raise ValueError(
+                f"{len(prompts)} prompts but only {len(free)} free slots "
+                f"(max_slots={eng.max_slots}); evict or raise max_slots")
+        # a session has no max_new_tokens bound, so reserve each slot's
+        # full context capacity — decode_step may be called until W
+        return np.asarray([eng.admit(slot, [int(t) for t in p],
+                                     reserve_len=eng.context_width)
+                           for slot, p in zip(free, prompts)], np.int32)
+
+    # -- the generic path --------------------------------------------------
 
     def predict(self, *args, method: Optional[str] = None, **kwargs):
         """Run forward (train=False semantics; ``method`` selects an
         alternative entry point such as ``generate``/``decode``). Positional
         args are traced arrays; keyword args are static configuration
-        (beam sizes etc.) and key the jit cache."""
+        (beam sizes etc.) and key the jit cache.
+
+        ``method="prefill"`` / ``"decode_step"`` never take the generic
+        path: they route through the serve engine's fixed-shape compiled
+        programs (see :meth:`_serve_predict`)."""
+        if method in ("prefill", "decode_step"):
+            return self._serve_predict(method, *args, **kwargs)
         model = self.model
         try:
             key = (method, tuple(sorted(kwargs.items())))
@@ -72,6 +147,17 @@ class InferenceModel:
         except TypeError:
             key = None                       # unhashable static kwarg
         if key is None:
+            bad = sorted(k for k, v in kwargs.items()
+                         if not _hashable(v))
+            if tuple(bad) not in self._unhashable_warned:
+                self._unhashable_warned.add(tuple(bad))
+                _log.warning(
+                    "predict kwarg(s) %s are unhashable — falling back to "
+                    "un-jitted model.apply (every call re-traces; no "
+                    "compile cache). Pass hashable statics (tuples, not "
+                    "lists/arrays), or use predict(method=\"prefill\"/"
+                    "\"decode_step\") / generate() for the compiled "
+                    "serving path.", ", ".join(repr(b) for b in bad))
             return model.apply(self.variables, *args, method=method,
                                **kwargs)
         if key not in self._jitted:

@@ -74,14 +74,18 @@ class TransformerBlock(Module):
             self.ffn2 = Linear(dim)
         self.dropout = Dropout(dropout) if dropout else None
 
-    def forward(self, x, train: bool = False, segments=None):
+    def forward(self, x, train: bool = False, segments=None,
+                return_kv: bool = False):
         # named_scope: profiler traces (utils/stats.py:profile_trace) show
         # model structure instead of anonymous fusions — trace-time
         # metadata only, zero runtime effect.
         with jax.named_scope("attn"):
-            h = x + self._maybe_drop(
-                self.attn(self.ln1(x), causal=True, segments=segments),
-                train)
+            a = self.attn(self.ln1(x), causal=True, segments=segments,
+                          return_kv=return_kv)
+            kv = None
+            if return_kv:
+                a, kv = a
+            h = x + self._maybe_drop(a, train)
         if self.residual_sharding is not None:
             h = self.residual_sharding(h)
         with jax.named_scope("ffn"):
@@ -94,7 +98,34 @@ class TransformerBlock(Module):
             out = h + self._maybe_drop(y, train)
         if self.residual_sharding is not None:
             out = self.residual_sharding(out)
+        if return_kv:
+            return out, aux, kv
         return out, aux
+
+    def decode_step(self, x, pages_k, pages_v, tables, positions, active,
+                    attn_impl: str = "xla"):
+        """One serving decode step: the forward block with the attention
+        sublayer swapped for :meth:`MultiHeadAttention.decode` (paged KV
+        scatter + q_len=1 attention). Returns ``(out, pages_k, pages_v)``
+        with this layer's updated pool pages. No dropout — serving is
+        inference-only by construction."""
+        with jax.named_scope("attn"):
+            a, pages_k, pages_v = self.attn.decode(
+                self.ln1(x), pages_k, pages_v, tables, positions, active,
+                impl=attn_impl)
+            h = x + a
+        if self.residual_sharding is not None:
+            h = self.residual_sharding(h)
+        with jax.named_scope("ffn"):
+            z = self.ln2(h)
+            if self.moe_experts > 0:
+                y, _aux = self.ffn(z, return_aux=True)
+            else:
+                y = self.ffn2(self.ffn1(z))
+            out = h + y
+        if self.residual_sharding is not None:
+            out = self.residual_sharding(out)
+        return out, pages_k, pages_v
 
     def _maybe_drop(self, x, train):
         if self.dropout is not None and train:
@@ -184,6 +215,87 @@ class TransformerLM(Module):
         if return_aux:
             return logits, aux_total
         return logits
+
+    # -- serving entry points (paddle_tpu.serve) ---------------------------
+    #
+    # Both run the block stack as ONE lax.scan over the per-block param
+    # subtrees STACKED AT TRACE TIME (the _scan_blocks recipe, minus
+    # checkpoint — no gradients flow here), so the variables tree is the
+    # training tree unchanged: any training checkpoint serves as-is.
+
+    def _stacked_blocks(self):
+        block0 = self.blocks[0]
+        subs = [blk.subtree() for blk in self.blocks]
+        return block0, jax.tree_util.tree_map(lambda *ls: jnp.stack(ls),
+                                              *subs)
+
+    def prefill(self, ids, positions=None):
+        """Serving prefill: ``ids [B, W] -> (logits [B, W, vocab],
+        (k, v))`` where ``k``/``v`` are the per-layer attention
+        projections ``[L, B, W, H, hd]`` — the engine scatters rows
+        ``< length`` into the paged KV cache. ``W`` is the engine's FIXED
+        padded context width: rows past a sequence's true length produce
+        unspecified logits/KV (causal masking keeps them out of every
+        valid row), and running every prefill at one width both pins the
+        compiled shape (no retraces) and keeps each row's softmax
+        reduction width identical to the training forward's — the f32
+        bit-equality contract the serve tests pin."""
+        T = ids.shape[1]
+        assert T <= self.max_len, f"T={T} exceeds max_len={self.max_len}"
+        pos = jnp.arange(T)[None] if positions is None else positions
+        with jax.named_scope("decode/prefill"):
+            with jax.named_scope("embed"):
+                x = self.emb(ids) + self.pos(pos)
+            block0, stacked = self._stacked_blocks()
+
+            def body(h, bp):
+                y, _aux, kv = block0.apply(
+                    {"params": {block0._name: bp}}, h, train=False,
+                    return_kv=True)
+                return y, kv
+
+            with jax.named_scope("block_scan"):
+                x, (ks, vs) = lax.scan(body, x, stacked)
+            with jax.named_scope("head"):
+                logits = self.emb.attend(self.ln_f(x))
+        return logits, (ks, vs)
+
+    def decode_step(self, token, kv, positions, active=None,
+                    attn_impl: str = "xla"):
+        """Serving decode tick: one new token per slot against the paged
+        KV cache. ``token [S]`` int32; ``kv = (pages_k, pages_v,
+        tables)`` with pools ``[L, N, bs, H, hd]`` (the leading layer
+        axis feeds the layer scan) and ``tables [S, MB]``; ``positions
+        [S]`` the incoming token's 0-based position (== pre-step length);
+        ``active [S]`` bool (default: all). Returns ``(logits [S,
+        vocab], kv')`` with the updated pools — same structure, so the
+        engine's jit carry donates cleanly."""
+        pages_k, pages_v, tables = kv
+        S = token.shape[0]
+        if active is None:
+            active = jnp.ones((S,), bool)
+        # inactive slots may carry position 0 forever; the clamp only
+        # guards overflow and is the identity for every valid position
+        pos_idx = jnp.minimum(positions, self.max_len - 1)
+        with jax.named_scope("decode/step"):
+            with jax.named_scope("embed"):
+                x = self.emb(token[:, None]) + self.pos(pos_idx[:, None])
+            block0, stacked = self._stacked_blocks()
+
+            def body(h, xs):
+                bp, pk, pv = xs
+                y, pk, pv = block0.apply(
+                    {"params": {block0._name: bp}}, h, pk, pv, tables,
+                    positions, active, attn_impl=attn_impl,
+                    method="decode_step")
+                return y, (pk, pv)
+
+            with jax.named_scope("block_scan"):
+                x, (pages_k, pages_v) = lax.scan(
+                    body, x, (stacked, pages_k, pages_v))
+            with jax.named_scope("head"):
+                logits = self.emb.attend(self.ln_f(x))
+        return logits[:, 0], (pages_k, pages_v, tables)
 
     def grad_sync_scan_paths(self):
         """The ``parallel.overlap`` in-scan protocol: fnmatch patterns (over

@@ -138,10 +138,13 @@ class MultiHeadAttention(Module):
                 "only; pass kv_in=None or use the XLA path")
 
     def forward(self, q_in, kv_in=None, mask=None, causal: bool = False,
-                segments=None):
+                segments=None, return_kv: bool = False):
         """q_in [B, Tq, D]; kv_in defaults to q_in (self-attention);
         mask [B, Tq, Tk] (1 = attend); segments [B, T] packed-sequence ids
-        (1-based, 0 = padding — ``core.sequence.pack_sequences``)."""
+        (1-based, 0 = padding — ``core.sequence.pack_sequences``).
+        ``return_kv``: also return the projected ``(k, v)`` ([B, Tk, H,
+        hd] each, pre-attention) — the serving prefill captures them into
+        the paged KV cache (``paddle_tpu.serve``)."""
         kv_in = q_in if kv_in is None else kv_in
         pol = current_policy()
         d_model = q_in.shape[-1]
@@ -208,4 +211,94 @@ class MultiHeadAttention(Module):
                 ctx = jnp.einsum("bhqk,bkhd->bqhd", w, v)
         ctx = ctx.reshape(*q_in.shape[:2], h * hd)
         with jax.named_scope("out_proj"):
-            return proj("wo", ctx, out_d)
+            out = proj("wo", ctx, out_d)
+        if return_kv:
+            return out, (k, v)
+        return out
+
+    def decode(self, q_in, pages_k, pages_v, tables, positions, active,
+               impl: str = "xla"):
+        """One decode step (q_len = 1) against a paged KV cache: project
+        the new token, scatter its K/V into this layer's pool pages, and
+        attend over the slot's whole ragged context.
+
+        Args: ``q_in`` [S, 1, D] (one token per serving slot);
+        ``pages_k``/``pages_v`` [N, bs, H, hd] (this layer's pool);
+        ``tables`` [S, MB] block tables; ``positions`` [S] the incoming
+        token's 0-based position (== the pre-step sequence length);
+        ``active`` [S] bool slot mask (inactive slots scatter to the null
+        block and output zeros). ``impl``: ``"paged"`` = the Pallas
+        decode kernel (:func:`~paddle_tpu.nn.pallas_attention.
+        paged_decode_attention`); ``"xla"`` = the gather + masked-softmax
+        reference path, bit-exact (f32) with the training forward at the
+        same padded width. Returns ``(out [S, 1, out_d], pages_k,
+        pages_v)`` with the updated pools.
+
+        Callable outside forward (the ``scope()`` helper-method pattern):
+        the serving engine reaches it via
+        ``model.apply(..., method="decode_step")``."""
+        from ..serve.kv_cache import gather_pages, scatter_token
+        with self.scope():
+            pol = current_policy()
+            d_model = q_in.shape[-1]
+            h = self.num_heads
+            hd = self.head_dim or d_model // h
+            out_d = self.out_dim or d_model
+            S = q_in.shape[0]
+
+            def proj(name, x, feats):
+                w = self.param(name, I.xavier_uniform, (x.shape[-1], feats))
+                return jnp.dot(pol.cast_compute(x), pol.cast_compute(w),
+                               preferred_element_type=pol.accum_dtype)
+
+            with jax.named_scope("qkv_proj"):
+                q = proj("wq", q_in, h * hd).reshape(S, 1, h, hd)
+                k = proj("wk", q_in, h * hd).reshape(S, 1, h, hd)
+                v = proj("wv", q_in, h * hd).reshape(S, 1, h, hd)
+            with jax.named_scope("kv_scatter"):
+                pages_k = scatter_token(pages_k, k[:, 0], tables,
+                                        positions, active)
+                pages_v = scatter_token(pages_v, v[:, 0], tables,
+                                        positions, active)
+            # the new token sees itself: effective length = position + 1
+            eff_len = jnp.where(active, positions + 1, 0)
+            if impl == "paged":
+                from .pallas_attention import paged_decode_attention
+                with jax.named_scope("paged_attention"):
+                    ctx = paged_decode_attention(
+                        q[:, 0], pages_k, pages_v, tables, eff_len)
+                    ctx = ctx.reshape(S, 1, h, hd).astype(pol.compute_dtype)
+            else:
+                # mirrors the forward "sdpa_xla" branch op for op — WITH
+                # the single query row broadcast to all W rows, so every
+                # op in the chain has the training forward's exact shape.
+                # XLA's CPU gemm is row-stable across row counts but the
+                # q_len=1 PV contraction lowers with a DIFFERENT
+                # k-accumulation order (measured: ~1 ulp drift), so
+                # shape-matching is what makes decode logits bit-equal
+                # (f32) to the full-sequence forward's row. O(W^2) — this
+                # is the correctness-oracle path; the paged Pallas kernel
+                # is the decode-shaped production path.
+                with jax.named_scope("sdpa_xla"):
+                    kg = gather_pages(pages_k, tables)      # [S, W, h, hd]
+                    vg = gather_pages(pages_v, tables)
+                    W = kg.shape[1]
+                    qb = jnp.broadcast_to(q, (S, W, h, hd))
+                    logits = jnp.einsum("bqhd,bkhd->bhqk", qb, kg) \
+                        / np.sqrt(hd)
+                    logits = logits.astype(jnp.float32)
+                    mask = jnp.arange(W)[None, :] < eff_len[:, None]
+                    logits = jnp.where(mask[:, None, None, :], logits, -1e9)
+                    w = jax.nn.softmax(logits, axis=-1) \
+                        .astype(pol.compute_dtype)
+                    ctx = jnp.einsum("bhqk,bkhd->bqhd", w, vg)[:, :1]
+                    # a length-0 lane's softmax is uniform over -1e9
+                    # logits (an average of stale pages, not zeros) —
+                    # zero it to match the paged kernel's convention;
+                    # active lanes pass through bit-unchanged
+                    ctx = jnp.where((eff_len > 0)[:, None, None, None],
+                                    ctx, 0.0)
+            ctx = ctx.reshape(S, 1, h * hd)
+            with jax.named_scope("out_proj"):
+                out = proj("wo", ctx, out_d)
+            return out, pages_k, pages_v

@@ -49,7 +49,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "reference_attention"]
+__all__ = ["flash_attention", "reference_attention",
+           "paged_decode_attention", "paged_reference_attention"]
 
 _NEG = -1e30
 
@@ -444,6 +445,135 @@ def _resolve_defaults(q, scale, interpret):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return scale, interpret
+
+
+# ---------------------------------------------------------------------------
+# decode-shaped attention: q_len = 1 over a paged KV cache (serving path)
+# ---------------------------------------------------------------------------
+
+def paged_reference_attention(q, pages_k, pages_v, tables, lengths,
+                              scale: Optional[float] = None):
+    """Numeric oracle for :func:`paged_decode_attention` — gather the
+    block-table pages into position order and run masked softmax
+    attention for the single query token. ``q`` ``[S, H, D]``; pages
+    ``[N, bs, H, D]``; ``tables`` ``[S, MB]``; ``lengths`` ``[S]``
+    (0 = inactive slot -> zero output)."""
+    S, H, D = q.shape
+    bs = pages_k.shape[1]
+    MB = tables.shape[1]
+    W = MB * bs
+    if scale is None:
+        scale = D ** -0.5
+    k = pages_k[tables].reshape(S, W, H, D)
+    v = pages_v[tables].reshape(S, W, H, D)
+    s = jnp.einsum("shd,skhd->shk", q, k) * scale
+    mask = jnp.arange(W)[None] < lengths[:, None]
+    s = jnp.where(mask[:, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)       # length-0 (inactive) rows
+    return jnp.einsum("shk,skhd->shd", p, v)
+
+
+def _paged_decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_s, l_s, acc_s, *, scale, bs):
+    """One (slot, head) row's online softmax over its block table. Grid
+    ``(S, H, MB)``: the innermost axis streams the slot's KV blocks
+    (sequential on TPU — the m/l/acc scratch carries across it), with the
+    pool block resolved by the PREFETCHED block table in the index map,
+    so the DMA fetches exactly the pages the sequence owns."""
+    s_idx = pl.program_id(0)
+    j = pl.program_id(2)
+    nkb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_s[:] = jnp.full(m_s.shape, _NEG, jnp.float32)
+        l_s[:] = jnp.zeros(l_s.shape, jnp.float32)
+        acc_s[:] = jnp.zeros(acc_s.shape, jnp.float32)
+
+    length = len_ref[s_idx]
+
+    # blocks past the sequence length are skipped entirely (an inactive
+    # slot — length 0 — skips every block and writes zeros)
+    @pl.when(j * bs < length)
+    def _():
+        # native-dtype matmul operands + f32 accumulate (see _attn_kernel)
+        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_idx = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s = jnp.where(k_idx < length, s, _NEG)
+        m = m_s[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = m_new
+
+    @pl.when(j == nkb - 1)
+    def _():
+        l = jnp.maximum(l_s[:], 1e-30)
+        o_ref[:] = (acc_s[:] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, pages_k, pages_v, tables, lengths,
+                           scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    """Decode-shaped (q_len = 1) flash attention over a paged KV cache.
+
+    The serving hot op: each active slot attends its single new-token
+    query against the KV blocks its block table names, streaming block by
+    block with the online softmax (lse-correct across the slot's ragged
+    length; within-block tail positions masked). The block table and
+    lengths are SCALAR-PREFETCHED (``pltpu.PrefetchScalarGridSpec``) so
+    the K/V index maps resolve pool pages before each grid step's DMA —
+    the kernel never touches blocks the sequence does not own, which is
+    what makes the pool's ragged sharing free.
+
+    Args: ``q`` ``[S, H, D]`` (slot-major, one token per slot);
+    ``pages_k``/``pages_v`` ``[N, bs, H, D]`` (one layer's pool);
+    ``tables`` ``[S, MB]`` int32; ``lengths`` ``[S]`` int32 — the number
+    of valid tokens INCLUDING the one just scattered; 0 marks an
+    inactive slot (zero output). ``interpret`` defaults to True off-TPU
+    (same contract as :func:`flash_attention`)."""
+    S, H, D = q.shape
+    N, bs, Hk, Dk = pages_k.shape
+    assert (H, D) == (Hk, Dk), f"q heads {(H, D)} != pages {(Hk, Dk)}"
+    MB = tables.shape[1]
+    scale, interpret = _resolve_defaults(q, scale, interpret)
+    q4 = q.reshape(S, H, 1, D)
+
+    def q_map(s, h, j, tbl, lens):
+        return (s, h, 0, 0)
+
+    def kv_map(s, h, j, tbl, lens):
+        return (tbl[s, j], 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, H, MB),
+        in_specs=[
+            pl.BlockSpec((None, None, 1, D), q_map),
+            pl.BlockSpec((None, bs, None, D), kv_map),
+            pl.BlockSpec((None, bs, None, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec((None, None, 1, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale, bs=bs),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, 1, D), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q4, pages_k, pages_v)
+    return out.reshape(S, H, D)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))

@@ -63,6 +63,14 @@ def hloprof_grad_sync_scope() -> str:
 
 _UNSCOPED = "(unscoped)"
 
+# the serving runtime's named-scope root (TransformerLM.prefill /
+# decode_step trace under it): scopes below it are classified as decode
+# work, and the report carries a `decode` roofline aggregate — decode is
+# memory-bound by construction (one token of compute streams the whole
+# parameter set + active KV), and the aggregate's bound says so on the
+# same spec-sheet HBM tables as every other row.
+DECODE_SCOPE = "decode"
+
 
 def _scope_key(scope: Tuple[str, ...]) -> str:
     return "/".join(scope) or _UNSCOPED
@@ -146,6 +154,9 @@ def build_report(analysis: ModuleAnalysis, *,
         est_ms = max(compute_ms, memory_ms)
         scopes.append({
             "scope": key,
+            "region": DECODE_SCOPE if (key == DECODE_SCOPE or
+                                       key.startswith(DECODE_SCOPE + "/"))
+            else None,
             "flops": round(e["flops"]),
             "flops_static": round(e["flops_static"]),
             "flops_frac": round(e["flops"] / flops_total, 4)
@@ -249,6 +260,26 @@ def build_report(analysis: ModuleAnalysis, *,
         } if grad_ar_count else None,
     }
 
+    # -- decode aggregate (serving programs only) ---------------------------
+    decode_rows = [s for s in scopes if s["region"] == DECODE_SCOPE]
+    decode = None
+    if decode_rows:
+        d_flops = sum(s["flops"] for s in decode_rows)
+        d_bytes = sum(s["bytes"] for s in decode_rows)
+        d_comp = d_flops / peak * 1e3
+        d_mem = d_bytes / hbm * 1e3
+        decode = {
+            "flops": round(d_flops),
+            "bytes": round(d_bytes),
+            "est_compute_ms": round(d_comp, 6),
+            "est_memory_ms": round(d_mem, 6),
+            "bound": ("compute" if d_comp >= d_mem else "memory")
+            if (d_comp or d_mem) else "none",
+            "intensity_flops_per_byte": round(d_flops / d_bytes, 3)
+            if d_bytes else None,
+            "scopes": len(decode_rows),
+        }
+
     # -- headline ------------------------------------------------------------
     compute_ms = flops_total / peak * 1e3
     memory_ms = sum(e["bytes"] for e in by_scope.values()) / hbm * 1e3
@@ -279,6 +310,7 @@ def build_report(analysis: ModuleAnalysis, *,
         "scopes": scopes,
         "scope_rollup": {k: round(v) for k, v in sorted(rollup.items())},
         "mfu_gap_rank": mfu_gap_rank,
+        "decode": decode,
         "collectives": collectives,
         "comm": comm,
     }

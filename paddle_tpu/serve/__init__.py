@@ -1,0 +1,26 @@
+"""Serving runtime — continuous batching over a paged KV cache.
+
+The "millions of users" pillar (ROADMAP #1): training produced a
+checkpoint; this package turns it into an incremental-decode server. Three
+layers, mirroring the serving literature the design follows (PAPERS.md
+[S1] PagedAttention, [S2] Orca):
+
+- :mod:`.kv_cache` — the paged KV pool: fixed-size blocks shared by all
+  concurrent sequences, host-side block tables/alloc/free, ``jnp``-pure
+  gather/scatter used by the compiled programs.
+- :mod:`.engine` — :class:`DecodeEngine`: the two compiled fixed-shape
+  programs (padded-width prefill, max-slot decode tick with an active
+  mask), donated KV carry, greedy sampling, retrace accounting.
+- :mod:`.scheduler` — :class:`ContinuousBatchingScheduler`: iteration-
+  level request admission/eviction between decode ticks with per-request
+  TTFT/TPOT telemetry.
+"""
+
+from .kv_cache import (BlockAllocator, PagedKVCache, gather_pages,
+                       scatter_prefill, scatter_token)
+from .engine import DecodeEngine
+from .scheduler import ContinuousBatchingScheduler, Request
+
+__all__ = ["BlockAllocator", "PagedKVCache", "DecodeEngine",
+           "ContinuousBatchingScheduler", "Request", "gather_pages",
+           "scatter_prefill", "scatter_token"]

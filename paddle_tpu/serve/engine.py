@@ -1,0 +1,289 @@
+"""The decode engine — two compiled fixed-shape programs serving any
+number of concurrent ragged requests.
+
+The framework's static-shapes contract ("Static shapes everywhere",
+DESIGN_DECISIONS) is what makes serving latency predictable: a program
+that retraces when a request arrives or finishes pays seconds of XLA
+compile mid-traffic. So the engine compiles exactly TWO programs and
+reuses them for the whole process lifetime:
+
+- **prefill** at the fixed padded width ``[1, W]`` (``W`` = the cache's
+  per-slot context capacity): runs :meth:`TransformerLM.prefill`, writes
+  the prompt's per-layer K/V into the slot's pool pages, and returns the
+  first greedy token. Every prompt, whatever its length, runs this one
+  shape.
+- the **decode tick** at the fixed slot count ``[S]``: one
+  :meth:`TransformerLM.decode_step` over ALL slots with an ``active``
+  mask — empty slots ride along as masked lanes (null-block scatter,
+  zero-length attention), so admissions and evictions between ticks are
+  pure host-side table edits that never change the compiled shape.
+
+The KV pools are the tick's DONATED carry: the pool buffers flip between
+two XLA allocations instead of reallocating per token. Block tables,
+lengths, and the token front are small host-authoritative arrays pushed
+per call (bytes, not megabytes — the pools never cross the host
+boundary).
+
+Sampling is greedy (argmax) — deterministic, which is what lets the serve
+tests pin engine output against the training forward bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .kv_cache import PagedKVCache, scatter_prefill
+
+__all__ = ["DecodeEngine"]
+
+
+def _resolve_attention(attention: str) -> str:
+    """``"auto"`` picks the Pallas paged kernel on TPU and the bit-exact
+    XLA gather path elsewhere (the same auto-select rule as the flash
+    kernels' ``interpret=None``)."""
+    if attention == "auto":
+        return "paged" if jax.default_backend() == "tpu" else "xla"
+    if attention not in ("paged", "xla"):
+        raise ValueError(f"attention must be 'auto'|'paged'|'xla', "
+                         f"got {attention!r}")
+    return attention
+
+
+class DecodeEngine:
+    """Compiled serving runtime for a :class:`~paddle_tpu.models.
+    TransformerLM` checkpoint.
+
+    Args:
+      model: a TransformerLM (homogeneous blocks; any training config —
+        the serve path restacks the per-block params at trace time, so
+        checkpoints are shape-compatible as-is).
+      variables: the model's variables dict (training checkpoint or
+        ``load_inference_model`` output).
+      max_slots: decode-tick batch width S — the max concurrent
+        sequences. Fixed at compile time; empty slots are masked lanes.
+      block_size: KV tokens per pool block. Small blocks waste less on
+        ragged tails but cost more gather indirection; 16 is the
+        conventional sweet spot (DESIGN_DECISIONS PR-9).
+      num_blocks: pool size. Default sizes the pool for full residency
+        (every slot at full context) — shrink it to test admission
+        backpressure.
+      max_blocks_per_seq: per-slot table width; the per-slot context
+        capacity is ``max_blocks_per_seq * block_size`` (defaults to
+        ``model.max_len // block_size``, and must keep the capacity
+        within ``model.max_len`` — positions are embedded).
+      attention: ``"auto" | "paged" | "xla"`` — see
+        :func:`_resolve_attention`.
+      telemetry: optional :class:`paddle_tpu.obs.Telemetry`; the engine
+        emits one ``kind="decode_tick"`` record per tick (dispatch wall,
+        active slots, tokens/sec) and the scheduler adds per-request
+        records through the same object.
+      dtype: KV pool dtype. f32 default matches the projections' f32
+        accumulation under both the f32 and bf16-compute policies.
+    """
+
+    def __init__(self, model, variables, *, max_slots: int = 4,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 max_blocks_per_seq: Optional[int] = None,
+                 attention: str = "auto", telemetry=None,
+                 dtype=jnp.float32):
+        self.model = model
+        self.variables = variables
+        self.telemetry = telemetry
+        self.attention = _resolve_attention(attention)
+        num_layers = len(model.blocks)
+        num_heads = model.blocks[0].attn.num_heads
+        dim = model.emb.dim
+        head_dim = model.blocks[0].attn.head_dim or dim // num_heads
+        if max_blocks_per_seq is None:
+            max_blocks_per_seq = max(1, model.max_len // block_size)
+        if max_blocks_per_seq * block_size > model.max_len:
+            raise ValueError(
+                f"slot capacity {max_blocks_per_seq * block_size} exceeds "
+                f"model.max_len={model.max_len} (positions are embedded)")
+        if num_blocks is None:
+            num_blocks = max_slots * max_blocks_per_seq + 1   # + null block
+        self.cache = PagedKVCache(
+            num_layers, num_heads, head_dim, num_blocks, block_size,
+            max_slots=max_slots, max_blocks_per_seq=max_blocks_per_seq,
+            dtype=dtype)
+        self.max_slots = max_slots
+        # host-authoritative slot state beside the cache's tables/lengths
+        self.active = np.zeros((max_slots,), bool)
+        self.tokens = np.zeros((max_slots,), np.int32)   # next to decode
+        self.ticks = 0
+        self.tokens_generated = 0
+
+        W = self.cache.context_width
+        attn_impl = self.attention
+
+        def prefill_fn(variables, pages_k, pages_v, ids, length, table):
+            # ids [1, W] padded; length [1]; table [1, MB]
+            logits, (ks, vs) = model.apply(variables, ids,
+                                           method="prefill")
+            scat = jax.vmap(scatter_prefill, in_axes=(0, 0, None, None))
+            pages_k = scat(pages_k, ks.astype(pages_k.dtype), table, length)
+            pages_v = scat(pages_v, vs.astype(pages_v.dtype), table, length)
+            last = jnp.take_along_axis(
+                logits, (length - 1)[:, None, None], axis=1)[0, 0]
+            return pages_k, pages_v, jnp.argmax(last).astype(jnp.int32)
+
+        def tick_fn(variables, pages_k, pages_v, tables, lengths, tokens,
+                    active):
+            logits, (pages_k, pages_v, _) = model.apply(
+                variables, tokens, (pages_k, pages_v, tables), lengths,
+                active, attn_impl=attn_impl, method="decode_step")
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return pages_k, pages_v, nxt
+
+        # donate the KV pools: the tick's carry flips between two
+        # allocations instead of growing HBM per token
+        self._prefill_fn = jax.jit(prefill_fn, donate_argnums=(1, 2))
+        self._tick_fn = jax.jit(tick_fn, donate_argnums=(1, 2))
+        self._W = W
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def context_width(self) -> int:
+        return self._W
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Distinct traced programs per entry point — the no-retrace
+        invariant is both == 1 after warmup, across any admit/evict
+        churn (the bench serving gate asserts it)."""
+        return {"prefill": int(self._prefill_fn._cache_size()),
+                "tick": int(self._tick_fn._cache_size())}
+
+    def free_slots(self) -> List[int]:
+        return [s for s in range(self.max_slots) if not self.active[s]]
+
+    def can_admit(self, total_len: int) -> bool:
+        """Whether the pool can host a sequence that may grow to
+        ``total_len`` tokens (prompt + generation budget). Admission
+        reserves the worst case up front so a running request can never
+        strand mid-decode without a block (DESIGN_DECISIONS PR-9)."""
+        return (total_len <= self._W
+                and self.cache.blocks_needed(total_len)
+                <= self.cache.free_blocks)
+
+    # -- request lifecycle -------------------------------------------------
+
+    def stage_prompt(self, prompt: List[int]) -> np.ndarray:
+        """Pad a prompt to the fixed prefill width ``[1, W]`` — pure host
+        work the scheduler runs at SUBMIT time (the PR-3 staging move:
+        admission-path host prep happens off the tick's critical path)."""
+        P = len(prompt)
+        if not 0 < P <= self._W:
+            raise ValueError(f"prompt length {P} not in [1, {self._W}]")
+        ids = np.zeros((1, self._W), np.int32)
+        ids[0, :P] = prompt
+        return ids
+
+    def admit(self, slot: int, prompt: List[int],
+              reserve_len: Optional[int] = None,
+              staged: Optional[np.ndarray] = None) -> int:
+        """Prefill ``prompt`` into ``slot`` and return the first greedy
+        token. ``reserve_len`` (default: prompt length) eagerly allocates
+        blocks for the sequence's full growth target; ``staged`` is an
+        already-padded :meth:`stage_prompt` array."""
+        assert not self.active[slot], f"slot {slot} is occupied"
+        P = len(prompt)
+        target = max(P, reserve_len or P)
+        if not self.cache.ensure_capacity(slot, target):
+            raise RuntimeError(
+                f"KV pool exhausted admitting slot {slot} "
+                f"(need {self.cache.blocks_needed(target)} blocks, "
+                f"{self.cache.free_blocks} free) — gate admissions on "
+                f"can_admit()")
+        ids = staged if staged is not None else self.stage_prompt(prompt)
+        self.cache.k, self.cache.v, tok = self._prefill_fn(
+            self.variables, self.cache.k, self.cache.v,
+            jnp.asarray(ids), jnp.asarray([P], jnp.int32),
+            jnp.asarray(self.cache.tables[slot:slot + 1]))
+        self.cache.lengths[slot] = P
+        self.active[slot] = True
+        self.tokens[slot] = int(tok)
+        return int(tok)
+
+    def evict(self, slot: int) -> None:
+        """Free ``slot``'s blocks back to the pool; the lane masks off at
+        the next tick. Stale pool contents are not wiped (finite, always
+        length-masked) — reuse is a table edit."""
+        self.cache.free_slot(slot)
+        self.active[slot] = False
+        self.tokens[slot] = 0
+
+    def decode_tick(self) -> np.ndarray:
+        """One compiled decode step over every slot. Appends each active
+        slot's pending token to its KV, samples the next greedy token,
+        and returns the new token front ``[S]`` (inactive lanes 0)."""
+        t0 = time.perf_counter()
+        # the new token lands at position lengths[slot]: every active slot
+        # must own that block, or the scatter would silently route to the
+        # null block / clamp onto live data — fail loud instead
+        for slot in np.flatnonzero(self.active):
+            need = self.cache.blocks_needed(int(self.cache.lengths[slot]) + 1)
+            if need > len(self.cache._owned[slot]):
+                raise RuntimeError(
+                    f"slot {slot} decoding past its reservation (length "
+                    f"{int(self.cache.lengths[slot])} needs block {need}, "
+                    f"owns {len(self.cache._owned[slot])}) — admit with a "
+                    f"larger reserve_len or call cache.ensure_capacity")
+        tables, lengths = self.cache.device_tables()
+        self.cache.k, self.cache.v, nxt = self._tick_fn(
+            self.variables, self.cache.k, self.cache.v, tables, lengths,
+            jnp.asarray(self.tokens), jnp.asarray(self.active))
+        # the dispatch is async: host bookkeeping that doesn't need the
+        # sampled tokens runs UNDER the in-flight device call (the PR-3
+        # overlap move at tick scale); np.asarray(nxt) is the drain
+        n_active = int(self.active.sum())
+        self.cache.lengths[self.active] += 1
+        nxt = np.asarray(nxt)
+        self.tokens = np.where(self.active, nxt, 0).astype(np.int32)
+        self.ticks += 1
+        self.tokens_generated += n_active
+        if self.telemetry is not None:
+            wall = time.perf_counter() - t0
+            self.telemetry.emit_event({
+                "kind": "decode_tick", "tick": self.ticks,
+                "active_slots": n_active, "wall_ms": round(wall * 1e3, 4),
+                "tokens_per_sec": round(n_active / wall, 2) if wall else None,
+                "free_blocks": self.cache.free_blocks,
+            })
+        return self.tokens.copy()
+
+    # -- observability -----------------------------------------------------
+
+    def attribution_report(self, emit: bool = True) -> Dict[str, Any]:
+        """MFU-gap attribution of the compiled decode tick (the
+        ``Trainer.attribution_report`` recipe: one AOT
+        ``lower().compile()``, zero executions). Decode is memory-bound —
+        every tick streams the full parameter set and the active KV for
+        one token of compute — and the report's ``decode`` block says so
+        on the spec-sheet HBM tables (``bound="memory"``)."""
+        from ..obs import attribution as attr_lib
+        from ..obs import hloprof
+        from ..obs.telemetry import lowered_hlo_flops
+        tables, lengths = self.cache.device_tables()
+        lowered = self._tick_fn.lower(
+            self.variables, self.cache.k, self.cache.v, tables, lengths,
+            jnp.asarray(self.tokens), jnp.asarray(self.active))
+        compiled = lowered.compile()
+        analysis = hloprof.parse_module(compiled.as_text())
+        report = attr_lib.build_report(
+            analysis,
+            device_kind=getattr(jax.devices()[0], "device_kind", ""),
+            n_devices=1,
+            cost_analysis_flops=lowered_hlo_flops(compiled),
+            meta={"program": "decode_tick", "max_slots": self.max_slots,
+                  "context_width": self._W,
+                  "block_size": self.cache.block_size,
+                  "attention": self.attention})
+        if emit and self.telemetry is not None:
+            self.telemetry.emit_event(report)
+        return report

@@ -1,0 +1,204 @@
+"""Paged KV cache — the serving runtime's device-memory manager.
+
+Long-context serving is memory-bound on the KV cache, and naive per-request
+contiguous allocation at ``max_len`` wastes most of it: concurrent sequences
+have ragged lengths, so reserving the worst case per slot strands HBM
+(PagedAttention's motivating measurement — PAPERS.md [S1]). The fix is the
+OS page-table design: the cache is a single pool of fixed-size **blocks**
+(``[num_blocks, block_size, heads, head_dim]`` per layer) and each sequence
+holds an ordered **block table** of pool indices; allocation is
+block-granular, so waste is bounded by one partial block per sequence and
+freed blocks are immediately reusable by any other request.
+
+Split of responsibilities (the framework's static-shapes contract):
+
+- **Host side, dynamic**: :class:`BlockAllocator` (free-list alloc/free)
+  and :class:`PagedKVCache` (device pools + the authoritative host mirror
+  of block tables and lengths). Admission/eviction mutate ONLY these small
+  host arrays between decode ticks — nothing here is traced.
+- **Device side, pure**: :func:`gather_pages`, :func:`scatter_prefill`,
+  :func:`scatter_token` — ``jnp``-pure gather/scatter the compiled
+  prefill/decode programs call with fixed shapes. Block tables enter the
+  compiled step as ordinary int32 operands, so the program never retraces
+  as sequences come and go.
+
+Block id 0 is reserved as the **null block**: unallocated table entries and
+masked-off scatter rows all target it, so every scatter is total (no
+dynamic shapes, no OOB) and its contents are unspecified-but-finite —
+reads through it are always masked by the length before use.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["BlockAllocator", "PagedKVCache", "gather_pages",
+           "scatter_prefill", "scatter_token", "NULL_BLOCK"]
+
+# block 0 never holds live data: it is the scatter target for padding rows
+# and the gather source for unallocated table entries (always masked)
+NULL_BLOCK = 0
+
+
+# ---------------------------------------------------------------------------
+# device side: jnp-pure gather/scatter (called from compiled programs)
+# ---------------------------------------------------------------------------
+
+def gather_pages(pages, table):
+    """Gather one layer's paged K (or V) into position order.
+
+    ``pages`` ``[N, bs, H, hd]``, ``table`` ``[S, MB]`` int32 ->
+    ``[S, MB*bs, H, hd]``: row ``s``'s tokens ``0..len-1`` in order, with
+    unspecified (null-block / stale) content beyond the sequence length —
+    the attention mask owns that boundary."""
+    S, MB = table.shape
+    _, bs, H, hd = pages.shape
+    return pages[table].reshape(S, MB * bs, H, hd)
+
+
+def scatter_prefill(pages, kv, table, length):
+    """Write a prefill's per-layer K (or V) rows into the paged pool.
+
+    ``kv`` ``[B, W, H, hd]`` holds projections for positions ``0..W-1``
+    (``W`` = the fixed padded prefill width); only rows ``< length`` are
+    live — the rest are routed to the null block. Returns the updated
+    pool. ``table`` ``[B, MB]``, ``length`` ``[B]``."""
+    B, W = kv.shape[:2]
+    bs = pages.shape[1]
+    pos = jnp.arange(W, dtype=jnp.int32)
+    blk = jnp.where(pos[None, :] < length[:, None],
+                    jnp.take_along_axis(table, pos[None, :] // bs, axis=1),
+                    NULL_BLOCK)                                   # [B, W]
+    off = jnp.broadcast_to(pos % bs, (B, W))
+    return pages.at[blk, off].set(kv)
+
+
+def scatter_token(pages, kv, table, position, active):
+    """Write one decode step's per-layer K (or V) for every slot.
+
+    ``kv`` ``[S, H, hd]`` is the new token's projection per slot;
+    ``position`` ``[S]`` the 0-based index it occupies (the sequence
+    length BEFORE this token); inactive slots scatter to the null block.
+    Returns the updated pool."""
+    S = kv.shape[0]
+    bs = pages.shape[1]
+    blk = jnp.where(active,
+                    table[jnp.arange(S), position // bs],
+                    NULL_BLOCK)                                   # [S]
+    off = position % bs
+    return pages.at[blk, off].set(kv)
+
+
+# ---------------------------------------------------------------------------
+# host side: allocation / free (between-tick bookkeeping, never traced)
+# ---------------------------------------------------------------------------
+
+class BlockAllocator:
+    """Free-list allocator over pool block ids ``1..num_blocks-1`` (block 0
+    is the reserved null block). FIFO reuse keeps churn deterministic —
+    tests pin that re-admitted sequences land on recycled blocks."""
+
+    def __init__(self, num_blocks: int):
+        assert num_blocks >= 2, "need at least one non-null block"
+        self.num_blocks = num_blocks
+        self._free = collections.deque(range(1, num_blocks))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` block ids, or None (and no change) if unavailable."""
+        if n > len(self._free):
+            return None
+        return [self._free.popleft() for _ in range(n)]
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            assert b != NULL_BLOCK, "cannot free the null block"
+            self._free.append(b)
+
+
+class PagedKVCache:
+    """Device pools + the authoritative host mirror of block tables and
+    sequence lengths for up to ``max_slots`` concurrent sequences.
+
+    ``k``/``v`` are ``[L, num_blocks, block_size, H, hd]`` device arrays
+    (the leading layer axis matches the model's scan-over-layers stack, so
+    the decode scan consumes one layer's pool per iteration). The compiled
+    tick DONATES and returns them; the engine reassigns ``cache.k/.v``
+    each call. Tables/lengths live here as small host numpy arrays —
+    admission and eviction are plain host mutations between ticks."""
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 num_blocks: int, block_size: int, max_slots: int,
+                 max_blocks_per_seq: int, dtype=jnp.float32):
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.max_slots = max_slots
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.dtype = dtype
+        shape = (num_layers, num_blocks, block_size, num_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.allocator = BlockAllocator(num_blocks)
+        self.tables = np.zeros((max_slots, max_blocks_per_seq), np.int32)
+        self.lengths = np.zeros((max_slots,), np.int32)
+        self._owned: List[List[int]] = [[] for _ in range(max_slots)]
+
+    # -- derived -----------------------------------------------------------
+
+    @property
+    def context_width(self) -> int:
+        """The fixed gather width ``max_blocks_per_seq * block_size`` —
+        the maximum context length a slot can hold, and the padded width
+        every prefill/decode attention runs at."""
+        return self.max_blocks_per_seq * self.block_size
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.num_free
+
+    def blocks_needed(self, length: int) -> int:
+        return -(-length // self.block_size)          # ceil
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    def ensure_capacity(self, slot: int, new_len: int) -> bool:
+        """Grow ``slot``'s block table to cover ``new_len`` tokens.
+        Returns False (and changes nothing) if the pool cannot supply the
+        extra blocks — the scheduler's backpressure signal."""
+        assert new_len <= self.context_width, \
+            f"length {new_len} exceeds slot capacity {self.context_width}"
+        need = self.blocks_needed(new_len) - len(self._owned[slot])
+        if need <= 0:
+            return True
+        got = self.allocator.alloc(need)
+        if got is None:
+            return False
+        start = len(self._owned[slot])
+        self._owned[slot].extend(got)
+        self.tables[slot, start:start + len(got)] = got
+        return True
+
+    def free_slot(self, slot: int) -> None:
+        """Return ``slot``'s blocks to the pool and clear its table row.
+        The pool data itself is NOT zeroed — stale block contents are
+        finite and always masked by length, so reuse is a table update,
+        not a memory wipe (the paged design's whole point)."""
+        if self._owned[slot]:
+            self.allocator.free(self._owned[slot])
+        self._owned[slot] = []
+        self.tables[slot] = NULL_BLOCK
+        self.lengths[slot] = 0
+
+    def device_tables(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """The current (tables, lengths) as device operands for a tick."""
+        return jnp.asarray(self.tables), jnp.asarray(self.lengths)

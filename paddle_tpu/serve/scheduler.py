@@ -1,0 +1,182 @@
+"""Iteration-level (continuous-batching) request scheduling — the Orca
+move (PAPERS.md [S2]): scheduling decisions happen between DECODE TICKS,
+not between whole requests.
+
+Classic static batching gangs requests: a batch runs until its LONGEST
+member finishes, so every short request's slot sits idle (masked lanes
+burning a full tick's work) while the straggler decodes. Iteration-level
+scheduling admits a queued request into a slot the moment one frees and
+evicts a finished request the moment its last token lands — the decode
+tick's fixed ``[S]`` shape never changes (the engine's active mask
+absorbs churn), so the scheduler is pure host bookkeeping between
+compiled calls.
+
+Host/device overlap reuses the PR-3 host-pipeline move at tick scale:
+``decode_tick`` dispatches async, the host does its admission staging
+(prompt padding, table edits) and request bookkeeping UNDER the in-flight
+call, and the token fetch that closes the tick is the drain.
+
+Per-request telemetry (the serving SLO vocabulary): **TTFT** (time to
+first token — submit to prefill's greedy token) and **TPOT** (time per
+output token — mean inter-token gap over the decode ticks), emitted as
+one ``kind="request"`` record per completed request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Request", "ContinuousBatchingScheduler"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its lifecycle timestamps."""
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    submit_ts: float = 0.0
+    first_token_ts: Optional[float] = None
+    finish_ts: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.finish_ts is not None
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_ts is None:
+            return None
+        return (self.first_token_ts - self.submit_ts) * 1e3
+
+    @property
+    def tpot_ms(self) -> Optional[float]:
+        """Mean inter-token time over tokens after the first; None until
+        finished or with a single token."""
+        if self.finish_ts is None or len(self.tokens) < 2:
+            return None
+        return ((self.finish_ts - self.first_token_ts) * 1e3
+                / (len(self.tokens) - 1))
+
+    def record(self) -> Dict[str, Any]:
+        return {
+            "kind": "request", "rid": self.rid,
+            "prompt_len": len(self.prompt),
+            "new_tokens": len(self.tokens),
+            "slot": self.slot,
+            "ttft_ms": round(self.ttft_ms, 4)
+            if self.ttft_ms is not None else None,
+            "tpot_ms": round(self.tpot_ms, 4)
+            if self.tpot_ms is not None else None,
+            "wall_ms": round((self.finish_ts - self.submit_ts) * 1e3, 4)
+            if self.finish_ts else None,
+        }
+
+
+class ContinuousBatchingScheduler:
+    """Drives a :class:`~paddle_tpu.serve.engine.DecodeEngine` over a
+    request queue.
+
+    ``policy="continuous"`` (default) admits between every tick;
+    ``policy="static"`` is the gang baseline — a batch is admitted only
+    when EVERY slot is free and runs until all its members finish (the
+    differential the bench serving gate measures: on ragged lengths
+    continuous wins exactly the idle-lane ticks static burns).
+    """
+
+    def __init__(self, engine, telemetry=None, policy: str = "continuous"):
+        if policy not in ("continuous", "static"):
+            raise ValueError(f"policy must be 'continuous'|'static', "
+                             f"got {policy!r}")
+        self.engine = engine
+        self.telemetry = (telemetry if telemetry is not None
+                          else engine.telemetry)
+        self.policy = policy
+        self.queue: List[Request] = []
+        self.running: Dict[int, Request] = {}       # slot -> request
+        self.completed: List[Request] = []
+        self._rid = itertools.count()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               eos_id: Optional[int] = None) -> Request:
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        req = Request(rid=next(self._rid), prompt=list(prompt),
+                      max_new_tokens=max_new_tokens, eos_id=eos_id,
+                      submit_ts=time.perf_counter())
+        if len(req.prompt) + max_new_tokens > self.engine.context_width:
+            raise ValueError(
+                f"prompt {len(req.prompt)} + max_new_tokens "
+                f"{max_new_tokens} exceeds slot capacity "
+                f"{self.engine.context_width}")
+        # stage the padded prefill array now — admission-path host prep
+        # off the tick loop's critical path (the PR-3 staging move)
+        req._staged = self.engine.stage_prompt(req.prompt)
+        self.queue.append(req)
+        return req
+
+    # -- the tick loop -----------------------------------------------------
+
+    def _admit(self) -> None:
+        if self.policy == "static" and self.running:
+            return                       # gang: wait for the whole batch
+        free = self.engine.free_slots()
+        while self.queue and free:
+            req = self.queue[0]
+            # a decode tick appends the pending token BEFORE sampling, so
+            # the cache must hold prompt + all generated tokens except
+            # the last sampled one: reserve prompt + max_new - 1
+            target = len(req.prompt) + req.max_new_tokens - 1
+            if not self.engine.can_admit(max(target, len(req.prompt))):
+                break                    # pool backpressure: try next tick
+            self.queue.pop(0)
+            slot = free.pop(0)
+            tok = self.engine.admit(slot, req.prompt, reserve_len=target,
+                                    staged=getattr(req, "_staged", None))
+            req.slot = slot
+            req.tokens.append(tok)
+            req.first_token_ts = time.perf_counter()
+            self.running[slot] = req
+            self._maybe_finish(slot, tok)
+
+    def _maybe_finish(self, slot: int, tok: int) -> None:
+        req = self.running[slot]
+        if (len(req.tokens) >= req.max_new_tokens
+                or (req.eos_id is not None and tok == req.eos_id)):
+            req.finish_ts = time.perf_counter()
+            del self.running[slot]
+            self.engine.evict(slot)
+            self.completed.append(req)
+            if self.telemetry is not None:
+                self.telemetry.emit_event(req.record())
+
+    def step(self) -> bool:
+        """Admit, run one decode tick, collect finished requests.
+        Returns True while work remains."""
+        self._admit()
+        if self.running:
+            front = self.engine.decode_tick()
+            for slot, req in list(self.running.items()):
+                tok = int(front[slot])
+                req.tokens.append(tok)
+                self._maybe_finish(slot, tok)
+        return bool(self.queue or self.running)
+
+    def run(self, max_ticks: int = 100000) -> List[Request]:
+        """Drive ticks until the queue drains; returns completed
+        requests in completion order."""
+        for _ in range(max_ticks):
+            if not self.step():
+                break
+        else:
+            raise RuntimeError(f"scheduler did not drain in "
+                               f"{max_ticks} ticks")
+        return self.completed

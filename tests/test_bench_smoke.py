@@ -83,6 +83,25 @@ def test_bench_smoke_cpu_green_and_equal():
     assert ovl["in_scan_rows"] >= 1
     assert ovl["sched_distance_field"] is True
     assert ovl["emitted_records"] == 1
+    # ISSUE 9: the serving gate ran — 8 ragged requests complete under
+    # both policies, the compiled prefill/tick never retrace across
+    # admission/eviction churn, per-request TTFT/TPOT telemetry records
+    # are emitted, continuous batching beats the gang-static baseline on
+    # ragged-length tokens/sec, and the decode tick's attribution
+    # classifies decode/* as memory-bound
+    srv = out["serving"]
+    assert srv["ok"] is True, srv
+    assert srv["continuous"]["completed"] == 8
+    assert srv["static"]["completed"] == 8
+    assert srv["zero_retraces_after_warmup"] is True
+    assert srv["continuous"]["compile_counts"] == {"prefill": 1, "tick": 1}
+    assert srv["continuous"]["request_records"] == 16
+    assert srv["continuous"]["sample_request"]["ttft_ms"] is not None
+    assert srv["continuous"]["sample_request"]["tpot_ms"] is not None
+    assert (srv["continuous"]["tokens_per_sec"]
+            > srv["static"]["tokens_per_sec"])
+    assert srv["continuous"]["ticks"] < srv["static"]["ticks"]
+    assert srv["decode_bound"] == "memory"
 
 
 def _write_bench(tmp_path, name, metrics):
@@ -173,6 +192,22 @@ def test_bench_prep_transformer_fused_builds():
     assert int(state[3]) == 3                    # K steps per call
     assert np.isfinite(float(state[-1]))
     assert meta["units_per_step"] == 3 * 8 * 16
+
+
+def test_bench_serving_child_builds(capsys):
+    """ISSUE 9: the transformer_decode metric child runs at a tiny config
+    — steady-state ticks through the real engine, one compiled program
+    per entry point, sane tokens/sec."""
+    sys.path.insert(0, REPO)
+    import bench
+    bench.run_serving_bench_child(
+        max_slots=2, block_size=4, seq_len=64, dim=32, layers=2, heads=4,
+        vocab=64, prompt_len=8, warmup_ticks=2, timed_ticks=6)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["child"] == "transformer_decode"
+    assert out["decode_tokens_per_sec"] > 0
+    assert out["compile_counts"] == {"prefill": 1, "tick": 1}
+    assert out["context_width"] == 64
 
 
 def test_bench_prep_transformer_dp_overlap_builds():
