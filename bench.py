@@ -1008,6 +1008,14 @@ def run_smoke(K=4, M=2, timing_passes=3):
     serving = run_gate_child("--serving-child")
     serving_ok = serving.get("ok") is True
 
+    # fault-tolerance gate (ISSUE 10): supervised crash/corrupt/preempt
+    # recovery — the supervisor resumes an injected crash, quarantines a
+    # corrupted latest pass and falls back one pass, and a preemption
+    # quiesces mid-pass then resumes, each bit-equal to the
+    # uninterrupted run.
+    faults = run_gate_child("--faults-child")
+    faults_ok = faults.get("ok") is True
+
     out = {
         "metric": "fused_vs_plain_smoke",
         "equal": bool(eq_params and eq_losses),
@@ -1024,13 +1032,14 @@ def run_smoke(K=4, M=2, timing_passes=3):
         "attribution": attribution,
         "overlap": overlap,
         "serving": serving,
+        "faults": faults,
     }
     print(json.dumps(out))
     ok = (out["equal"] and jsonl_ok
           and telemetry["losses_equal_with_telemetry"]
           and pipeline["losses_equal"] and pipeline["overlap_keys_ok"]
           and trace_ok and trace["losses_equal_with_tracer"]
-          and attribution_ok and overlap_ok and serving_ok)
+          and attribution_ok and overlap_ok and serving_ok and faults_ok)
     return 0 if ok else 1
 
 
@@ -1279,6 +1288,131 @@ def run_serving_child():
         "decode_bound": decode_block.get("bound"),
         "decode_intensity_flops_per_byte":
             decode_block.get("intensity_flops_per_byte"),
+        "device": jax.devices()[0].device_kind,
+    }))
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# elastic fault-tolerance gate child (ISSUE 10): supervised crash/corrupt/
+# preempt recovery on CPU, bit-equal to the uninterrupted run
+# ---------------------------------------------------------------------------
+
+def run_faults_child():
+    """The resilience layer's CI gate: a tiny fused transformer training
+    run under ``run_resilient`` with a seeded :class:`FaultSchedule`,
+    three legs —
+
+    - **crash+resume**: an injected crash mid pass 2; the supervisor
+      restarts, ``resume=True`` picks up the newest checkpoint, and the
+      final params are BIT-EQUAL (f32) to the uninterrupted 3-pass run.
+    - **corrupt latest pass**: pass 1's landed checkpoint gets a byte
+      flipped (CRC now stale), then a crash in pass 2; the resume
+      quarantines ``pass-00001`` to ``pass-00001.corrupt`` (never
+      deletes), falls back to pass 0, replays, and still finishes
+      bit-equal.
+    - **preempt mid-pass**: an injected preemption quiesces at the next
+      group boundary, writes a mid-pass checkpoint, and exits with the
+      distinct ``"preempted"`` status; a second supervised run resumes
+      from it and finishes bit-equal.
+
+    Prints the verdict as one JSON line."""
+    import glob
+    import tempfile
+    from paddle_tpu import optim
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.nn import costs
+    from paddle_tpu.train import FaultSchedule, Trainer, run_resilient
+
+    V, T, bs, n_batches = 64, 16, 8, 8
+    rng = np.random.RandomState(0)
+    batches = [{"x": rng.randint(0, V, (bs, T)).astype(np.int32),
+                "y": rng.randint(0, V, (bs, T)).astype(np.int32)}
+               for _ in range(n_batches)]
+    reader = lambda: iter(batches)       # noqa: E731 - deterministic replay
+
+    def make_tr(faults=None):
+        tr = Trainer(
+            model=TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
+                                ffn_hidden=64, max_len=T),
+            loss_fn=lambda out, b: costs.softmax_cross_entropy(
+                out.reshape(-1, V), b["y"].reshape(-1)),
+            optimizer=optim.adam(1e-3), steps_per_call=2, faults=faults)
+        tr.init(jax.random.PRNGKey(0), batches[0])
+        return tr
+
+    def leaves(state):
+        return jax.tree_util.tree_leaves(jax.device_get(state.params))
+
+    def equal(a, b):
+        return all(np.array_equal(np.asarray(x), np.asarray(y))
+                   for x, y in zip(a, b))
+
+    root = tempfile.mkdtemp(prefix="paddle_tpu_faults_")
+    passes, steps_per_pass = 3, n_batches          # M=1: one step per batch
+
+    base = make_tr()
+    base.train(reader, num_passes=passes,
+               checkpoint_dir=os.path.join(root, "base"), log_period=0)
+    p0 = leaves(base.train_state)
+
+    # leg A: crash mid pass 2 -> restart -> resume -> bit-equal. ONE
+    # schedule instance shared across attempts: the one-shot disarm is
+    # what makes the fault transient (a fresh schedule per attempt would
+    # model a deterministic bug — give-up-loud territory).
+    crash_step = 2 * steps_per_pass + 3
+    fs_a = FaultSchedule(crash_at_step=crash_step)
+    res_a = run_resilient(
+        lambda: make_tr(fs_a), reader,
+        checkpoint_dir=os.path.join(root, "crash"), num_passes=passes,
+        log_period=0, backoff_s=0.01)
+    leg_a = {"status": res_a.status, "restarts": res_a.restarts,
+             "params_equal": equal(p0, leaves(res_a.state))}
+
+    # leg B: corrupt pass-1's checkpoint (save idx 1), crash in pass 2 ->
+    # quarantine + fall back one pass -> bit-equal
+    ck_b = os.path.join(root, "corrupt")
+    fs_b = FaultSchedule(corrupt_checkpoint_file=1,
+                         crash_at_step=crash_step)
+    res_b = run_resilient(
+        lambda: make_tr(fs_b), reader,
+        checkpoint_dir=ck_b, num_passes=passes, log_period=0,
+        backoff_s=0.01)
+    leg_b = {"status": res_b.status, "restarts": res_b.restarts,
+             "fallbacks": len(res_b.fallbacks),
+             "corrupt_dirs": len(glob.glob(os.path.join(ck_b,
+                                                        "*.corrupt*"))),
+             "params_equal": equal(p0, leaves(res_b.state))}
+
+    # leg C: preempt mid pass 1 (graceful stop at the group boundary,
+    # quiesced mid-pass checkpoint) -> distinct status -> resume finishes
+    ck_c = os.path.join(root, "preempt")
+    fs_c = FaultSchedule(preempt_at_step=steps_per_pass + 3)
+    res_c1 = run_resilient(
+        lambda: make_tr(fs_c),
+        reader, checkpoint_dir=ck_c, num_passes=passes, saving_period=4,
+        log_period=0, backoff_s=0.01)
+    res_c2 = run_resilient(
+        make_tr, reader, checkpoint_dir=ck_c, num_passes=passes,
+        saving_period=4, log_period=0, backoff_s=0.01)
+    leg_c = {"first_status": res_c1.status,
+             "preempt_next_batch": (res_c1.preempted.next_batch
+                                    if res_c1.preempted else None),
+             "second_status": res_c2.status,
+             "params_equal": equal(p0, leaves(res_c2.state))}
+
+    ok = (leg_a["status"] == "completed" and leg_a["restarts"] == 1
+          and leg_a["params_equal"]
+          and leg_b["status"] == "completed" and leg_b["restarts"] == 1
+          and leg_b["fallbacks"] >= 1 and leg_b["corrupt_dirs"] >= 1
+          and leg_b["params_equal"]
+          and leg_c["first_status"] == "preempted"
+          and leg_c["second_status"] == "completed"
+          and leg_c["params_equal"])
+    print(json.dumps({
+        "child": "faults", "ok": bool(ok),
+        "passes": passes, "steps_per_pass": steps_per_pass,
+        "crash": leg_a, "corrupt": leg_b, "preempt": leg_c,
         "device": jax.devices()[0].device_kind,
     }))
     return 0 if ok else 1
@@ -1697,7 +1831,8 @@ DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_fused",
 _KNOWN_FLAGS = ("--metric", "--child", "--probe", "--n", "--k",
                 "--timed-steps", "--steps-per-call", "--smoke",
                 "--attribution-child", "--overlap-child",
-                "--serving-child", "--compare", "--threshold")
+                "--serving-child", "--faults-child", "--compare",
+                "--threshold")
 
 
 def main():
@@ -1744,6 +1879,9 @@ def main():
 
     if flag("--serving-child", cast=int):
         sys.exit(run_serving_child())
+
+    if flag("--faults-child", cast=int):
+        sys.exit(run_faults_child())
 
     if "--smoke" in args or flag("--smoke", cast=int):
         # CPU mode: the gate must be deterministic and CI-runnable — on any

@@ -187,6 +187,11 @@ class Telemetry:
         # deadline at close — surfaced in summary() so a leak is visible
         # in the run's own output, not only in a log line
         self.stager_leaked = False
+        # bumped by background workers (AsyncCheckpointer's write thread)
+        # when their work fails — the failure also re-raises at the
+        # owner's next fence, but the counter survives into summary()
+        # even when the fence is never reached (interpreter exit)
+        self.background_failures = 0
         self._closed = False
 
     # -- compile / retrace -------------------------------------------------
@@ -383,7 +388,8 @@ class Telemetry:
                "retrace_count": self.retrace_count,
                "hlo_flops_per_call": self.hlo_flops_per_call,
                "peak_bytes": mem,
-               "stager_leaked": self.stager_leaked}
+               "stager_leaked": self.stager_leaked,
+               "background_failures": self.background_failures}
         for s in self.sinks:
             if isinstance(s, InMemorySink) and s.records:
                 steps = s.by_kind("step")

@@ -27,7 +27,9 @@ from .overlap import (Bucket, partition_buckets, sync_tangent,
 from .ring import ring_attention, make_ring_attention
 from .ulysses import ulysses_attention, make_ulysses_attention
 from .multihost import (initialize, is_initialized,
-                        host_sharded_reader, multihost_mesh)
+                        host_sharded_reader, multihost_mesh,
+                        HostHeartbeat, detect_dead_hosts, plan_reform,
+                        reform, ReformPlan)
 from .pipeline import (pipeline_apply, make_pipeline,
                        pipeline_loss_apply, make_pipeline_loss,
                        pipeline_grads_1f1b, make_pipeline_1f1b)
@@ -41,6 +43,8 @@ __all__ = [
     "make_pipeline_1f1b", "pipeline_loss_apply", "make_pipeline_loss",
     "megatron_sp_rules", "make_megatron_sp_lm_apply",
     "is_initialized", "host_sharded_reader", "multihost_mesh",
+    "HostHeartbeat", "detect_dead_hosts", "plan_reform", "reform",
+    "ReformPlan",
     "Bucket", "partition_buckets", "sync_tangent", "mark_buckets",
     "apply_bucket_sync", "sync_scan_slice", "scan_sync_scope",
     "resolve_grad_sync",

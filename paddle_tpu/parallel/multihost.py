@@ -18,13 +18,24 @@ Recovery model: JAX jobs are gang-scheduled, so elastic recovery =
 restart-all + ``Trainer(..., resume=True)`` from the shared checkpoint dir —
 the capability the reference implements with etcd leases and task requeue
 (``go/master/service.go:313``), collapsed into deterministic data + CRC'd
-checkpoints.
+checkpoints. ISSUE 10 adds the missing watchdog half: per-host heartbeat
+files under the (shared) checkpoint root (:class:`HostHeartbeat` — the
+etcd-lease analog done as mtime'd files on the storage every host already
+mounts), :func:`detect_dead_hosts` to probe them, and :func:`plan_reform`
+/ :func:`reform` to rebuild the mesh and data sharding over the SURVIVING
+host count, so a restart-all after host loss resumes with fewer replicas
+instead of hanging on the dead host's collectives.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
+import logging
 import os
-from typing import Callable, Optional
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 
@@ -32,7 +43,11 @@ from ..core import mesh as mesh_lib
 from ..data import reader as reader_lib
 
 __all__ = ["initialize", "is_initialized", "host_sharded_reader",
-           "multihost_mesh"]
+           "multihost_mesh", "HostHeartbeat", "heartbeat_path",
+           "write_heartbeat", "read_heartbeats", "detect_dead_hosts",
+           "ReformPlan", "plan_reform", "reform"]
+
+_log = logging.getLogger("paddle_tpu.multihost")
 
 _initialized = False
 
@@ -87,3 +102,223 @@ def multihost_mesh(**axis_sizes) -> "mesh_lib.Mesh":
     remote-host devices). Axis sizes follow ``core.mesh.make_mesh``; the
     ``data`` axis defaults to all devices."""
     return mesh_lib.make_mesh(axis_sizes or {mesh_lib.DATA_AXIS: -1})
+
+
+# ---------------------------------------------------------------------------
+# dead-host detection + reformed-mesh restart (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+HEARTBEAT_DIRNAME = "heartbeats"
+
+
+def heartbeat_path(root: str, host_id: int) -> str:
+    return os.path.join(root, HEARTBEAT_DIRNAME, f"host-{host_id:05d}.json")
+
+
+def write_heartbeat(root: str, host_id: Optional[int] = None,
+                    seq: int = 0, now: Optional[float] = None) -> str:
+    """Write one heartbeat file atomically (tmp + rename, the checkpoint
+    writer's recipe — a reader never sees a torn beat). The payload
+    carries provenance a watchdog can act on: host id, PID, wall-clock
+    ``ts``, and a monotonically increasing ``seq`` (distinguishes a live
+    host whose clock skews from a dead host whose file merely exists)."""
+    host_id = mesh_lib.host_id() if host_id is None else int(host_id)
+    path = heartbeat_path(root, host_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {"host_id": host_id, "pid": os.getpid(),
+               "ts": time.time() if now is None else float(now),
+               "seq": int(seq)}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_heartbeats(root: str) -> Dict[int, Dict]:
+    """All readable heartbeat payloads under ``root``, keyed by host id,
+    each annotated with ``_mtime`` (the heartbeat FILE's mtime — the
+    shared filesystem's clock, stamped by the storage, not by the
+    writer's possibly-skewed wall clock). Torn/garbled files are skipped
+    (the writer is atomic; garbage means a foreign file), missing dir
+    means no beats yet."""
+    d = os.path.join(root, HEARTBEAT_DIRNAME)
+    if not os.path.isdir(d):
+        return {}
+    out: Dict[int, Dict] = {}
+    for name in sorted(os.listdir(d)):
+        if not (name.startswith("host-") and name.endswith(".json")):
+            continue
+        path = os.path.join(d, name)
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            payload["_mtime"] = os.path.getmtime(path)
+            out[int(payload["host_id"])] = payload
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+def detect_dead_hosts(root: str, timeout_s: float,
+                      expected_hosts: Optional[Sequence[int]] = None,
+                      now: Optional[float] = None) -> List[int]:
+    """Hosts whose heartbeat is stale (older than ``timeout_s``) or
+    missing entirely. ``expected_hosts`` defaults to every host that has
+    EVER beaten under ``root`` (a host that never joined can't be
+    declared dead from silence alone); pass the known topology — e.g.
+    ``range(host_count())`` — to also catch never-joined hosts.
+
+    Clock provenance: in production (``now=None``) staleness is the
+    heartbeat FILE's mtime vs this reader's clock — ONE clock pair per
+    reader, uniform across every host's file, so a beating host with a
+    skewed wall clock can never be declared dead (its payload ``ts``
+    would be skewed; the storage-stamped mtime is not). With an explicit
+    ``now`` (deterministic tests, offline log analysis) the payload
+    ``ts`` is compared instead."""
+    beats = read_heartbeats(root)
+    use_payload_ts = now is not None
+    now = time.time() if now is None else float(now)
+
+    def age(b):
+        return now - float(b["ts"] if use_payload_ts else b["_mtime"])
+
+    expected = (sorted(beats) if expected_hosts is None
+                else sorted(int(h) for h in expected_hosts))
+    return [h for h in expected
+            if h not in beats or age(beats[h]) > timeout_s]
+
+
+class HostHeartbeat:
+    """Keep this host's heartbeat file fresh on a daemon thread (the etcd
+    lease analog: liveness = a recent write to storage every peer can
+    read). ``start()`` beats immediately then every ``interval_s``;
+    ``stop()`` joins the thread. The resilience supervisor starts one per
+    supervised run (``run_resilient(heartbeat_interval_s=...)``); a
+    standalone watchdog combines :func:`detect_dead_hosts` +
+    :func:`plan_reform`."""
+
+    def __init__(self, root: str, interval_s: float = 10.0,
+                 host_id: Optional[int] = None):
+        self.root = root
+        self.interval_s = float(interval_s)
+        self.host_id = mesh_lib.host_id() if host_id is None else int(host_id)
+        self.seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> str:
+        self.seq += 1
+        return write_heartbeat(self.root, self.host_id, seq=self.seq)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.beat()
+            except OSError:
+                # a missed beat must never kill training — the watchdog
+                # treats staleness as the signal, and one blip is below
+                # any sane timeout
+                _log.exception("heartbeat write failed (host %d)",
+                               self.host_id)
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "HostHeartbeat":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"paddle_tpu.multihost.heartbeat-{self.host_id}")
+            self._thread.start()
+        return self
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+@dataclasses.dataclass
+class ReformPlan:
+    """The surviving topology after host loss: who is alive, who is
+    dead, and the contiguous re-ranking the restart uses. Built by
+    :func:`plan_reform` from heartbeat evidence; consumed after
+    restart-all (the new, smaller process set re-initializes
+    ``jax.distributed`` with ``num_processes=len(survivors)`` and each
+    survivor's ``process_id=rank_of[old_id]``)."""
+    survivors: List[int]
+    dead: List[int]
+    rank_of: Dict[int, int]
+
+    @property
+    def host_count(self) -> int:
+        return len(self.survivors)
+
+    def sharded_reader(self, reader_fn: Callable,
+                       host_id: Optional[int] = None) -> Callable:
+        """This survivor's disjoint slice of the global stream under the
+        REFORMED topology — ``sharded(reader, survivors, new_rank)``.
+        The shard boundaries move (items a dead host would have read are
+        redistributed), which is exactly the elastic-resume semantics:
+        fewer replicas, full coverage."""
+        host_id = mesh_lib.host_id() if host_id is None else int(host_id)
+        if host_id not in self.rank_of:
+            raise ValueError(
+                f"host {host_id} is not a survivor of this reform "
+                f"(survivors: {self.survivors}, dead: {self.dead})")
+        return reader_lib.sharded(reader_fn, self.host_count,
+                                  self.rank_of[host_id])
+
+    def mesh(self, **axis_sizes) -> "mesh_lib.Mesh":
+        """Mesh over the surviving processes' devices. Call AFTER
+        restart-all: ``jax.devices()`` then spans exactly the survivors,
+        and the default ``data`` axis absorbs the smaller device count
+        (fewer dp replicas, same program)."""
+        return mesh_lib.make_mesh(axis_sizes or {mesh_lib.DATA_AXIS: -1})
+
+
+def plan_reform(root: str, timeout_s: float,
+                expected_hosts: Optional[Sequence[int]] = None,
+                now: Optional[float] = None) -> ReformPlan:
+    """Decide the post-loss topology from heartbeat evidence: dead =
+    stale/missing beats, survivors = the rest, re-ranked contiguously in
+    old-host-id order (deterministic — every survivor computes the same
+    plan from the same files, no coordinator needed)."""
+    beats = read_heartbeats(root)
+    expected = (sorted(beats) if expected_hosts is None
+                else sorted(int(h) for h in expected_hosts))
+    dead = set(detect_dead_hosts(root, timeout_s,
+                                 expected_hosts=expected, now=now))
+    survivors = [h for h in expected if h not in dead]
+    if not survivors:
+        raise RuntimeError(
+            f"no surviving hosts under {root} (expected {expected}, all "
+            f"heartbeats stale past {timeout_s}s)")
+    return ReformPlan(survivors=survivors, dead=sorted(dead),
+                      rank_of={h: r for r, h in enumerate(survivors)})
+
+
+def reform(root: str, timeout_s: float = 60.0,
+           expected_hosts: Optional[Sequence[int]] = None,
+           **axis_sizes):
+    """One-call reformed-mesh restart for a survivor: probe heartbeats,
+    plan the smaller topology, and return ``(mesh, plan)`` — the mesh
+    over the surviving devices plus the plan whose ``sharded_reader``
+    re-shards the data stream. Combine with ``Trainer(mesh=mesh,
+    resume=True)`` over the shared checkpoint dir for the full
+    restart-all recovery: fewer replicas, same training state."""
+    plan = plan_reform(root, timeout_s, expected_hosts=expected_hosts)
+    if plan.dead:
+        _log.warning(
+            "reforming mesh without dead hosts %s: %d -> %d hosts (data "
+            "re-sharded over the survivors)", plan.dead,
+            len(plan.survivors) + len(plan.dead), len(plan.survivors))
+    return plan.mesh(**axis_sizes), plan
+

@@ -34,16 +34,26 @@ __all__ = ["Request", "ContinuousBatchingScheduler"]
 
 @dataclasses.dataclass
 class Request:
-    """One generation request and its lifecycle timestamps."""
+    """One generation request and its lifecycle timestamps.
+
+    ``deadline_s`` (optional) is a wall-clock budget from submit: a
+    request still running (or still queued) past it is evicted between
+    ticks with ``finish_reason="timeout"`` and its blocks freed — a
+    stuck/long request can no longer occupy a slot and its worst-case
+    block reservation forever (ISSUE 10). ``finish_reason`` is
+    ``"length"`` | ``"eos"`` | ``"timeout"``, surfaced in the
+    per-request telemetry record."""
     rid: int
     prompt: List[int]
     max_new_tokens: int
     eos_id: Optional[int] = None
+    deadline_s: Optional[float] = None
     tokens: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
     submit_ts: float = 0.0
     first_token_ts: Optional[float] = None
     finish_ts: Optional[float] = None
+    finish_reason: Optional[str] = None
 
     @property
     def done(self) -> bool:
@@ -70,6 +80,8 @@ class Request:
             "prompt_len": len(self.prompt),
             "new_tokens": len(self.tokens),
             "slot": self.slot,
+            "finish_reason": self.finish_reason,
+            "deadline_s": self.deadline_s,
             "ttft_ms": round(self.ttft_ms, 4)
             if self.ttft_ms is not None else None,
             "tpot_ms": round(self.tpot_ms, 4)
@@ -90,7 +102,8 @@ class ContinuousBatchingScheduler:
     continuous wins exactly the idle-lane ticks static burns).
     """
 
-    def __init__(self, engine, telemetry=None, policy: str = "continuous"):
+    def __init__(self, engine, telemetry=None, policy: str = "continuous",
+                 clock=time.perf_counter):
         if policy not in ("continuous", "static"):
             raise ValueError(f"policy must be 'continuous'|'static', "
                              f"got {policy!r}")
@@ -98,6 +111,9 @@ class ContinuousBatchingScheduler:
         self.telemetry = (telemetry if telemetry is not None
                           else engine.telemetry)
         self.policy = policy
+        # injectable wall clock: deadlines are tested deterministically
+        # with a fake clock; production uses perf_counter
+        self._clock = clock
         self.queue: List[Request] = []
         self.running: Dict[int, Request] = {}       # slot -> request
         self.completed: List[Request] = []
@@ -106,12 +122,15 @@ class ContinuousBatchingScheduler:
     # -- submission --------------------------------------------------------
 
     def submit(self, prompt: List[int], max_new_tokens: int,
-               eos_id: Optional[int] = None) -> Request:
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Request:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError("deadline_s must be >= 0")
         req = Request(rid=next(self._rid), prompt=list(prompt),
                       max_new_tokens=max_new_tokens, eos_id=eos_id,
-                      submit_ts=time.perf_counter())
+                      deadline_s=deadline_s, submit_ts=self._clock())
         if len(req.prompt) + max_new_tokens > self.engine.context_width:
             raise ValueError(
                 f"prompt {len(req.prompt)} + max_new_tokens "
@@ -124,6 +143,37 @@ class ContinuousBatchingScheduler:
         return req
 
     # -- the tick loop -----------------------------------------------------
+
+    def _finish(self, req: Request, reason: str) -> None:
+        """Common completion path: stamp reason + timestamp, free the
+        slot's blocks (when running), record telemetry."""
+        req.finish_ts = self._clock()
+        req.finish_reason = reason
+        if req.slot is not None and self.running.get(req.slot) is req:
+            del self.running[req.slot]
+            self.engine.evict(req.slot)        # blocks back to the pool
+        self.completed.append(req)
+        if self.telemetry is not None:
+            self.telemetry.emit_event(req.record())
+
+    def _expire(self) -> None:
+        """Deadline sweep, run BETWEEN ticks (the same boundary where
+        admissions/evictions already happen — the compiled tick shape
+        never changes). A running slot past its deadline is evicted and
+        its block reservation freed; a queued request past its deadline
+        is dropped before ever taking a slot."""
+        now = self._clock()
+
+        def expired(req):
+            return (req.deadline_s is not None
+                    and now - req.submit_ts > req.deadline_s)
+
+        for slot, req in list(self.running.items()):
+            if expired(req):
+                self._finish(req, "timeout")
+        for req in [r for r in self.queue if expired(r)]:
+            self.queue.remove(req)
+            self._finish(req, "timeout")
 
     def _admit(self) -> None:
         if self.policy == "static" and self.running:
@@ -143,24 +193,21 @@ class ContinuousBatchingScheduler:
                                     staged=getattr(req, "_staged", None))
             req.slot = slot
             req.tokens.append(tok)
-            req.first_token_ts = time.perf_counter()
+            req.first_token_ts = self._clock()
             self.running[slot] = req
             self._maybe_finish(slot, tok)
 
     def _maybe_finish(self, slot: int, tok: int) -> None:
         req = self.running[slot]
-        if (len(req.tokens) >= req.max_new_tokens
-                or (req.eos_id is not None and tok == req.eos_id)):
-            req.finish_ts = time.perf_counter()
-            del self.running[slot]
-            self.engine.evict(slot)
-            self.completed.append(req)
-            if self.telemetry is not None:
-                self.telemetry.emit_event(req.record())
+        if req.eos_id is not None and tok == req.eos_id:
+            self._finish(req, "eos")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._finish(req, "length")
 
     def step(self) -> bool:
-        """Admit, run one decode tick, collect finished requests.
-        Returns True while work remains."""
+        """Expire deadlines, admit, run one decode tick, collect
+        finished requests. Returns True while work remains."""
+        self._expire()
         self._admit()
         if self.running:
             front = self.engine.decode_tick()

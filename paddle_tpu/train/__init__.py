@@ -1,7 +1,14 @@
 """Training driver, events, evaluators, checkpointing (successor of
-paddle/trainer, v2 SGD event loop, gserver evaluators, ParamUtil checkpoints)."""
+paddle/trainer, v2 SGD event loop, gserver evaluators, ParamUtil checkpoints)
+— plus the elastic fault-tolerance layer (ISSUE 10): the restart
+supervisor, preemption handling, and the deterministic fault-injection
+plane."""
 
-from . import checkpoint, events, evaluators
+from . import checkpoint, events, evaluators, faults, resilience
 from .evaluators import (Auc, ChunkEvaluator, ClassificationError, Evaluator,
                          EvaluatorSet, PnPair, PrecisionRecall, RankAuc)
+from .faults import (FaultSchedule, InjectedCrash, InjectedFault,
+                     InjectedSaveError, Preempted)
+from .resilience import (RunResult, SupervisorGaveUp, classify_failure,
+                         install_preemption_handler, run_resilient)
 from .trainer import Trainer, TrainState

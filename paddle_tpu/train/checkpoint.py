@@ -15,22 +15,37 @@ Multi-host: only process 0 writes (single-controller pattern); all hosts read.
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import json
+import logging
 import os
 import shutil
+import time
+import zipfile
 import zlib
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 import jax
 
-__all__ = ["save_checkpoint", "load_checkpoint", "latest_pass", "pass_dir",
-           "atomic_dir", "write_manifest", "verify_manifest",
-           "AsyncCheckpointer"]
+__all__ = ["save_checkpoint", "load_checkpoint", "load_latest_valid",
+           "latest_pass", "pass_dir", "atomic_dir", "write_manifest",
+           "verify_manifest", "quarantine_pass_dir",
+           "CorruptCheckpointError", "AsyncCheckpointer"]
+
+_log = logging.getLogger("paddle_tpu.checkpoint")
 
 _MANIFEST = "manifest.json"
+
+
+class CorruptCheckpointError(IOError):
+    """A pass dir whose manifest/CRC integrity check failed (missing
+    file, CRC mismatch, colliding entries). An ``IOError`` subclass so
+    pre-fallback callers that caught ``IOError`` still do; the fallback
+    chain (:func:`load_latest_valid`) and the resilience supervisor
+    catch it specifically to quarantine-and-fall-back instead of dying."""
 
 
 @contextlib.contextmanager
@@ -127,11 +142,11 @@ def verify_manifest(d: str, verify_crc: bool = True) -> Dict[str, Any]:
         elif os.path.exists(os.path.join(d, f + ".npz")):
             resolved = f + ".npz"
         else:
-            raise IOError(
+            raise CorruptCheckpointError(
                 f"checkpoint {d} is missing file for manifest entry {f!r} "
                 f"(neither {f!r} nor {f + '.npz'!r} exists)")
         if resolved in normalized:
-            raise IOError(
+            raise CorruptCheckpointError(
                 f"checkpoint {d}: manifest entries collide on {resolved!r} "
                 f"after legacy-name normalisation")
         normalized[resolved] = info
@@ -139,7 +154,8 @@ def verify_manifest(d: str, verify_crc: bool = True) -> Dict[str, Any]:
     if verify_crc:
         for fname, info in manifest["files"].items():
             if _file_crc(os.path.join(d, fname)) != info["crc32"]:
-                raise IOError(f"crc mismatch in {os.path.join(d, fname)}")
+                raise CorruptCheckpointError(
+                    f"crc mismatch in {os.path.join(d, fname)}")
     return manifest
 
 
@@ -211,28 +227,45 @@ def _snapshot_host(tree: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def _write_pass_dir(root: str, pass_id: int, tree: Dict[str, Any],
-                    keep_last: Optional[int] = None) -> str:
+                    keep_last: Optional[int] = None, faults=None) -> str:
     """The disk half of a save (CRC + npz write + swap + gc). Snapshots
     each collection to host right before writing it, so the sync path holds
     at most ONE collection in host memory at a time; the async path passes
-    pre-snapshotted numpy (``np.asarray`` is then a no-op)."""
+    pre-snapshotted numpy (``np.asarray`` is then a no-op).
+
+    ``faults``: optional :class:`~paddle_tpu.train.faults.FaultSchedule` —
+    the checkpoint writer's two injection points (``fail_save_at`` before
+    any byte is written; ``slow_save``/``corrupt_checkpoint_file`` after
+    the atomic swap). None (the default) is the exact pre-faults path."""
+    fault_idx = faults.on_write_begin(pass_id) if faults is not None else None
     final = pass_dir(root, pass_id)
     with atomic_dir(final) as tmp:
         for coll, sub in tree.items():
             host = jax.tree_util.tree_map(lambda x: np.asarray(x), sub)
             np.savez(os.path.join(tmp, f"{coll}.npz"), **_flatten(host))
         write_manifest(tmp, {"pass_id": pass_id})
+    if faults is not None:
+        faults.on_write_complete(final, pass_id, fault_idx)
     if keep_last:
         _gc(root, keep_last)
     return final
 
 
 def save_checkpoint(root: str, pass_id: int, tree: Dict[str, Any],
-                    keep_last: Optional[int] = None) -> str:
+                    keep_last: Optional[int] = None, faults=None) -> str:
     """Atomically write ``tree`` (a dict of collections) to pass-NNNNN/."""
     if jax.process_index() != 0:
         return pass_dir(root, pass_id)
-    return _write_pass_dir(root, pass_id, tree, keep_last)
+    return _write_pass_dir(root, pass_id, tree, keep_last, faults=faults)
+
+
+def _dir_bytes(d: str) -> int:
+    try:
+        return sum(os.path.getsize(os.path.join(d, f))
+                   for f in os.listdir(d)
+                   if os.path.isfile(os.path.join(d, f)))
+    except OSError:
+        return 0
 
 
 class AsyncCheckpointer:
@@ -247,12 +280,44 @@ class AsyncCheckpointer:
     that must observe a consistent training state), then hands the CRC +
     npz write + atomic swap to a single background thread. The next
     ``save()`` — or ``wait()`` / context exit — fences the in-flight write;
-    a background failure re-raises at that fence."""
+    a background failure re-raises at that fence.
 
-    def __init__(self):
+    Args:
+      telemetry: optional :class:`paddle_tpu.obs.Telemetry`. Each landed
+        save emits one ``kind="checkpoint"`` record (pass_id, host
+        snapshot ms, background write ms, bytes on disk, and the backlog
+        ms the save spent fenced behind the previous in-flight write) —
+        emitted from the worker thread, which the PR-4 sink
+        thread-safety contract allows. A background write failure
+        increments ``telemetry.background_failures`` (surfaced in
+        ``Telemetry.summary()``) before re-raising at the fence.
+      faults: optional :class:`~paddle_tpu.train.faults.FaultSchedule`,
+        threaded into the background ``_write_pass_dir`` so save-path
+        faults (fail/slow/corrupt) fire where real ones would — in the
+        worker, surfacing at the next fence.
+
+    Shutdown safety: construction registers an ``atexit`` final
+    ``wait()`` (unregistered by ``close()``), so an interpreter exit
+    that skipped ``close()`` still fences the in-flight write instead of
+    truncating it — and surfaces its error in the log rather than
+    swallowing it with the process."""
+
+    def __init__(self, telemetry=None, faults=None):
         self._pool = ThreadPoolExecutor(max_workers=1,
                                         thread_name_prefix="ckpt")
         self._pending = None
+        self.telemetry = telemetry
+        self.faults = faults
+        atexit.register(self._atexit_wait)
+
+    def _atexit_wait(self) -> None:
+        """Interpreter-exit safety net: fence the in-flight write; log
+        (never raise) its failure — there is no caller left to catch."""
+        try:
+            self.wait()
+        except Exception:
+            _log.exception("async checkpoint write failed at interpreter "
+                           "exit (the checkpoint did not land)")
 
     def wait(self) -> None:
         """Block until the in-flight write (if any) lands; re-raise its
@@ -261,20 +326,53 @@ class AsyncCheckpointer:
             fut, self._pending = self._pending, None
             fut.result()
 
+    def _write_job(self, root: str, pass_id: int, host: Dict[str, Any],
+                   keep_last: Optional[int], snapshot_s: float,
+                   backlog_s: float) -> str:
+        """The background half: write + telemetry record; a failure
+        bumps the background-failure counter, then re-raises into the
+        future (surfacing at the caller's next fence)."""
+        t0 = time.perf_counter()
+        try:
+            final = _write_pass_dir(root, pass_id, host, keep_last,
+                                    faults=self.faults)
+        except BaseException:
+            if self.telemetry is not None:
+                self.telemetry.background_failures += 1
+            raise
+        if self.telemetry is not None:
+            self.telemetry.emit_event({
+                "kind": "checkpoint", "pass_id": pass_id,
+                "snapshot_ms": round(snapshot_s * 1e3, 3),
+                "write_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                "bytes": _dir_bytes(final),
+                "backlog_ms": round(backlog_s * 1e3, 3),
+                "async": True})
+        return final
+
     def save(self, root: str, pass_id: int, tree: Dict[str, Any],
              keep_last: Optional[int] = None) -> str:
         if jax.process_index() != 0:
             return pass_dir(root, pass_id)
+        t0 = time.perf_counter()
         self.wait()                        # fence the previous save
+        backlog_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
         host = _snapshot_host(tree)
-        self._pending = self._pool.submit(_write_pass_dir, root, pass_id,
-                                          host, keep_last)
+        snapshot_s = time.perf_counter() - t1
+        self._pending = self._pool.submit(
+            self._write_job, root, pass_id, host, keep_last, snapshot_s,
+            backlog_s)
         return pass_dir(root, pass_id)
 
     def close(self) -> None:
         try:
             self.wait()
         finally:
+            try:
+                atexit.unregister(self._atexit_wait)
+            except Exception:       # pragma: no cover - interpreter teardown
+                pass
             self._pool.shutdown(wait=True)
 
     def __enter__(self):
@@ -333,3 +431,86 @@ def load_checkpoint(root: str, pass_id: Optional[int] = None,
             out[fname[:-len(".npz")]] = _unflatten({k: z[k] for k in z.files})
     out["pass_id"] = manifest["pass_id"]
     return out
+
+
+# -- fallback chain (ISSUE 10) ----------------------------------------------
+
+def quarantine_pass_dir(d: str) -> str:
+    """Move a bad checkpoint dir aside to ``d + '.corrupt'`` — NEVER
+    delete it (the bytes are forensic evidence, and deletion would turn a
+    bad checksum into an unexplainable gap). The ``.corrupt`` name parses
+    to no pass id, so quarantined dirs are invisible to
+    :func:`latest_pass`, :func:`_resolve_pass_dir`, and retention
+    (``_gc`` leaves unparsable names alone). Returns the quarantine
+    path."""
+    target, k = d + ".corrupt", 1
+    while os.path.exists(target):
+        k += 1
+        target = f"{d}.corrupt{k}"
+    os.rename(d, target)
+    _log.warning(
+        "quarantined corrupt checkpoint dir %s -> %s; falling back to the "
+        "previous readable pass (inspect or delete the quarantined copy "
+        "manually)", d, target)
+    return target
+
+
+# what a poisoned pass dir can raise at load: integrity failures
+# (CorruptCheckpointError is an OSError), truncated/garbled npz (BadZipFile,
+# ValueError), a garbled manifest (json errors are ValueError), or a
+# manifest missing its keys (KeyError)
+_LOAD_ERRORS = (OSError, ValueError, KeyError, zipfile.BadZipFile)
+
+
+def load_latest_valid(root: str, verify_crc: bool = True) -> Dict[str, Any]:
+    """Load the NEWEST READABLE checkpoint, quarantining every poisoned
+    pass dir met on the way (the recovery half of the Go pserver's CRC
+    story: it *wrote* checksums; this is what a reader does when one
+    fails). Each failing dir is renamed aside via
+    :func:`quarantine_pass_dir` — never silently deleted — and the chain
+    falls back to the previous readable pass. Raises
+    ``FileNotFoundError`` when nothing readable remains.
+
+    The returned dict is ``load_checkpoint``'s, plus ``_quarantined``:
+    the list of quarantine paths created (empty on the clean path) —
+    ``Trainer.restore`` pops it into ``trainer.last_quarantined`` so the
+    supervisor can count fallbacks.
+
+    Multi-reader safe: on a shared checkpoint root, another host may
+    quarantine (or retention may delete) the dir between our
+    ``latest_pass`` probe and the load — a VANISHED dir is re-scanned,
+    not treated as corruption, so every host racing the same poison
+    converges on the same fallback pass instead of one of them dying
+    (or restarting from scratch) on the other's rename."""
+    quarantined: List[str] = []
+    # every iteration either quarantines a dir, or re-scans after one
+    # vanished — both strictly shrink the candidate set, but bound the
+    # loop anyway so a pathological writer can't spin us forever
+    for _ in range(10000):
+        pid = latest_pass(root)
+        if pid is None:
+            err = FileNotFoundError(
+                f"no readable checkpoints under {root}"
+                + (f" ({len(quarantined)} quarantined: {quarantined})"
+                   if quarantined else ""))
+            err.quarantined = quarantined     # the ledger survives the raise
+            raise err
+        d = _resolve_pass_dir(root, pid)
+        try:
+            out = load_checkpoint(root, pid, verify_crc=verify_crc)
+            out["_quarantined"] = quarantined
+            return out
+        except _LOAD_ERRORS as e:
+            if not os.path.isdir(d):
+                # a concurrent actor moved it (another host's quarantine,
+                # retention gc) — the next probe sees the new state
+                _log.warning(
+                    "checkpoint pass %d vanished mid-read (%s: %s) — "
+                    "re-scanning %s", pid, type(e).__name__, e, root)
+                continue
+            _log.warning("checkpoint pass %d failed to load (%s: %s)",
+                         pid, type(e).__name__, e)
+            quarantined.append(quarantine_pass_dir(d))
+    raise RuntimeError(
+        f"load_latest_valid({root!r}) did not converge after 10000 "
+        f"attempts — a writer is racing the reader pathologically")

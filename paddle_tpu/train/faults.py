@@ -1,0 +1,242 @@
+"""Deterministic fault injection for the training loop (ISSUE 10).
+
+The recovery path is only trustworthy if it is *continuously tested* —
+the lesson of Gemini's fast-recovery work (PAPERS.md [R1]) and of elastic
+systems like Bamboo [R2], and the reason the reference fork shipped a
+fault-tolerant Go pserver with its own failure drills (PAPER.md,
+``go/pserver``). A recovery path exercised only by real outages is a
+recovery path that rots. This module is the injection plane those tests
+drive: a :class:`FaultSchedule` is a seeded, step-indexed description of
+*which* named injection point fires *when*, threaded through the Trainer,
+the checkpoint writer, and the host-pipeline stager.
+
+Design rules:
+
+- **Off by default, zero overhead when off.** ``Trainer(faults=None)``
+  takes the exact pre-PR hot loop: every injection point is behind an
+  ``if faults is not None`` host-side check, no traced-step change, no
+  extra dispatch or fence (``tests/test_resilience.py`` pins this in the
+  PR-2/4/6 style).
+- **Deterministic.** Points are keyed by optimizer step, save index, or
+  group start — never wall clock — so a seeded schedule reproduces the
+  same failure in every run, and the kill-anywhere sweep can assert
+  bit-equality against the uninterrupted run.
+- **One-shot.** Each armed point fires once and disarms (recorded in
+  :attr:`FaultSchedule.fired`), so the supervisor's retry of the same
+  work proceeds — the injected fault models a transient event (a
+  preemption, a flaky disk), not a permanent defect.
+
+Injection points (who checks them):
+
+- ``crash_at_step`` — Trainer, after the host replay of that optimizer
+  step: raises :class:`InjectedCrash` (simulated process death mid-pass).
+- ``preempt_at_step`` — Trainer, same spot: requests a graceful stop
+  (the SIGTERM path without a signal), which quiesces and raises
+  :class:`Preempted` at the next group boundary.
+- ``fail_save_at`` — ``checkpoint._write_pass_dir``, before any byte is
+  written on the N-th save: raises :class:`InjectedSaveError` (the save
+  never lands; recovery resumes from the previous pass).
+- ``corrupt_checkpoint_file`` — after the N-th save's atomic swap: flips
+  one byte in the pass dir's first ``.npz`` so its manifest CRC no
+  longer matches (latent corruption the fallback chain must catch).
+- ``slow_save`` — after the N-th save's swap: sleeps, exercising the
+  drain/fence behavior around a long in-flight async write.
+- ``stager_error_at_group`` — the host-pipeline stager thread, at the
+  group starting at that batch index: raises in the worker, surfacing
+  through ``GroupStager``'s producer-error propagation.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["FaultSchedule", "InjectedFault", "InjectedCrash",
+           "InjectedSaveError", "Preempted"]
+
+_log = logging.getLogger("paddle_tpu.faults")
+
+
+class InjectedFault(RuntimeError):
+    """Base class for every scheduled fault (so tests and classifiers can
+    tell injected failures from organic ones)."""
+
+
+class InjectedCrash(InjectedFault):
+    """Simulated process death at an optimizer step (``crash_at_step``)."""
+
+    def __init__(self, msg: str, step: Optional[int] = None):
+        super().__init__(msg)
+        self.step = step
+
+
+class InjectedSaveError(OSError):
+    """Simulated I/O failure inside the checkpoint write path
+    (``fail_save_at``) — an OSError so the supervisor classifies it as
+    transient, like a real flaky disk."""
+
+
+class Preempted(Exception):
+    """Graceful-stop exit status: SIGTERM/SIGINT (or an injected
+    preemption) was handled at a group boundary — the host pipeline was
+    drained, a quiesced checkpoint was written, and training exited
+    cleanly mid-run. The supervisor treats this as a CLEAN exit (status
+    ``"preempted"``), never as a failure to retry."""
+
+    def __init__(self, pass_id: int, next_batch: int, reason: str = ""):
+        super().__init__(
+            f"training preempted at pass {pass_id} batch {next_batch}"
+            + (f" ({reason})" if reason else ""))
+        self.pass_id = pass_id
+        self.next_batch = next_batch
+        self.reason = reason
+
+
+class FaultSchedule:
+    """A seeded, step-indexed fault plan (see module docstring for the
+    points). All indices are 0-based except optimizer steps, which follow
+    the trainer's 1-based host step count. Thread-safe: the save and
+    stager points fire from worker threads.
+
+    Args:
+      seed: folds into nothing today but names the run (recorded in
+        ``describe()``) — schedules are fully deterministic by
+        construction, the seed exists so sweeps can label themselves.
+      crash_at_step: optimizer step (int) after whose host replay an
+        :class:`InjectedCrash` raises.
+      preempt_at_step: optimizer step at which a graceful stop is
+        requested (handled at the next group boundary).
+      fail_save_at: save index (0-based count of checkpoint writes
+        through this schedule) whose write raises
+        :class:`InjectedSaveError` before writing anything.
+      corrupt_checkpoint_file: save index whose landed pass dir gets one
+        byte flipped in its first ``.npz`` (CRC now mismatches).
+      slow_save: ``(save_index, seconds)`` — that save sleeps after its
+        swap (worker thread for async saves).
+      stager_error_at_group: pass-relative batch index of the group
+        whose staging raises :class:`InjectedFault` in the stager
+        thread.
+    """
+
+    def __init__(self, seed: int = 0, *,
+                 crash_at_step: Optional[int] = None,
+                 preempt_at_step: Optional[int] = None,
+                 fail_save_at: Optional[int] = None,
+                 corrupt_checkpoint_file: Optional[int] = None,
+                 slow_save: Optional[Tuple[int, float]] = None,
+                 stager_error_at_group: Optional[int] = None):
+        self.seed = int(seed)
+        self.crash_at_step = crash_at_step
+        self.preempt_at_step = preempt_at_step
+        self.fail_save_at = fail_save_at
+        self.corrupt_checkpoint_file = corrupt_checkpoint_file
+        self.slow_save = slow_save
+        self.stager_error_at_group = stager_error_at_group
+        self._lock = threading.Lock()
+        self._save_count = 0
+        # (point, key) tuples, in firing order — the sweep's assertions
+        # and the supervisor's failure signatures both read this
+        self.fired: List[Tuple[str, Any]] = []
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _fire_once(self, point: str, key) -> bool:
+        """Record ``(point, key)`` and return True exactly once."""
+        with self._lock:
+            if (point, key) in self.fired:
+                return False
+            self.fired.append((point, key))
+        _log.warning("fault injection: %s fired (key=%r)", point, key)
+        return True
+
+    def describe(self) -> Dict[str, Any]:
+        return {"seed": self.seed,
+                "crash_at_step": self.crash_at_step,
+                "preempt_at_step": self.preempt_at_step,
+                "fail_save_at": self.fail_save_at,
+                "corrupt_checkpoint_file": self.corrupt_checkpoint_file,
+                "slow_save": self.slow_save,
+                "stager_error_at_group": self.stager_error_at_group,
+                "fired": list(self.fired)}
+
+    # -- trainer step points -------------------------------------------------
+
+    def maybe_crash_step(self, step: int) -> None:
+        """Raise :class:`InjectedCrash` when ``step`` is the armed crash
+        step (one-shot). Called by the trainer after each optimizer
+        step's host replay."""
+        if self.crash_at_step is not None and step == self.crash_at_step \
+                and self._fire_once("crash_at_step", step):
+            raise InjectedCrash(f"injected crash at step {step}", step=step)
+
+    def should_preempt(self, step: int) -> bool:
+        """True (once) when ``step`` is the armed preemption step."""
+        return (self.preempt_at_step is not None
+                and step == self.preempt_at_step
+                and self._fire_once("preempt_at_step", step))
+
+    # -- checkpoint-writer points --------------------------------------------
+
+    def on_write_begin(self, pass_id: int) -> int:
+        """Called by ``checkpoint._write_pass_dir`` before any write.
+        May raise :class:`InjectedSaveError` — the save never lands.
+        Returns this save's index (passed back to
+        :meth:`on_write_complete`)."""
+        with self._lock:
+            idx = self._save_count
+            self._save_count += 1
+        if self.fail_save_at is not None and idx == self.fail_save_at \
+                and self._fire_once("fail_save_at", idx):
+            raise InjectedSaveError(
+                f"injected save failure (save #{idx}, pass {pass_id})")
+        return idx
+
+    def on_write_complete(self, final_dir: str, pass_id: int,
+                          idx: int) -> None:
+        """Called after the atomic swap landed ``final_dir``. May sleep
+        (``slow_save``) or flip a byte in the pass's first ``.npz``
+        (``corrupt_checkpoint_file``)."""
+        if self.slow_save is not None and idx == self.slow_save[0] \
+                and self._fire_once("slow_save", idx):
+            time.sleep(float(self.slow_save[1]))
+        if self.corrupt_checkpoint_file is not None \
+                and idx == self.corrupt_checkpoint_file \
+                and self._fire_once("corrupt_checkpoint_file", idx):
+            corrupt_one_file(final_dir)
+
+    # -- stager point --------------------------------------------------------
+
+    def maybe_stager_error(self, buf_start: int) -> None:
+        """Raise in the stager thread for the group starting at
+        ``buf_start`` (one-shot)."""
+        if self.stager_error_at_group is not None \
+                and buf_start == self.stager_error_at_group \
+                and self._fire_once("stager_error_at_group", buf_start):
+            raise InjectedFault(
+                f"injected stager error at group {buf_start}")
+
+
+def corrupt_one_file(pass_dir: str) -> Optional[str]:
+    """Flip one byte mid-file in ``pass_dir``'s first ``.npz`` — the
+    manifest CRC (computed before the flip) no longer matches, so the
+    next load raises and the fallback chain must quarantine. Returns the
+    corrupted path (None when the dir holds no ``.npz``)."""
+    for name in sorted(os.listdir(pass_dir)):
+        if not name.endswith(".npz"):
+            continue
+        path = os.path.join(pass_dir, name)
+        size = os.path.getsize(path)
+        if size == 0:
+            continue
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+        _log.warning("fault injection: corrupted %s (byte %d flipped)",
+                     path, size // 2)
+        return path
+    return None

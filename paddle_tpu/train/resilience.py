@@ -1,0 +1,281 @@
+"""Elastic fault tolerance: the preemption-aware training supervisor
+(ISSUE 10).
+
+The reference fork's answer to fleet-scale flakiness was a dedicated
+fault-tolerant tier — the Go pserver/master with etcd leases, CRC'd
+checkpoints, and task requeue (PAPER.md, ``go/``). TPU-native, the
+pserver tier is gone (gradients move inside the compiled step), so what
+remains of that story is exactly the *recovery* path: something must
+supervise the training process, classify its failures, and restart it
+from the newest VALID checkpoint. PR 1–9 built the crash-atomic save
+half (``train/checkpoint.py``); this module is the recovery half —
+Gemini's lesson (PAPERS.md [R1]) that goodput comes from making
+restart/resume a first-class, continuously tested subsystem, and
+Bamboo's [R2] that preemption must be a *clean, expected* exit, not a
+failure mode.
+
+Three pieces:
+
+- :func:`run_resilient` — run a training pass factory under retry with
+  exponential backoff + seeded jitter. Failures are classified
+  (:func:`classify_failure`): a poisoned checkpoint quarantines and
+  falls back one pass (the ``load_latest_valid`` chain does the heavy
+  lifting during ``Trainer(resume=True)``); transient I/O retries in
+  place; a crash retries with resume; a *repeated same-step* failure —
+  the deterministic-bug signature — gives up loud instead of burning
+  restarts on a fault that will recur forever. NaN losses
+  (``FloatingPointError`` from ``nan_check``) are fatal immediately:
+  restarting replays the same batches into the same NaN.
+- **Preemption**: :func:`install_preemption_handler` turns SIGTERM/
+  SIGINT into ``trainer.request_stop()`` — the trainer quiesces at the
+  next group boundary (drains the host pipeline and the async
+  checkpointer, writes a final mid-pass checkpoint with the iterator
+  position) and exits via :class:`~paddle_tpu.train.faults.Preempted`,
+  which the supervisor returns as status ``"preempted"``, never
+  retries.
+- **Telemetry**: every restart/fallback emits a ``kind="restart"`` /
+  ``kind="fallback"`` record through the trainer's telemetry (when
+  attached), so a run's JSONL tells the whole recovery story.
+
+Dead-host detection and reformed-mesh restart live in
+:mod:`paddle_tpu.parallel.multihost` (heartbeat files under the
+checkpoint root + ``detect_dead_hosts`` + ``plan_reform``); pass
+``heartbeat_interval_s=`` here to have the supervisor keep this host's
+heartbeat fresh for the fleet watchdog.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import signal
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import checkpoint as ckpt_lib
+from .faults import InjectedCrash, Preempted
+
+__all__ = ["run_resilient", "RunResult", "classify_failure",
+           "install_preemption_handler", "SupervisorGaveUp", "Preempted"]
+
+_log = logging.getLogger("paddle_tpu.resilience")
+
+
+class SupervisorGaveUp(RuntimeError):
+    """The supervisor exhausted its restart budget, or the same failure
+    recurred at the same step enough times to look deterministic. The
+    original failure is chained (``__cause__``)."""
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map a training failure to a recovery policy:
+
+    - ``"poisoned_checkpoint"`` — checkpoint integrity failure
+      (:class:`~paddle_tpu.train.checkpoint.CorruptCheckpointError`):
+      quarantine the latest pass and fall back one pass before retrying.
+    - ``"fatal"`` — deterministic poison (``FloatingPointError`` from
+      ``nan_check``): a restart replays the same batches into the same
+      NaN; re-raise immediately, loud.
+    - ``"transient_io"`` — ``OSError`` family (flaky disk/transport):
+      retry in place after backoff.
+    - ``"crash"`` — everything else (including
+      :class:`~paddle_tpu.train.faults.InjectedCrash`): retry with
+      resume from the newest valid checkpoint.
+    """
+    if isinstance(exc, ckpt_lib.CorruptCheckpointError):
+        return "poisoned_checkpoint"
+    if isinstance(exc, FloatingPointError):
+        return "fatal"
+    if isinstance(exc, InjectedCrash):
+        return "crash"
+    if isinstance(exc, OSError):
+        return "transient_io"
+    return "crash"
+
+
+@dataclasses.dataclass
+class RunResult:
+    """What :func:`run_resilient` hands back: the terminal status
+    (``"completed"`` | ``"preempted"``), the final ``TrainState`` (the
+    preempted case returns the quiesced state the checkpoint captured),
+    and the recovery ledger — restart count, checkpoint dirs quarantined
+    by the fallback chain, and one dict per failed attempt."""
+    status: str
+    state: Any
+    restarts: int
+    fallbacks: List[str]
+    attempts: List[Dict[str, Any]]
+    preempted: Optional[Preempted] = None
+
+
+def install_preemption_handler(trainer,
+                               signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                           signal.SIGINT)):
+    """Route SIGTERM/SIGINT into ``trainer.request_stop()`` — the
+    preemption notice most schedulers send before reclaiming a host. The
+    handler only sets a flag (async-signal-safe); the trainer quiesces at
+    its next group boundary and exits via
+    :class:`~paddle_tpu.train.faults.Preempted`. Returns a ``restore()``
+    callable reinstating the previous handlers (call it when the trainer
+    is done — e.g. in a ``finally``). Main thread only (CPython's signal
+    rule)."""
+    prev = {}
+
+    def handler(signum, frame):
+        trainer.request_stop(f"signal {signum}")
+
+    for s in signals:
+        prev[s] = signal.signal(s, handler)
+
+    def restore():
+        for s, h in prev.items():
+            signal.signal(s, h)
+
+    return restore
+
+
+def _emit(trainer, record: Dict[str, Any]) -> None:
+    """Best-effort telemetry emit through the attempt's trainer."""
+    tel = getattr(trainer, "telemetry", None)
+    if tel is not None:
+        try:
+            tel.emit_event(record)
+        except Exception:
+            _log.exception("resilience telemetry emit failed")
+
+
+def run_resilient(make_trainer_fn: Callable[[], Any], reader: Callable,
+                  *, checkpoint_dir: str, num_passes: int = 1,
+                  max_restarts: int = 3, backoff_s: float = 0.5,
+                  backoff_max_s: float = 30.0, same_step_limit: int = 3,
+                  seed: int = 0, classify: Callable = classify_failure,
+                  sleep_fn: Callable[[float], None] = time.sleep,
+                  install_signals: bool = False,
+                  heartbeat_interval_s: Optional[float] = None,
+                  **train_kwargs) -> RunResult:
+    """Run a training job under the restart supervisor.
+
+    Args:
+      make_trainer_fn: zero-arg factory returning a FRESH, initialized
+        :class:`~paddle_tpu.train.trainer.Trainer` for each attempt (a
+        crashed attempt's donated buffers and worker threads are dead
+        weight — never reuse the instance).
+      reader: the training reader callable, passed to every attempt's
+        ``train()``. Must be deterministic for mid-pass resume to replay
+        the interrupted pass exactly (the trainer's batch-fingerprint
+        check warns when it is not).
+      checkpoint_dir: the shared checkpoint root — the supervisor's only
+        durable state. Every attempt runs ``resume=True`` against it.
+      num_passes / **train_kwargs: forwarded to ``Trainer.train``
+        (``saving_period``, ``checkpoint_async``, ``event_handler``, …).
+      max_restarts: restart budget; exceeding it raises
+        :class:`SupervisorGaveUp` chained to the last failure.
+      backoff_s / backoff_max_s: exponential backoff base/cap between
+        retries, with seeded multiplicative jitter (+0–25%) so a fleet
+        of restarting workers doesn't stampede the checkpoint store.
+      same_step_limit: a failure with the same (type, step) signature
+        this many times in a row is treated as deterministic — give up
+        loud instead of spending the budget on it.
+      classify: failure → policy mapping (see :func:`classify_failure`).
+      sleep_fn: injection point for tests (replaces ``time.sleep``).
+      install_signals: also route SIGTERM/SIGINT to a graceful stop for
+        the duration of each attempt (main thread only).
+      heartbeat_interval_s: when set, keep this host's heartbeat file
+        under ``checkpoint_dir`` fresh for the whole supervised run
+        (:class:`paddle_tpu.parallel.multihost.HostHeartbeat`), so a
+        fleet watchdog's ``detect_dead_hosts`` sees the truth.
+
+    Returns a :class:`RunResult`; raises :class:`SupervisorGaveUp` when
+    the budget is spent, and re-raises ``"fatal"`` failures immediately.
+    """
+    rng = random.Random(seed)
+    attempts: List[Dict[str, Any]] = []
+    fallbacks: List[str] = []
+    restarts = 0
+    last_sig: Optional[Tuple[str, Optional[int]]] = None
+    same_sig = 0
+    heartbeat = None
+    if heartbeat_interval_s is not None:
+        from ..parallel import multihost
+        heartbeat = multihost.HostHeartbeat(checkpoint_dir,
+                                            interval_s=heartbeat_interval_s)
+        heartbeat.start()
+    try:
+        while True:
+            trainer = make_trainer_fn()
+            restore_signals = (install_preemption_handler(trainer)
+                               if install_signals else None)
+            try:
+                state = trainer.train(reader, num_passes=num_passes,
+                                      checkpoint_dir=checkpoint_dir,
+                                      resume=True, **train_kwargs)
+                fallbacks.extend(trainer.last_quarantined)
+                return RunResult(status="completed", state=state,
+                                 restarts=restarts, fallbacks=fallbacks,
+                                 attempts=attempts)
+            except Preempted as p:
+                # the CLEAN exit: a graceful stop quiesced and
+                # checkpointed — hand control back, never retry
+                fallbacks.extend(trainer.last_quarantined)
+                _log.warning("training preempted cleanly: %s", p)
+                return RunResult(status="preempted",
+                                 state=trainer.train_state,
+                                 restarts=restarts, fallbacks=fallbacks,
+                                 attempts=attempts, preempted=p)
+            except Exception as exc:
+                fallbacks.extend(trainer.last_quarantined)
+                kind = classify(exc)
+                step = getattr(exc, "step", None)
+                if step is None:
+                    step = getattr(trainer, "_host_step", None)
+                attempts.append({"failure": kind,
+                                 "error": f"{type(exc).__name__}: {exc}",
+                                 "step": step})
+                if kind == "fatal":
+                    _log.error("fatal training failure (%s) — not "
+                               "retrying: %s", type(exc).__name__, exc)
+                    raise
+                sig = (type(exc).__name__, step)
+                same_sig = same_sig + 1 if sig == last_sig else 1
+                last_sig = sig
+                if same_sig >= same_step_limit:
+                    raise SupervisorGaveUp(
+                        f"failure {sig[0]} at step {sig[1]} recurred "
+                        f"{same_sig}x — deterministic, giving up (see "
+                        f"attempts ledger)") from exc
+                restarts += 1
+                if restarts > max_restarts:
+                    raise SupervisorGaveUp(
+                        f"restart budget exhausted ({max_restarts}); last "
+                        f"failure {sig[0]} at step {sig[1]}") from exc
+                if kind == "poisoned_checkpoint":
+                    # belt and braces: the resume path quarantines on
+                    # load, but a poison detected elsewhere (async-save
+                    # fence, explicit restore) must not be re-read
+                    pid = ckpt_lib.latest_pass(checkpoint_dir)
+                    if pid is not None:
+                        d = ckpt_lib._resolve_pass_dir(checkpoint_dir, pid)
+                        q = ckpt_lib.quarantine_pass_dir(d)
+                        fallbacks.append(q)
+                        _emit(trainer, {"kind": "fallback", "pass_id": pid,
+                                        "quarantined": q})
+                delay = min(backoff_max_s,
+                            backoff_s * (2.0 ** (restarts - 1)))
+                delay *= 1.0 + 0.25 * rng.random()
+                _log.warning(
+                    "training attempt failed (%s at step %s): %s — "
+                    "restart %d/%d after %.2fs backoff",
+                    kind, step, exc, restarts, max_restarts, delay)
+                _emit(trainer, {"kind": "restart", "attempt": restarts,
+                                "failure": kind, "step": step,
+                                "error": f"{type(exc).__name__}: "
+                                         f"{str(exc)[:200]}",
+                                "backoff_s": round(delay, 3)})
+                sleep_fn(delay)
+            finally:
+                if restore_signals is not None:
+                    restore_signals()
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()

@@ -36,6 +36,7 @@ from ..optim.optimizers import Optimizer, apply_updates
 from ..utils.stats import StatSet
 from . import checkpoint as ckpt_lib
 from . import events as ev
+from .faults import Preempted
 
 __all__ = ["Trainer", "TrainState"]
 
@@ -218,6 +219,26 @@ class Trainer:
         snapshot + verdict — and can arm a ``jax.profiler`` capture for
         the next fused call. Observation only: training continues, and a
         detector failure is logged, never raised.
+      faults: optional :class:`paddle_tpu.train.faults.FaultSchedule` —
+        the deterministic fault-injection plane (ISSUE 10). When
+        attached, the named injection points fire at their scheduled
+        step/save/group: ``crash_at_step``/``preempt_at_step`` after
+        that optimizer step's host replay, the save-path points inside
+        the checkpoint writer (sync or async), and
+        ``stager_error_at_group`` in the host-pipeline stager thread.
+        With ``faults=None`` (default) the hot loop is the exact
+        pre-faults build: same traced step, dispatch count, donation,
+        zero extra fences (pinned by tests/test_resilience.py).
+
+    Preemption: ``request_stop(reason)`` (typically from a SIGTERM/SIGINT
+    handler — see :func:`paddle_tpu.train.resilience.
+    install_preemption_handler`) asks ``train()`` to stop gracefully at
+    the NEXT GROUP BOUNDARY: the host pipeline and deferred-fetch window
+    are drained, a final quiesced checkpoint (with the data-iterator
+    position) is written through the active save path, the async
+    checkpointer is fenced, and ``train()`` raises
+    :class:`~paddle_tpu.train.faults.Preempted` — a distinct CLEAN
+    status the resilience supervisor returns instead of retrying.
     """
 
     def __init__(self, model: Module, loss_fn: Callable, optimizer: Optimizer,
@@ -228,7 +249,7 @@ class Trainer:
                  steps_per_call: int = 1, grad_accum: int = 1,
                  grad_sync: Optional[str] = None, bucket_mb: float = 4.0,
                  pipeline_depth: int = 1, telemetry=None, tracer=None,
-                 anomaly=None):
+                 anomaly=None, faults=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -288,12 +309,82 @@ class Trainer:
                 "AnomalyDetector consumes telemetry step records — pass "
                 "telemetry=Telemetry(...) alongside anomaly=")
         self.anomaly = anomaly
+        # faults: None = the exact pre-faults hot loop (every injection
+        # point is behind a host-side `is not None` check — no traced-step
+        # or dispatch-count change; pinned by tests/test_resilience.py).
+        self.faults = faults
+        # graceful-stop request (SIGTERM handler / injected preemption /
+        # request_stop()); a bare attribute write, so it is safe from
+        # signal handlers and other threads. Consumed at group boundaries.
+        self._stop_requested: Optional[str] = None
         self._fused_step = None
         self.train_state: Optional[TrainState] = None
         self._last_iter_state: Optional[Dict[str, Any]] = None
+        # fallback-chain bookkeeping from the last restore (ISSUE 10)
+        self.last_quarantined: list = []
+        self._last_restored_pass: Optional[int] = None
 
     def _health_on(self) -> bool:
         return self.telemetry is not None and self.telemetry.health
+
+    # -- preemption + fault injection (ISSUE 10) -----------------------------
+
+    def request_stop(self, reason: str = "requested") -> None:
+        """Ask the training loop to stop gracefully at the next group
+        boundary (drain the pipeline, write a quiesced checkpoint, raise
+        :class:`~paddle_tpu.train.faults.Preempted`). Safe to call from a
+        signal handler or another thread — it only writes an attribute;
+        all the work happens on the training thread at the boundary."""
+        if self._stop_requested is None:
+            self._stop_requested = reason
+            _log.warning("graceful stop requested (%s): will quiesce at "
+                         "the next group boundary", reason)
+
+    def _fire_step_faults(self, step: int) -> None:
+        """One optimizer step's host replay just finished: fire any
+        scheduled crash (raises) or preemption (requests a graceful
+        stop) keyed to that step. Callers gate on ``faults is not
+        None``, so the off path never even makes this call."""
+        fs = self.faults
+        fs.maybe_crash_step(step)
+        if fs.should_preempt(step):
+            self.request_stop(f"injected preemption at step {step}")
+
+    def _maybe_stop(self, pipe, pending, pass_id, next_batch, handler,
+                    costs, log_period, checkpoint_dir, checkpoint_keep,
+                    save_fn, last_batch=None) -> None:
+        """Group-boundary graceful-stop check. When a stop is pending:
+        drain the in-flight window (pipelined fused / deferred plain) so
+        ``train_state`` quiesces at exactly ``next_batch`` consumed
+        batches, write a final mid-pass checkpoint carrying the iterator
+        position — with the last consumed batch's fingerprint
+        (``last_batch``), so the resume-time nondeterministic-reader
+        check guards the preempt path like every other mid-pass save —
+        and exit via :class:`Preempted`. The async checkpointer (when
+        active) is fenced by ``train()``'s finally — the preempt save is
+        on disk before ``train()`` unwinds."""
+        if self._stop_requested is None:
+            return
+        reason = self._stop_requested
+        if pipe is not None:
+            pipe.flush()           # FIFO drain: replay order preserved
+        while pending:
+            self._replay_plain(pending.pop(0), pass_id, handler, costs,
+                               log_period, checkpoint_dir, checkpoint_keep,
+                               save_fn)
+        if checkpoint_dir:
+            it = {"pass": pass_id, "next_batch": next_batch,
+                  "completed": 0, "preempted": 1}
+            if last_batch is not None:
+                it["batch_crc"] = _batch_fingerprint(last_batch)
+            with tspan(self.tracer, "checkpoint_save",
+                       preempt_next_batch=next_batch):
+                save_fn(
+                    checkpoint_dir, pass_id,
+                    {**self.train_state.as_dict(), "iter": it},
+                    keep_last=checkpoint_keep)
+        raise Preempted(pass_id=pass_id, next_batch=next_batch,
+                        reason=reason)
 
     # -- anomaly plumbing ----------------------------------------------------
 
@@ -802,6 +893,10 @@ class Trainer:
         pass's metrics cover only its remaining batches.
         """
         assert self.train_state is not None, "call init() first"
+        # a stop request is scoped to ONE train() call: a prior run's
+        # consumed-or-unconsumed flag must not instantly preempt this one
+        # (the handler can re-request once this run is live)
+        self._stop_requested = None
         if self.anomaly is not None:
             # the flight recorder needs the trace ring and a lazy
             # config/env/mesh snapshot source for its bundles
@@ -815,18 +910,40 @@ class Trainer:
 
         start_pass, skip_batches = 0, 0
         if resume and checkpoint_dir:
-            last = ckpt_lib.latest_pass(checkpoint_dir)
-            if last is not None:
-                self.restore(checkpoint_dir, last)
-                it = self._last_iter_state
-                if it is not None and not int(it.get("completed", 1)):
-                    start_pass = int(it["pass"])
-                    skip_batches = int(it["next_batch"])
+            if ckpt_lib.latest_pass(checkpoint_dir) is not None:
+                try:
+                    # latest VALID pass: poisoned dirs are quarantined
+                    # (renamed .corrupt, never deleted) and the chain
+                    # falls back one pass — resume survives a corrupt
+                    # latest checkpoint instead of dying on its CRC
+                    self.restore(checkpoint_dir)
+                except FileNotFoundError as e:
+                    self.last_quarantined = list(
+                        getattr(e, "quarantined", []))
+                    _log.warning(
+                        "resume: no readable checkpoint remains under %s "
+                        "after quarantine — starting from scratch",
+                        checkpoint_dir)
                 else:
-                    start_pass = last + 1
+                    last = self._last_restored_pass
+                    it = self._last_iter_state
+                    if it is not None and not int(it.get("completed", 1)):
+                        start_pass = int(it["pass"])
+                        skip_batches = int(it["next_batch"])
+                    else:
+                        start_pass = last + 1
 
-        saver = ckpt_lib.AsyncCheckpointer() if checkpoint_async else None
-        save_fn = saver.save if saver else ckpt_lib.save_checkpoint
+        saver = None
+        if checkpoint_async:
+            saver = ckpt_lib.AsyncCheckpointer(telemetry=self.telemetry,
+                                               faults=self.faults)
+            save_fn = saver.save
+        elif self.faults is not None:
+            import functools
+            save_fn = functools.partial(ckpt_lib.save_checkpoint,
+                                        faults=self.faults)
+        else:
+            save_fn = ckpt_lib.save_checkpoint
         try:
             return self._train_loop(reader, num_passes, handler, test_reader,
                                     checkpoint_dir, checkpoint_keep,
@@ -883,6 +1000,13 @@ class Trainer:
                                   "completed": 1}},
                         keep_last=checkpoint_keep)
             handler(ev.EndPass(pass_id, pass_metrics))
+            if self._stop_requested is not None:
+                # a stop that arrived too late for a group boundary (or
+                # during eval / the pass-end save) exits here: the pass
+                # checkpoint above already recorded completed=1, so the
+                # resume position is the next pass's first batch
+                raise Preempted(pass_id=pass_id + 1, next_batch=0,
+                                reason=self._stop_requested)
         return self.train_state
 
     def _run_pass(self, reader, pass_id, start_pass, skip_batches, pipe,
@@ -951,6 +1075,14 @@ class Trainer:
                             log_period, saving_period, checkpoint_dir,
                             checkpoint_keep, save_fn)
                     buf = []
+                    # graceful stop lands on exactly this boundary: the
+                    # group's batches are all dispatched (buf empty), so
+                    # after the drain inside _maybe_stop the state is
+                    # quiesced at batch_id + 1 consumed batches
+                    self._maybe_stop(pipe, pending, pass_id, batch_id + 1,
+                                     handler, costs, log_period,
+                                     checkpoint_dir, checkpoint_keep,
+                                     save_fn, last_batch=host_batch)
                 continue
             if plain_deferred:
                 # The plain loop's deferred-fetch window: dispatch now,
@@ -977,6 +1109,10 @@ class Trainer:
                             pending.pop(0), pass_id, handler, costs,
                             log_period, checkpoint_dir, checkpoint_keep,
                             save_fn)
+                self._maybe_stop(None, pending, pass_id, batch_id + 1,
+                                 handler, costs, log_period,
+                                 checkpoint_dir, checkpoint_keep, save_fn,
+                                 last_batch=host_batch)
                 continue
             # SERIAL plain step. _plain_dispatch/_replay_plain mirror this
             # body for the deferred-fetch window (divergences are the
@@ -1090,6 +1226,12 @@ class Trainer:
                         keep_last=checkpoint_keep)
             handler(ev.EndIteration(pass_id, batch_id, int(step), cost,
                                     metrics))
+            if self.faults is not None:
+                self._fire_step_faults(self._host_step)
+            self._maybe_stop(None, pending, pass_id, batch_id + 1, handler,
+                             costs, log_period, checkpoint_dir,
+                             checkpoint_keep, save_fn,
+                             last_batch=host_batch)
         if fused and buf:
             # Pass tail smaller than K*M: flush what's buffered (the
             # final optimizer step may accumulate < M microbatches;
@@ -1224,6 +1366,8 @@ class Trainer:
                     keep_last=checkpoint_keep)
         handler(ev.EndIteration(pass_id, batch_id, entry["step"], cost,
                                 metrics))
+        if self.faults is not None:
+            self._fire_step_faults(entry["step"])
 
     # -- fused dispatch ------------------------------------------------------
 
@@ -1249,6 +1393,11 @@ class Trainer:
         locked), so it can overlap the in-flight device calls."""
         from .host_pipeline import StagedGroup, StagedUnit
         buf, buf_start, boundary = work
+        if self.faults is not None:
+            # the stager injection point: raises IN THE WORKER THREAD, so
+            # the failure travels GroupStager's producer-error path and
+            # surfaces in the training thread at the next submit/get
+            self.faults.maybe_stager_error(buf_start)
         tracer = self.tracer
         # the group's flow id links THIS thread's staging span to the main
         # thread's later dispatch + drain spans in the trace viewer
@@ -1621,6 +1770,8 @@ class Trainer:
                 self._log_param_stats(pass_id, last_id)
             handler(ev.EndIteration(pass_id, last_id,
                                     step_after - (K - 1 - k), cost, metrics))
+            if self.faults is not None:
+                self._fire_step_faults(step_after - (K - 1 - k))
 
     def _log_stat_report(self, top_n: int = 8):
         """Periodic StatSet summary at log_period — the reference's
@@ -1763,7 +1914,19 @@ class Trainer:
                                         self.train_state.as_dict())
 
     def restore(self, checkpoint_dir: str, pass_id: Optional[int] = None):
-        loaded = ckpt_lib.load_checkpoint(checkpoint_dir, pass_id)
+        """Restore from a checkpoint. ``pass_id=None`` loads the newest
+        READABLE pass via the fallback chain
+        (:func:`~paddle_tpu.train.checkpoint.load_latest_valid`):
+        poisoned dirs are quarantined to ``pass-NNNNN.corrupt`` — never
+        deleted — and the previous readable pass loads instead
+        (``trainer.last_quarantined`` records what was moved aside). An
+        explicit ``pass_id`` stays strict and raises on corruption."""
+        if pass_id is None:
+            loaded = ckpt_lib.load_latest_valid(checkpoint_dir)
+        else:
+            loaded = ckpt_lib.load_checkpoint(checkpoint_dir, pass_id)
+        self.last_quarantined = loaded.pop("_quarantined", [])
+        self._last_restored_pass = int(loaded["pass_id"])
         # iterator position (absent in pre-saving_period checkpoints)
         self._last_iter_state = loaded.get("iter")
         put = lambda tree: jax.tree_util.tree_map(jnp.asarray, tree)

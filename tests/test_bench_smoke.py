@@ -102,6 +102,25 @@ def test_bench_smoke_cpu_green_and_equal():
             > srv["static"]["tokens_per_sec"])
     assert srv["continuous"]["ticks"] < srv["static"]["ticks"]
     assert srv["decode_bound"] == "memory"
+    # ISSUE 10: the fault-tolerance gate ran — the supervisor resumed an
+    # injected crash, a corrupted latest pass was quarantined (renamed
+    # .corrupt, never deleted) with fallback to the previous readable
+    # pass, and a mid-pass preemption quiesced with the distinct
+    # "preempted" status then resumed — each leg's final params
+    # BIT-EQUAL (f32) to the uninterrupted run
+    flt = out["faults"]
+    assert flt["ok"] is True, flt
+    assert flt["crash"]["status"] == "completed"
+    assert flt["crash"]["restarts"] == 1
+    assert flt["crash"]["params_equal"] is True
+    assert flt["corrupt"]["status"] == "completed"
+    assert flt["corrupt"]["fallbacks"] >= 1
+    assert flt["corrupt"]["corrupt_dirs"] >= 1
+    assert flt["corrupt"]["params_equal"] is True
+    assert flt["preempt"]["first_status"] == "preempted"
+    assert flt["preempt"]["preempt_next_batch"] is not None
+    assert flt["preempt"]["second_status"] == "completed"
+    assert flt["preempt"]["params_equal"] is True
 
 
 def _write_bench(tmp_path, name, metrics):

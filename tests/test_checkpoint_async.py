@@ -187,6 +187,73 @@ def test_rewrite_of_crash_surviving_tmp_keeps_a_complete_copy(tmp_path,
     np.testing.assert_allclose(out["params"]["w"], np.full((2,), 1.0))
 
 
+def test_checkpoint_telemetry_record_per_save(tmp_path):
+    """ISSUE 10 satellite: each landed async save emits one
+    kind="checkpoint" record — pass_id, snapshot/write wall, bytes on
+    disk, and the backlog wait behind the previous in-flight write."""
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    mem = InMemorySink()
+    tel = Telemetry(sinks=[mem], health=False, memory=False)
+    with ckpt.AsyncCheckpointer(telemetry=tel) as saver:
+        for i in range(2):
+            saver.save(str(tmp_path), i,
+                       {"params": {"w": np.ones((64,), np.float32) * i}})
+        saver.wait()
+    recs = mem.by_kind("checkpoint")
+    assert [r["pass_id"] for r in recs] == [0, 1]
+    for r in recs:
+        assert r["snapshot_ms"] >= 0 and r["write_ms"] >= 0
+        assert r["bytes"] > 0 and r["backlog_ms"] >= 0
+        assert r["async"] is True
+    assert tel.summary()["background_failures"] == 0
+
+
+def test_background_failure_counts_and_reraises(tmp_path, monkeypatch):
+    """A failing background write bumps telemetry.background_failures
+    (visible in summary() even if the fence is never reached) AND still
+    re-raises at the fence."""
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    tel = Telemetry(sinks=[InMemorySink()], health=False, memory=False)
+    saver = ckpt.AsyncCheckpointer(telemetry=tel)
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+    monkeypatch.setattr(ckpt, "_write_pass_dir", boom)
+    try:
+        saver.save(str(tmp_path), 0, {"params": {"w": np.ones((2,))}})
+        with pytest.raises(OSError, match="disk full"):
+            saver.wait()
+    finally:
+        saver.close()
+    assert tel.background_failures == 1
+    assert tel.summary()["background_failures"] == 1
+    assert len(tel.sinks[0].by_kind("checkpoint")) == 0   # no record
+
+
+def test_atexit_final_wait_fences_inflight_write(tmp_path):
+    """Interpreter-exit safety: the registered atexit hook fences the
+    in-flight write (no truncation), and close() unregisters it."""
+    import atexit
+    saver = ckpt.AsyncCheckpointer()
+    gate = threading.Event()
+    real_write = ckpt._write_pass_dir
+
+    def slow_write(*a, **k):
+        gate.wait(timeout=10)
+        return real_write(*a, **k)
+    ckpt._write_pass_dir = slow_write
+    try:
+        saver.save(str(tmp_path), 0, {"params": {"w": np.ones((2,))}})
+        gate.set()
+        saver._atexit_wait()           # what interpreter exit would run
+        assert ckpt.latest_pass(str(tmp_path)) == 0
+    finally:
+        ckpt._write_pass_dir = real_write
+        saver.close()
+    # close() unregistered the hook: re-unregistering finds nothing
+    atexit.unregister(saver._atexit_wait)   # no-op, must not raise
+
+
 def test_async_overlaps_with_training_thread(tmp_path):
     """The background write really runs concurrently: a slow write does not
     block the caller between saves (smoke check that save() returns before

@@ -302,6 +302,79 @@ def test_pool_backpressure_defers_admission(model_and_vars, nprng):
     assert eng.compile_counts() == {"prefill": 1, "tick": 1}
 
 
+class _FakeClock:
+    """Deterministic scheduler clock: the test advances it between
+    ticks, so deadline expiry is exact, not wall-time-flaky."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_deadline_evicts_running_slot_and_frees_blocks(model_and_vars,
+                                                       nprng):
+    """ISSUE 10: a slot that exceeds its deadline_s is evicted between
+    ticks with finish_reason="timeout" and its blocks freed — a stuck/
+    long request can no longer hold a slot + reservation forever."""
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    eng = DecodeEngine(model, vs, max_slots=2, block_size=BS,
+                       telemetry=Telemetry(sinks=[mem]))
+    clock = _FakeClock()
+    sched = ContinuousBatchingScheduler(eng, clock=clock)
+    free0 = eng.cache.free_blocks
+    stuck = sched.submit(list(nprng.randint(0, V, 4)), 18, deadline_s=2.5)
+    quick = sched.submit(list(nprng.randint(0, V, 4)), 3)
+    while sched.step():
+        clock.t += 1.0                        # one "second" per tick
+    assert quick.done and quick.finish_reason == "length"
+    assert stuck.done and stuck.finish_reason == "timeout"
+    # evicted mid-decode: partial tokens, well short of max_new
+    assert 1 <= len(stuck.tokens) < 18
+    # the whole reservation came back to the pool
+    assert eng.cache.free_blocks == free0
+    assert not eng.active.any()
+    # surfaced in the request telemetry records
+    recs = {r["rid"]: r for r in mem.by_kind("request")}
+    assert recs[stuck.rid]["finish_reason"] == "timeout"
+    assert recs[stuck.rid]["deadline_s"] == 2.5
+    assert recs[quick.rid]["finish_reason"] == "length"
+    assert recs[quick.rid]["deadline_s"] is None
+
+
+def test_deadline_drops_expired_queued_request(model_and_vars, nprng):
+    """A request whose deadline expires while still QUEUED (pool/slot
+    backpressure) is dropped before ever taking a slot."""
+    model, vs = model_and_vars
+    eng = DecodeEngine(model, vs, max_slots=1, block_size=BS)
+    clock = _FakeClock()
+    sched = ContinuousBatchingScheduler(eng, clock=clock)
+    free0 = eng.cache.free_blocks
+    long_req = sched.submit(list(nprng.randint(0, V, 4)), 10)
+    starved = sched.submit(list(nprng.randint(0, V, 4)), 4, deadline_s=3.0)
+    while sched.step():
+        clock.t += 1.0
+    assert long_req.finish_reason == "length"
+    assert starved.finish_reason == "timeout"
+    assert starved.slot is None and starved.tokens == []
+    # the timed-out request never took a slot or any blocks
+    assert eng.cache.free_blocks == free0
+
+
+def test_deadline_none_is_unchanged_and_validation(model_and_vars, nprng):
+    model, vs = model_and_vars
+    eng = DecodeEngine(model, vs, max_slots=2, block_size=BS)
+    sched = ContinuousBatchingScheduler(eng)
+    with pytest.raises(ValueError, match="deadline_s"):
+        sched.submit([1, 2], 2, deadline_s=-1.0)
+    req = sched.submit(list(nprng.randint(0, V, 3)), 4)
+    sched.run()
+    assert req.finish_reason == "length" and len(req.tokens) == 4
+
+
 def test_decode_past_reservation_raises(model_and_vars):
     """Out-decoding the admission reservation must fail loud, not scatter
     new-token KV into the null block (silent wrong logits)."""
