@@ -1016,6 +1016,13 @@ def run_smoke(K=4, M=2, timing_passes=3):
     faults = run_gate_child("--faults-child")
     faults_ok = faults.get("ok") is True
 
+    # serving-fleet gate (ISSUE 11): seeded bursty loadgen over 3
+    # replicas with one injected kill + one drain — every request
+    # terminal with clean lineage, no survivor leaks/retraces, bounded
+    # shedding, and the SJF-vs-FCFS goodput-under-deadline differential.
+    fleet = run_gate_child("--fleet-child")
+    fleet_ok = fleet.get("ok") is True
+
     out = {
         "metric": "fused_vs_plain_smoke",
         "equal": bool(eq_params and eq_losses),
@@ -1033,13 +1040,15 @@ def run_smoke(K=4, M=2, timing_passes=3):
         "overlap": overlap,
         "serving": serving,
         "faults": faults,
+        "fleet": fleet,
     }
     print(json.dumps(out))
     ok = (out["equal"] and jsonl_ok
           and telemetry["losses_equal_with_telemetry"]
           and pipeline["losses_equal"] and pipeline["overlap_keys_ok"]
           and trace_ok and trace["losses_equal_with_tracer"]
-          and attribution_ok and overlap_ok and serving_ok and faults_ok)
+          and attribution_ok and overlap_ok and serving_ok and faults_ok
+          and fleet_ok)
     return 0 if ok else 1
 
 
@@ -1413,6 +1422,123 @@ def run_faults_child():
         "child": "faults", "ok": bool(ok),
         "passes": passes, "steps_per_pass": steps_per_pass,
         "crash": leg_a, "corrupt": leg_b, "preempt": leg_c,
+        "device": jax.devices()[0].device_kind,
+    }))
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# serving-fleet resilience gate child (ISSUE 11): loadgen burst over 3
+# in-process replicas with one injected kill + one drain, plus the
+# SJF-vs-FCFS goodput differential under a deterministic clock
+# ---------------------------------------------------------------------------
+
+def run_fleet_child():
+    """The serving fleet's CI gate, two legs on a SimClock —
+
+    - **fault drill**: a seeded bursty loadgen trace (sessions with
+      shared prefixes, ragged lengths, deadlines) over 3 replicas; a
+      FaultSchedule kills replica 0 mid-decode and replica 1 is drained
+      mid-traffic. Asserts: every request reaches a terminal
+      finish_reason with exactly one terminal record per rid (retried
+      lineage for the killed replica's requests), p99 TTFT finite, the
+      shed count bounded, zero retraces and zero leaked KV blocks on
+      every surviving replica.
+    - **SLO policy differential**: the same overload (2 long jobs ahead
+      of 4 short deadline-carrying jobs, one engine, fixed 1s ticks)
+      under order="fcfs" vs order="sjf" — SJF's goodput-under-deadline
+      must beat FCFS's, reported through the new percentile metrics.
+
+    Prints the verdict as one JSON line."""
+    import collections
+    import tempfile
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.obs import InMemorySink, Telemetry, summarize_requests
+    from paddle_tpu.serve import (ContinuousBatchingScheduler,
+                                  DecodeEngine, ServingFleet, SimClock)
+    from paddle_tpu.serve.loadgen import make_workload, workload_stats
+    from paddle_tpu.train import FaultSchedule
+
+    V, W = 64, 32
+    model = TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
+                          ffn_hidden=64, max_len=W)
+    vs = model.init(jax.random.PRNGKey(0), jnp.zeros((1, W), jnp.int32))
+
+    # -- leg 1: the fleet fault drill
+    mem = InMemorySink()
+    clock = SimClock()
+    faults = FaultSchedule(kill_replica_at_tick=(6, 0))
+    fleet = ServingFleet.from_model(
+        model, vs, 3, engine_kwargs=dict(max_slots=2, block_size=4),
+        telemetry=Telemetry(sinks=[mem]), clock=clock,
+        heartbeat_timeout_s=0.25, est_tick_s=0.1, faults=faults,
+        root=tempfile.mkdtemp(prefix="paddle_tpu_fleet_gate_"))
+    wl = make_workload(14, V, seed=3, rate_rps=30.0, arrival="bursty",
+                       prompt_len=(2, 8), max_new=(2, 10), n_sessions=3,
+                       session_prefix_len=4, p_session=0.5,
+                       deadline_s=(2.0, 6.0), p_deadline=0.5,
+                       max_total=W)
+    frs = fleet.play(wl, dt_s=0.1, drain_at_tick={10: 1})
+    stats = fleet.stats()
+    summary = summarize_requests(mem.records)
+
+    all_terminal = all(fr.record is not None for fr in frs)
+    terminal_per_rid = collections.Counter(
+        r["rid"] for r in mem.by_kind("request")
+        if r["finish_reason"] != "retried")
+    lineage_ok = (set(terminal_per_rid) == {fr.rid for fr in frs}
+                  and all(v == 1 for v in terminal_per_rid.values()))
+    survivors = [w for w in fleet.workers if not w.killed
+                 and w.state != "dead"]
+    no_leak = all(w.engine.cache.free_blocks
+                  == w.engine.cache.num_blocks - 1 for w in survivors)
+    no_retrace = all(
+        w.engine.compile_counts() == {"prefill": 1, "tick": 1}
+        for w in survivors if w.engine.ticks > 0)
+    p99_finite = (summary["ttft_ms_p99"] is not None
+                  and np.isfinite(summary["ttft_ms_p99"]))
+    shed_bounded = 0 <= stats["shed"] <= len(frs) // 2
+
+    # -- leg 2: SJF vs FCFS goodput differential (single engine, 1s ticks)
+    def run_order(order):
+        mem2 = InMemorySink()
+        eng = DecodeEngine(model, vs, max_slots=2, block_size=4,
+                           telemetry=Telemetry(sinks=[mem2]))
+        clk = SimClock()
+        sched = ContinuousBatchingScheduler(eng, order=order, clock=clk,
+                                            est_tick_s=1.0)
+        rng = np.random.RandomState(0)
+        for _ in range(2):                         # stragglers first
+            sched.submit(list(rng.randint(1, V, 4)), 12)
+        for _ in range(4):                         # tight-deadline shorts
+            sched.submit(list(rng.randint(1, V, 4)), 2, deadline_s=8.0)
+        while sched.step():
+            clk.advance(1.0)
+        return summarize_requests(mem2.records)
+
+    fcfs = run_order("fcfs")
+    sjf = run_order("sjf")
+    sjf_wins = (fcfs["goodput_pct"] is not None
+                and sjf["goodput_pct"] is not None
+                and sjf["goodput_pct"] > fcfs["goodput_pct"])
+
+    ok = (all_terminal and lineage_ok and no_leak and no_retrace
+          and p99_finite and shed_bounded and stats["resubmits"] >= 1
+          and stats["stale_completions"] == 0 and sjf_wins)
+    print(json.dumps({
+        "child": "fleet", "ok": bool(ok),
+        "workload": workload_stats(wl),
+        "all_terminal": bool(all_terminal),
+        "lineage_ok": bool(lineage_ok),
+        "no_leak_on_survivors": bool(no_leak),
+        "zero_retraces_on_survivors": bool(no_retrace),
+        "p99_ttft_finite": bool(p99_finite),
+        "shed_bounded": bool(shed_bounded),
+        "sjf_beats_fcfs_goodput": bool(sjf_wins),
+        "goodput_fcfs_pct": fcfs["goodput_pct"],
+        "goodput_sjf_pct": sjf["goodput_pct"],
+        "stats": stats, "requests": summary,
+        "faults_fired": [p for p, _ in faults.fired],
         "device": jax.devices()[0].device_kind,
     }))
     return 0 if ok else 1
@@ -1831,7 +1957,8 @@ DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_fused",
 _KNOWN_FLAGS = ("--metric", "--child", "--probe", "--n", "--k",
                 "--timed-steps", "--steps-per-call", "--smoke",
                 "--attribution-child", "--overlap-child",
-                "--serving-child", "--faults-child", "--compare",
+                "--serving-child", "--faults-child", "--fleet-child",
+                "--compare",
                 "--threshold")
 
 
@@ -1882,6 +2009,9 @@ def main():
 
     if flag("--faults-child", cast=int):
         sys.exit(run_faults_child())
+
+    if flag("--fleet-child", cast=int):
+        sys.exit(run_fleet_child())
 
     if "--smoke" in args or flag("--smoke", cast=int):
         # CPU mode: the gate must be deterministic and CI-runnable — on any

@@ -1,0 +1,87 @@
+"""Serving SLO percentile aggregation (ISSUE 11 satellite): p50/p95/p99
+TTFT/TPOT and goodput-under-deadline over ``kind="request"`` telemetry
+records, so scheduling policies are comparable as NUMBERS, not logs —
+the SJF-vs-FCFS differential in the bench fleet gate is exactly two of
+these summaries diffed.
+
+Definitions (the serving-SLO vocabulary the scheduler emits):
+
+- **TTFT / TPOT / wall percentiles** are computed over TERMINAL records
+  only — ``finish_reason="retried"`` rows are resubmission lineage, not
+  outcomes (counting them would double-weight every request a replica
+  death touched).
+- **Goodput-under-deadline**: of the deadline-carrying terminal
+  requests, the fraction that actually FINISHED (``"length"``/``"eos"``)
+  within their deadline. Timed-out, shed, and late completions all count
+  against it — goodput is the number that keeps overload honest, because
+  raw throughput still looks fine while every request misses its SLO.
+- Percentiles use the **nearest-rank** method (no interpolation):
+  deterministic, exact on small CI-sized samples, and p99 of N<100
+  requests degrades to the max rather than inventing a value.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Any, Dict, Iterable, List, Optional
+
+__all__ = ["percentile", "summarize_requests", "GOODPUT_REASONS"]
+
+# finish reasons that count as useful completed work
+GOODPUT_REASONS = ("length", "eos")
+
+
+def percentile(values: Iterable[Optional[float]],
+               p: float) -> Optional[float]:
+    """Nearest-rank percentile of the non-None values (None if empty):
+    the smallest value with at least ``p``% of the sample at or below
+    it. ``p=50`` of [1,2,3,4] is 2; ``p=99`` of any sample <= 100 items
+    is the max."""
+    vals = sorted(v for v in values if v is not None)
+    if not vals:
+        return None
+    k = max(1, int(math.ceil(p / 100.0 * len(vals))))
+    return vals[min(k, len(vals)) - 1]
+
+
+def summarize_requests(records: List[Dict[str, Any]]
+                       ) -> Optional[Dict[str, Any]]:
+    """Aggregate ``kind="request"`` records into the SLO summary dict
+    (None when the stream has no request records — training-only runs
+    don't grow a serving block). See module docstring for semantics."""
+    reqs = [r for r in records if r.get("kind") == "request"]
+    if not reqs:
+        return None
+    terminal = [r for r in reqs if r.get("finish_reason") != "retried"]
+    out: Dict[str, Any] = {
+        "requests": len(terminal),
+        "retried_attempts": len(reqs) - len(terminal),
+        "finish_reasons": dict(collections.Counter(
+            r.get("finish_reason") or "?" for r in terminal)),
+        "new_tokens_total": sum(r.get("new_tokens") or 0
+                                for r in terminal),
+    }
+    # latency percentiles exclude shed records: a shed does no work and
+    # records wall_ms=0 by construction, so counting it would make p50
+    # wall IMPROVE exactly when overload is worst. Timeouts stay in —
+    # their latency was genuinely experienced.
+    latency = [r for r in terminal if r.get("finish_reason") != "shed"]
+    for key in ("ttft_ms", "tpot_ms", "wall_ms"):
+        vals = [r.get(key) for r in latency]
+        for p in (50, 95, 99):
+            v = percentile(vals, p)
+            out[f"{key}_p{p}"] = round(v, 4) if v is not None else None
+    dl = [r for r in terminal if r.get("deadline_s") is not None]
+    met = [r for r in dl
+           if r.get("finish_reason") in GOODPUT_REASONS
+           and r.get("wall_ms") is not None
+           and r["wall_ms"] <= r["deadline_s"] * 1e3]
+    out["deadline_requests"] = len(dl)
+    out["deadline_met"] = len(met)
+    out["goodput_pct"] = (round(100.0 * len(met) / len(dl), 2)
+                          if dl else None)
+    out["goodput_tokens"] = sum(r.get("new_tokens") or 0 for r in met)
+    out["shed"] = out["finish_reasons"].get("shed", 0)
+    out["timeout"] = out["finish_reasons"].get("timeout", 0)
+    return out

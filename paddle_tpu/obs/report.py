@@ -28,6 +28,8 @@ import os
 import sys
 from typing import Any, Dict, List, Optional
 
+from .percentiles import summarize_requests
+
 __all__ = ["load_records", "summarize", "format_summary", "main"]
 
 
@@ -129,6 +131,11 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         out["attribution_est_mfu_pct"] = att.get("est_mfu_pct")
         comm = att.get("comm") or {}
         out["attribution_exposed_comm_ms"] = comm.get("exposed_ms")
+    # serving SLO percentiles (ISSUE 11): p50/p95/p99 TTFT/TPOT +
+    # goodput-under-deadline over kind="request" records, when present
+    serving = summarize_requests(records)
+    if serving is not None:
+        out["serving"] = serving
     return out
 
 
@@ -183,6 +190,30 @@ def format_summary(s: Dict[str, Any]) -> str:
             if val is None:
                 continue
         lines.append(f"  {label:<28}{val}")
+    sv = s.get("serving")
+    if sv:
+        lines.append("serving requests")
+        lines.append(f"  {'requests (terminal / retried)':<28}"
+                     f"{sv.get('requests')} / "
+                     f"{sv.get('retried_attempts')}")
+        reasons = sv.get("finish_reasons") or {}
+        if reasons:
+            lines.append(f"  {'finish reasons':<28}"
+                         + ", ".join(f"{k}={v}"
+                                     for k, v in sorted(reasons.items())))
+        for key, label in (("ttft_ms", "TTFT ms"),
+                           ("tpot_ms", "TPOT ms"),
+                           ("wall_ms", "wall ms")):
+            ps = [sv.get(f"{key}_p{p}") for p in (50, 95, 99)]
+            if any(v is not None for v in ps):
+                lines.append(f"  {label + ' p50/p95/p99':<28}"
+                             + " / ".join(str(v) for v in ps))
+        if sv.get("goodput_pct") is not None:
+            lines.append(f"  {'goodput under deadline':<28}"
+                         f"{sv['goodput_pct']}% "
+                         f"({sv.get('deadline_met')}/"
+                         f"{sv.get('deadline_requests')}, "
+                         f"{sv.get('goodput_tokens')} tokens)")
     return "\n".join(lines)
 
 

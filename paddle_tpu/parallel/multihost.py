@@ -116,18 +116,24 @@ def heartbeat_path(root: str, host_id: int) -> str:
 
 
 def write_heartbeat(root: str, host_id: Optional[int] = None,
-                    seq: int = 0, now: Optional[float] = None) -> str:
+                    seq: int = 0, now: Optional[float] = None,
+                    extra: Optional[Dict] = None) -> str:
     """Write one heartbeat file atomically (tmp + rename, the checkpoint
     writer's recipe — a reader never sees a torn beat). The payload
     carries provenance a watchdog can act on: host id, PID, wall-clock
     ``ts``, and a monotonically increasing ``seq`` (distinguishes a live
-    host whose clock skews from a dead host whose file merely exists)."""
+    host whose clock skews from a dead host whose file merely exists).
+    ``extra`` merges additional JSON-safe payload fields (reserved keys
+    win) — the serving fleet rides its per-replica load report on the
+    beat, so a cross-process router could balance on the same evidence
+    it health-checks."""
     host_id = mesh_lib.host_id() if host_id is None else int(host_id)
     path = heartbeat_path(root, host_id)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    payload = {"host_id": host_id, "pid": os.getpid(),
-               "ts": time.time() if now is None else float(now),
-               "seq": int(seq)}
+    payload = dict(extra or {})
+    payload.update({"host_id": host_id, "pid": os.getpid(),
+                    "ts": time.time() if now is None else float(now),
+                    "seq": int(seq)})
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(payload, f)

@@ -1,26 +1,44 @@
-"""Serving runtime — continuous batching over a paged KV cache.
+"""Serving runtime — continuous batching over a paged KV cache, scaled
+out to a fault-drilled multi-replica fleet.
 
 The "millions of users" pillar (ROADMAP #1): training produced a
-checkpoint; this package turns it into an incremental-decode server. Three
-layers, mirroring the serving literature the design follows (PAPERS.md
-[S1] PagedAttention, [S2] Orca):
+checkpoint; this package turns it into an incremental-decode server.
+The layers mirror the serving literature the design follows (PAPERS.md
+[S1] PagedAttention, [S2] Orca, [R2] Bamboo for the death-is-routine
+doctrine):
 
 - :mod:`.kv_cache` — the paged KV pool: fixed-size blocks shared by all
   concurrent sequences, host-side block tables/alloc/free, ``jnp``-pure
   gather/scatter used by the compiled programs.
 - :mod:`.engine` — :class:`DecodeEngine`: the two compiled fixed-shape
   programs (padded-width prefill, max-slot decode tick with an active
-  mask), donated KV carry, greedy sampling, retrace accounting.
+  mask), donated KV carry, greedy sampling, retrace accounting, and the
+  structured :class:`AdmitProbe` backpressure verdict.
 - :mod:`.scheduler` — :class:`ContinuousBatchingScheduler`: iteration-
-  level request admission/eviction between decode ticks with per-request
-  TTFT/TPOT telemetry.
+  level request admission/eviction between decode ticks with
+  FCFS/SJF/priority queue policies, submit-time load shedding, deadline
+  eviction, and per-request TTFT/TPOT telemetry.
+- :mod:`.router` / :mod:`.fleet` — :class:`FleetRouter` +
+  :class:`ServingFleet` (ISSUE 11): N replica workers behind
+  session-affine least-loaded routing, heartbeat health gating (the
+  PR-10 machinery), idempotent rid-keyed resubmission of a dead
+  replica's requests, graceful drain for elastic scale-down.
+- :mod:`.loadgen` — seeded traffic shapes (Poisson/bursty arrivals,
+  ragged lengths, shareable-prefix sessions, deadlines/priorities) and
+  the :class:`SimClock` that makes fleet fault drills deterministic.
 """
 
 from .kv_cache import (BlockAllocator, PagedKVCache, gather_pages,
                        scatter_prefill, scatter_token)
-from .engine import DecodeEngine
+from .engine import AdmitProbe, DecodeEngine
 from .scheduler import ContinuousBatchingScheduler, Request
+from .router import FleetRouter, RouteDecision
+from .fleet import FleetRequest, ReplicaWorker, ServingFleet
+from .loadgen import GenRequest, SimClock, make_workload, workload_stats
 
-__all__ = ["BlockAllocator", "PagedKVCache", "DecodeEngine",
+__all__ = ["BlockAllocator", "PagedKVCache", "DecodeEngine", "AdmitProbe",
            "ContinuousBatchingScheduler", "Request", "gather_pages",
-           "scatter_prefill", "scatter_token"]
+           "scatter_prefill", "scatter_token",
+           "FleetRouter", "RouteDecision", "ServingFleet",
+           "ReplicaWorker", "FleetRequest",
+           "GenRequest", "SimClock", "make_workload", "workload_stats"]

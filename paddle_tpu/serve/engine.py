@@ -30,6 +30,7 @@ tests pin engine output against the training forward bit-for-bit.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any, Dict, List, Optional
 
@@ -39,7 +40,22 @@ import jax.numpy as jnp
 
 from .kv_cache import PagedKVCache, scatter_prefill
 
-__all__ = ["DecodeEngine"]
+__all__ = ["DecodeEngine", "AdmitProbe"]
+
+
+@dataclasses.dataclass
+class AdmitProbe:
+    """Structured admission verdict (ISSUE 11 satellite): WHY a request
+    can't start matters to the router — ``"slots"`` clears at the next
+    eviction (queue briefly), ``"blocks"`` is KV-pool saturation that can
+    persist for a straggler's whole lifetime (prefer another replica, or
+    shed), ``"width"`` can never clear (reject). ``ok`` mirrors the old
+    boolean ``can_admit`` answer."""
+    ok: bool
+    reason: Optional[str]          # None | "width" | "slots" | "blocks"
+    blocks_needed: int
+    free_blocks: int
+    free_slots: int
 
 
 def _resolve_attention(attention: str) -> str:
@@ -162,14 +178,38 @@ class DecodeEngine:
     def free_slots(self) -> List[int]:
         return [s for s in range(self.max_slots) if not self.active[s]]
 
+    def admit_probe(self, total_len: int,
+                    include_slots: bool = True) -> AdmitProbe:
+        """Structured admission check for a sequence that may grow to
+        ``total_len`` tokens (prompt + generation budget): the first
+        failing constraint, in never-clears-first order — ``"width"``
+        (exceeds slot capacity), ``"slots"`` (no free decode lane;
+        skipped with ``include_slots=False`` for callers that manage
+        slots themselves, like the scheduler), ``"blocks"`` (KV pool
+        can't cover the worst-case reservation)."""
+        blocks_needed = self.cache.blocks_needed(total_len)
+        free_slots = len(self.free_slots())
+        if total_len > self._W:
+            reason = "width"
+        elif include_slots and free_slots == 0:
+            reason = "slots"
+        elif blocks_needed > self.cache.free_blocks:
+            reason = "blocks"
+        else:
+            reason = None
+        return AdmitProbe(ok=reason is None, reason=reason,
+                          blocks_needed=blocks_needed,
+                          free_blocks=self.cache.free_blocks,
+                          free_slots=free_slots)
+
     def can_admit(self, total_len: int) -> bool:
         """Whether the pool can host a sequence that may grow to
         ``total_len`` tokens (prompt + generation budget). Admission
         reserves the worst case up front so a running request can never
-        strand mid-decode without a block (DESIGN_DECISIONS PR-9)."""
-        return (total_len <= self._W
-                and self.cache.blocks_needed(total_len)
-                <= self.cache.free_blocks)
+        strand mid-decode without a block (DESIGN_DECISIONS PR-9).
+        Boolean view of :meth:`admit_probe` (slot availability excluded —
+        the historical contract; the scheduler tracks slots itself)."""
+        return self.admit_probe(total_len, include_slots=False).ok
 
     # -- request lifecycle -------------------------------------------------
 

@@ -1,0 +1,595 @@
+"""Multi-replica serving fleet (ISSUE 11): replica death is routine.
+
+PR 10 made *training* recovery a supervised, continuously-fault-injected
+subsystem; this module applies the same doctrine to serving. A
+:class:`ServingFleet` runs N :class:`ReplicaWorker`\\ s — each one a
+:class:`~paddle_tpu.serve.engine.DecodeEngine` +
+:class:`~paddle_tpu.serve.scheduler.ContinuousBatchingScheduler` pair —
+behind a :class:`~paddle_tpu.serve.router.FleetRouter`, and guarantees
+that EVERY submitted request reaches a terminal ``finish_reason``
+(``"length"|"eos"|"timeout"|"shed"``) no matter which replica dies,
+stalls, or drains mid-flight.
+
+The recovery contract, and how each piece is honest about what a
+distributed deployment could actually know:
+
+- **Death is observed, not announced.** A killed replica simply stops
+  ticking and heartbeating; the router declares it dead only when its
+  heartbeat FILE (the PR-10 ``parallel/multihost`` machinery) goes stale
+  past the timeout. Until then its requests wait — exactly the
+  detection latency a real fleet pays.
+- **Resubmission is a reconcile sweep, keyed by request id.** The fleet
+  keeps the assignment table (rid → replica). Every tick it verifies
+  each non-terminal request is still held by a live replica that
+  actually KNOWS it; orphans (dead/released replica, or a delivery the
+  ``drop_submit`` fault ate) are resubmitted to a survivor with the
+  GLOBAL rid, the ORIGINAL submit timestamp (deadlines never reset),
+  and a bumped ``retries`` count. The abandoned attempt emits a
+  ``finish_reason="retried"`` request record — the lineage is in the
+  telemetry stream, one terminal record per rid, always.
+- **Resubmit is idempotent.** A duplicate delivery (the
+  ``duplicate_submit`` fault — an RPC retry racing its original) is
+  dropped at the replica boundary because the rid is already known
+  there; a completion for a superseded attempt is dropped at collection
+  because the fleet request is already terminal or re-homed
+  (``stale_completions`` counts both, asserting zero surprise).
+- **A stalled replica self-fences.** A replica that stops beating long
+  enough to be declared dead (a GC pause, a network partition) finds,
+  on waking, that its lease is gone: it evicts every slot, frees its
+  blocks, and stays out of service — it never completes a request the
+  fleet already re-homed (the Bamboo [R2] zombie rule).
+- **Drain is the elastic scale-down path.** ``drain(replica)`` stops
+  admission, re-routes the replica's QUEUED requests to survivors,
+  lets RUNNING slots finish in place, then releases the replica with
+  every block back in its pool — scale-down loses zero requests.
+
+Fault injection rides the PR-10 :class:`~paddle_tpu.train.faults.
+FaultSchedule` (``kill_replica_at_tick``, ``stall_replica_at_tick``,
+``drop_submit_at``, ``duplicate_submit_at``), so the whole fleet path is
+deterministically drilled in CI (``bench.py --fleet-child``) the same
+way ``run_resilient`` is.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import logging
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..parallel import multihost
+from .router import FleetRouter
+from .scheduler import ContinuousBatchingScheduler, Request
+
+__all__ = ["ReplicaWorker", "FleetRequest", "ServingFleet"]
+
+_log = logging.getLogger("paddle_tpu.serve.fleet")
+
+
+class ReplicaWorker:
+    """One serving replica: engine + scheduler + heartbeat + lifecycle.
+
+    ``state`` machine: ``"live"`` → (``drain``) → ``"draining"`` →
+    ``"released"``; any non-released state → ``"dead"`` (set ONLY by the
+    router's heartbeat verdict). ``killed`` and ``stall`` are fault-
+    injection flags beneath the state machine — they change what the
+    replica *does* (nothing), not what the fleet *knows* (that takes a
+    stale heartbeat)."""
+
+    def __init__(self, replica_id: int, engine, scheduler, root: str):
+        self.replica_id = int(replica_id)
+        self.engine = engine
+        self.scheduler = scheduler
+        self.root = root
+        self.state = "live"
+        self.killed = False
+        self._stall_until: Optional[int] = None
+        self._fenced = False
+        self.known: set = set()           # rids actually delivered here
+        self._collected = 0               # scheduler.completed cursor
+        self._hb_seq = 0
+
+    # -- fault hooks -------------------------------------------------------
+
+    def kill(self) -> None:
+        """Process death: no more ticks, no more beats. The engine's
+        blocks die with it (a real process loses its HBM); survivors'
+        pools are untouched."""
+        self.killed = True
+
+    def stall(self, until_tick: int) -> None:
+        """Hang (GC pause / partition) until the fleet tick index
+        ``until_tick``: no work, no beats — but unlike ``kill``, the
+        replica may wake, and must then self-fence if its lease died."""
+        self._stall_until = int(until_tick)
+
+    def stalled(self, tick: int) -> bool:
+        return self._stall_until is not None and tick < self._stall_until
+
+    # -- liveness ----------------------------------------------------------
+
+    def beat(self, now: float) -> None:
+        self._hb_seq += 1
+        multihost.write_heartbeat(
+            self.root, host_id=self.replica_id, seq=self._hb_seq, now=now,
+            extra={"role": "serving-replica",
+                   "pending_new_tokens": self.scheduler.pending_new_tokens(),
+                   "running": len(self.scheduler.running),
+                   "queued": len(self.scheduler.queue)})
+
+    def reset(self) -> None:
+        """Self-fence: evict every slot (blocks back to the pool), drop
+        all bookkeeping. Run by a replica that wakes from a stall to
+        find itself declared dead — its requests live elsewhere now."""
+        for slot in list(self.scheduler.running):
+            self.engine.evict(slot)
+        self.scheduler.running.clear()
+        self.scheduler.queue.clear()
+        self.known.clear()
+
+    def tick(self, now: float, tick_idx: int) -> None:
+        """One replica tick: step the scheduler, then beat. Killed,
+        released and stalled replicas do nothing; a dead one that can
+        still run (a woken zombie) fences itself exactly once."""
+        if self.killed or self.state == "released":
+            return
+        if self.state == "dead":
+            if not self._fenced and not self.stalled(tick_idx):
+                _log.warning("replica %d woke fenced (lease lost): "
+                             "resetting", self.replica_id)
+                self.reset()
+                self._fenced = True
+            return
+        if self.stalled(tick_idx):
+            return
+        self.scheduler.step()
+        self.beat(now)
+
+
+@dataclasses.dataclass
+class FleetRequest:
+    """One fleet-level request: the global identity (``rid``), the SLO
+    fields, the current assignment, and the resubmission lineage. The
+    terminal request record (the one non-"retried" telemetry record for
+    this rid) lands in ``record``."""
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int]
+    deadline_s: Optional[float]
+    priority: int
+    session_id: Optional[int]
+    submit_ts: float
+    replica: Optional[int] = None
+    retries: int = 0
+    attempts: List[int] = dataclasses.field(default_factory=list)
+    local: Optional[Request] = None       # current replica-side attempt
+    record: Optional[Dict[str, Any]] = None
+
+    @property
+    def done(self) -> bool:
+        return self.record is not None
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return self.record["finish_reason"] if self.record else None
+
+    @property
+    def tokens(self) -> List[int]:
+        return list(self.local.tokens) if self.local is not None else []
+
+
+class ServingFleet:
+    """N replica workers + a router + the recovery loop (see module
+    docstring).
+
+    Args:
+      make_engine: ``callable(replica_id) -> DecodeEngine`` — one engine
+        per replica (homogeneous capacity assumed for validation).
+      n_replicas: fleet width.
+      telemetry: shared :class:`~paddle_tpu.obs.Telemetry`; every
+        replica's request/evict records and the fleet's shed/replica
+        events land in one stream (records carry the GLOBAL rid).
+      root: heartbeat directory (a fresh tempdir by default).
+      clock: shared injectable clock — heartbeats, deadlines, arrival
+        replay and predictions all read it (``SimClock`` for CI).
+      heartbeat_timeout_s: staleness after which a replica is dead.
+      order / shed / est_tick_s: scheduler admission policy, router
+        shedding, and the cold-start tick-time prior (see
+        :class:`ContinuousBatchingScheduler`).
+      faults: a :class:`~paddle_tpu.train.faults.FaultSchedule` with the
+        serving points armed.
+    """
+
+    def __init__(self, make_engine: Callable[[int], Any],
+                 n_replicas: int, *, telemetry=None, root: Optional[str]
+                 = None, clock=None, heartbeat_timeout_s: float = 3.0,
+                 order: str = "fcfs", shed: bool = True,
+                 affinity: bool = True,
+                 est_tick_s: Optional[float] = None, faults=None):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.telemetry = telemetry
+        self.clock = clock if clock is not None else time.perf_counter
+        self.root = root or tempfile.mkdtemp(prefix="paddle_tpu_fleet_")
+        self.faults = faults
+        self.workers: List[ReplicaWorker] = []
+        for i in range(n_replicas):
+            eng = make_engine(i)
+            sched = ContinuousBatchingScheduler(
+                eng, telemetry=telemetry, order=order, shed=False,
+                est_tick_s=est_tick_s, clock=self.clock)
+            self.workers.append(ReplicaWorker(i, eng, sched, self.root))
+        self.router = FleetRouter(
+            self.workers, self.root,
+            heartbeat_timeout_s=heartbeat_timeout_s, clock=self.clock,
+            affinity=affinity, shed=shed)
+        now = self.clock()
+        for w in self.workers:            # join the fleet: first beat
+            w.beat(now)
+        self.requests: Dict[int, FleetRequest] = {}
+        # the non-terminal subset, kept separately so the per-tick
+        # reconcile/outstanding sweeps are O(in-flight), not
+        # O(everything ever submitted); `requests` is the full ledger
+        # (prune_terminal() bounds it for long-lived fleets)
+        self._active: Dict[int, FleetRequest] = {}
+        self._rid = itertools.count()
+        self._unplaced: List[FleetRequest] = []
+        self.ticks = 0
+        self.resubmits = 0
+        self.shed_count = 0
+        self.duplicates_dropped = 0
+        self.stale_completions = 0
+
+    # -- helpers -----------------------------------------------------------
+
+    def _worker(self, replica_id: int) -> ReplicaWorker:
+        return self.workers[replica_id]
+
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        if self.telemetry is not None:
+            self.telemetry.emit_event(rec)
+
+    def _replica_event(self, event: str, worker: ReplicaWorker,
+                       **extra) -> None:
+        self._emit({"kind": "replica", "event": event,
+                    "replica": worker.replica_id, "tick": self.ticks,
+                    **extra})
+
+    def _finalize(self, fr: FleetRequest, emit: bool = True) -> None:
+        """A request reached its terminal record: drop it from the
+        in-flight index (and emit the record when the fleet built it —
+        replica-side completions were already emitted by the
+        scheduler)."""
+        if emit:
+            self._emit(fr.record)
+        self._active.pop(fr.rid, None)
+
+    def _terminal_record(self, fr: FleetRequest, reason: str, now: float,
+                         **extra) -> Dict[str, Any]:
+        """Fleet-side terminal record (shed / parked-timeout — requests
+        no replica ever ran) built through ``Request.record()`` so the
+        schema lives in exactly one place."""
+        req = Request(rid=fr.rid, prompt=fr.prompt,
+                      max_new_tokens=fr.max_new_tokens, eos_id=fr.eos_id,
+                      deadline_s=fr.deadline_s, priority=fr.priority,
+                      retries=fr.retries, submit_ts=fr.submit_ts,
+                      finish_ts=now, finish_reason=reason)
+        rec = req.record()
+        rec.update(extra)
+        return rec
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None, priority: int = 0,
+               session_id: Optional[int] = None) -> FleetRequest:
+        """Route one request into the fleet. Returns a
+        :class:`FleetRequest` immediately — possibly already terminal
+        (``"shed"``)."""
+        width = self.workers[0].engine.context_width
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > width:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds slot capacity {width}")
+        now = self.clock()
+        fr = FleetRequest(rid=next(self._rid), prompt=list(prompt),
+                          max_new_tokens=max_new_tokens, eos_id=eos_id,
+                          deadline_s=deadline_s, priority=priority,
+                          session_id=session_id, submit_ts=now)
+        self.requests[fr.rid] = fr
+        self._active[fr.rid] = fr
+        dec = self.router.route(
+            prompt_len=len(fr.prompt), max_new_tokens=max_new_tokens,
+            deadline_s=deadline_s, session_id=session_id,
+            submit_ts=now, now=now)
+        if dec.shed:
+            self._shed(fr, dec)
+            return fr
+        if dec.worker is None:
+            self._unplaced.append(fr)     # no healthy capacity: park
+            return fr
+        self._deliver(fr, dec.worker)
+        if (self.faults is not None
+                and self.faults.should_duplicate_submit(fr.rid)):
+            # RPC-retry duplicate: same request delivered again — the
+            # replica-boundary rid check must drop it
+            self._deliver(fr, dec.worker)
+        return fr
+
+    def _deliver(self, fr: FleetRequest, worker: ReplicaWorker) -> None:
+        if fr.rid in worker.known:
+            self.duplicates_dropped += 1
+            return
+        fr.replica = worker.replica_id
+        fr.attempts.append(worker.replica_id)
+        if (self.faults is not None
+                and self.faults.should_drop_submit(fr.rid)):
+            # delivery lost after assignment: the replica never learns
+            # of the rid — the reconcile sweep must notice and resubmit
+            fr.local = None
+            return
+        fr.local = worker.scheduler.submit(
+            fr.prompt, fr.max_new_tokens, eos_id=fr.eos_id,
+            deadline_s=fr.deadline_s, priority=fr.priority, rid=fr.rid,
+            submit_ts=fr.submit_ts, retries=fr.retries)
+        worker.known.add(fr.rid)
+
+    def _shed(self, fr: FleetRequest, dec) -> None:
+        self.shed_count += 1
+        fr.record = self._terminal_record(
+            fr, "shed", fr.submit_ts,        # shed at submit: wall 0
+            shed_reason=dec.shed_reason,
+            predicted_completion_s=dec.predicted_completion_s)
+        self._finalize(fr)
+
+    # -- recovery ----------------------------------------------------------
+
+    def _resubmit(self, fr: FleetRequest, now: float,
+                  reason: str) -> None:
+        if fr.local is not None:
+            # abandon the old attempt with visible lineage: one
+            # "retried" record per abandoned attempt, never terminal
+            fr.local.finish_ts = now
+            fr.local.finish_reason = "retried"
+            self._emit(fr.local.record())
+        self.resubmits += 1
+        fr.retries += 1
+        fr.local, fr.replica = None, None
+        _log.warning("resubmitting rid=%d (%s), retry %d",
+                     fr.rid, reason, fr.retries)
+        dec = self.router.route(
+            prompt_len=len(fr.prompt),
+            max_new_tokens=fr.max_new_tokens, deadline_s=fr.deadline_s,
+            session_id=fr.session_id, submit_ts=fr.submit_ts, now=now,
+            allow_shed=False)
+        if dec.worker is None:
+            self._unplaced.append(fr)
+        else:
+            self._deliver(fr, dec.worker)
+
+    def _reconcile(self, now: float) -> None:
+        """The anti-entropy sweep: every non-terminal request must be
+        held by a live replica that knows its rid. Parked requests
+        retry placement first (capacity may have appeared)."""
+        self._place_parked(now)
+        if self._unplaced and not self.router.candidates():
+            # capacity emergency: parked work and zero live replicas.
+            # The drain guard can be raced (a replica killed just before
+            # the drain is only OBSERVED dead later), so scale-down
+            # yields: cancel a drain rather than strand requests.
+            w = next((w for w in self.workers if w.state == "draining"),
+                     None)
+            if w is not None:
+                w.state = "live"
+                _log.warning("drain of replica %d cancelled: no other "
+                             "live capacity for %d parked request(s)",
+                             w.replica_id, len(self._unplaced))
+                self._replica_event("drain-cancelled", w,
+                                    parked=len(self._unplaced))
+                self._place_parked(now)
+        for fr in list(self._active.values()):
+            if fr.record is not None or fr.replica is None:
+                continue
+            w = self._worker(fr.replica)
+            if w.state in ("dead", "released"):
+                self._resubmit(fr, now, f"replica-{w.state}")
+            elif fr.local is None and w.state in ("live", "draining"):
+                self._resubmit(fr, now, "lost-submit")
+
+    def _place_parked(self, now: float) -> None:
+        for fr in list(self._unplaced):
+            # a parked request still owns its deadline: no replica will
+            # ever run the scheduler's expiry sweep for it, so the fleet
+            # does — parked-forever must not exist
+            if (fr.deadline_s is not None
+                    and now - fr.submit_ts > fr.deadline_s):
+                self._unplaced.remove(fr)
+                fr.record = self._terminal_record(fr, "timeout", now)
+                self._finalize(fr)
+                continue
+            dec = self.router.route(
+                prompt_len=len(fr.prompt),
+                max_new_tokens=fr.max_new_tokens,
+                deadline_s=fr.deadline_s, session_id=fr.session_id,
+                submit_ts=fr.submit_ts, now=now, allow_shed=False)
+            if dec.worker is not None:
+                self._unplaced.remove(fr)
+                self._deliver(fr, dec.worker)
+
+    def _collect(self) -> None:
+        """Drain newly completed replica-side requests into fleet
+        terminal records. Completions from superseded attempts (the rid
+        was re-homed) or already-terminal rids are counted and dropped —
+        the idempotency boundary."""
+        for w in self.workers:
+            if w.killed or w.state in ("dead", "released"):
+                continue
+            comp = w.scheduler.completed
+            while w._collected < len(comp):
+                req = comp[w._collected]
+                w._collected += 1
+                fr = self.requests.get(req.rid)
+                if fr is None:
+                    continue
+                if fr.record is not None or fr.local is not req:
+                    self.stale_completions += 1
+                    continue
+                fr.record = req.record()
+                self._finalize(fr, emit=False)   # scheduler emitted it
+                w.known.discard(req.rid)
+
+    # -- elastic scale-down ------------------------------------------------
+
+    def drain(self, replica_id: int) -> str:
+        """Graceful drain: stop admitting to ``replica_id``, re-route
+        its queued (never-admitted) requests, let running slots finish,
+        then release. Returns the replica's state."""
+        w = self._worker(replica_id)
+        if w.state != "live":
+            return w.state
+        if not any(o.state == "live" for o in self.workers if o is not w):
+            raise ValueError(
+                f"cannot drain replica {replica_id}: it is the last live "
+                f"replica (scale-down below 1 would strand every "
+                f"outstanding request)")
+        w.state = "draining"
+        now = self.clock()
+        self._replica_event("draining", w)
+        for local in list(w.scheduler.queue):
+            w.scheduler.queue.remove(local)
+            w.known.discard(local.rid)
+            fr = self.requests.get(local.rid)
+            if fr is not None and fr.record is None:
+                self._resubmit(fr, now, "drain")
+        return w.state
+
+    # -- the fleet tick ----------------------------------------------------
+
+    def tick(self) -> None:
+        """One fleet heartbeat: fire scheduled faults, observe health,
+        reconcile assignments, tick every replica, collect completions,
+        finalize drains."""
+        now = self.clock()
+        t = self.ticks
+        if self.faults is not None:
+            k = self.faults.kill_replica_for_tick(t)
+            if k is not None:
+                self._worker(k).kill()
+            s = self.faults.stall_replica_for_tick(t)
+            if s is not None:
+                rep, n = s
+                self._worker(rep).stall(t + n)
+        for w in self.router.refresh_health(now):
+            self._replica_event(
+                "dead", w,
+                orphans=len(w.scheduler.queue) + len(w.scheduler.running))
+        self._reconcile(now)
+        for w in self.workers:
+            w.tick(now, t)
+        self._collect()
+        for w in self.workers:
+            if (w.state == "draining" and not w.scheduler.running
+                    and not w.scheduler.queue):
+                w.state = "released"
+                self._replica_event(
+                    "released", w,
+                    free_blocks=w.engine.cache.free_blocks)
+        self.ticks += 1
+
+    def outstanding(self) -> bool:
+        return (bool(self._active)
+                or any(w.state == "draining" for w in self.workers))
+
+    def prune_terminal(self) -> int:
+        """Drop terminal requests from the ledger (a long-lived fleet's
+        memory bound — the telemetry stream is the durable record).
+        Returns how many were pruned."""
+        dead = [rid for rid, fr in self.requests.items()
+                if fr.record is not None]
+        for rid in dead:
+            del self.requests[rid]
+        return len(dead)
+
+    # -- workload replay ---------------------------------------------------
+
+    def play(self, workload, *, dt_s: Optional[float] = None,
+             drain_at_tick: Optional[Dict[int, int]] = None,
+             max_ticks: int = 100000) -> List[FleetRequest]:
+        """Replay a :func:`~paddle_tpu.serve.loadgen.make_workload`
+        trace: submit every arrival whose ``at_s`` has passed, tick,
+        advance the clock (``SimClock`` + ``dt_s``; a real clock just
+        flows). Arrival times are relative to the START of the replay —
+        the clock's epoch (perf_counter's arbitrary origin, a SimClock
+        mid-run) must not collapse the trace into one burst.
+        ``drain_at_tick`` maps fleet tick index → replica id for
+        scripted elastic scale-down. Returns every
+        :class:`FleetRequest` in rid order, all terminal."""
+        pending = collections.deque(
+            sorted(workload, key=lambda g: g.at_s))
+        drains = dict(drain_at_tick or {})
+        t0 = self.clock()
+        for _ in range(max_ticks):
+            now = self.clock() - t0
+            while pending and pending[0].at_s <= now:
+                g = pending.popleft()
+                self.submit(g.prompt, g.max_new_tokens, eos_id=g.eos_id,
+                            deadline_s=g.deadline_s, priority=g.priority,
+                            session_id=g.session_id)
+            if self.ticks in drains:
+                self.drain(drains.pop(self.ticks))
+            if not pending and not drains and not self.outstanding():
+                return [self.requests[r] for r in sorted(self.requests)]
+            self.tick()
+            adv = getattr(self.clock, "advance", None)
+            if adv is not None and dt_s is not None:
+                adv(dt_s)
+        raise RuntimeError(f"fleet did not drain in {max_ticks} ticks "
+                           f"({sum(1 for f in self.requests.values() if not f.done)} "
+                           f"requests outstanding)")
+
+    # -- reporting ---------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        reasons = collections.Counter(
+            fr.record["finish_reason"]
+            for fr in self.requests.values() if fr.record)
+        return {
+            "submitted": len(self.requests),
+            "terminal": sum(1 for fr in self.requests.values()
+                            if fr.record is not None),
+            "finish_reasons": dict(reasons),
+            "resubmits": self.resubmits,
+            "shed": self.shed_count,
+            "duplicates_dropped": self.duplicates_dropped,
+            "stale_completions": self.stale_completions,
+            "unplaced": len(self._unplaced),
+            "ticks": self.ticks,
+            "replicas": {
+                w.replica_id: {
+                    "state": w.state, "killed": w.killed,
+                    "engine_ticks": w.engine.ticks,
+                    "free_blocks": w.engine.cache.free_blocks,
+                    "compile_counts": w.engine.compile_counts(),
+                } for w in self.workers},
+        }
+
+    @classmethod
+    def from_model(cls, model, variables, n_replicas: int, *,
+                   engine_kwargs: Optional[Dict[str, Any]] = None,
+                   **kw) -> "ServingFleet":
+        """Convenience constructor: N identical engines over one
+        checkpoint (the common homogeneous fleet)."""
+        from .engine import DecodeEngine
+        ek = dict(engine_kwargs or {})
+
+        def mk(_i):
+            return DecodeEngine(model, variables, **ek)
+
+        return cls(mk, n_replicas, **kw)

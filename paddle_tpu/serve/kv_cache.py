@@ -169,6 +169,12 @@ class PagedKVCache:
     def blocks_needed(self, length: int) -> int:
         return -(-length // self.block_size)          # ceil
 
+    def owned_count(self, slot: int) -> int:
+        """How many pool blocks ``slot`` currently holds — the size of
+        the reservation an eviction returns to the free list (leak
+        accounting for the eviction telemetry and tests)."""
+        return len(self._owned[slot])
+
     # -- slot lifecycle ----------------------------------------------------
 
     def ensure_capacity(self, slot: int, new_len: int) -> bool:

@@ -1,0 +1,165 @@
+"""Traffic-realistic workload generation (ISSUE 11): "millions of users"
+is a traffic *shape*, not a tokens/sec number — this module generates the
+shape so the fleet can be scheduled under it, measured under it, and
+fault-drilled under it, deterministically.
+
+Three properties of production LLM traffic the serving literature keeps
+measuring (PagedAttention's motivating traces [S1], Orca's arrival model
+[S2]), each seeded and reproducible here:
+
+- **Arrival process**: Poisson (independent users) or bursty — a
+  two-state modulated Poisson (thundering herds, retry storms): gaps are
+  exponential at ``rate_rps`` in the quiet state and
+  ``rate_rps * burst_factor`` inside a burst, with the state flipping
+  with probability ``1/burst_len`` per arrival (geometric burst and
+  quiet lengths).
+- **Ragged lengths**: prompt and decode budgets are log-normal around
+  the geometric mean of their ``(lo, hi)`` range, clipped — short
+  requests dominate, long stragglers exist, which is exactly the shape
+  continuous batching and SJF exist to absorb.
+- **Sessions with shareable prefixes**: a fraction ``p_session`` of
+  requests belong to one of ``n_sessions`` conversations, sharing that
+  session's fixed prompt prefix (system prompt / chat history) plus a
+  fresh tail — the trace shape prefix-cache block sharing and router
+  session affinity are measured against.
+
+Per-request SLO fields ride along: a deadline (uniform in a range, on a
+``p_deadline`` fraction of requests) and a priority tier drawn from
+``priorities``. The output is a plain list of :class:`GenRequest`
+sorted by arrival time; ``ServingFleet.play`` (or any custom loop)
+replays it against an injectable clock — :class:`SimClock` for
+deterministic CI, the wall clock for real measurement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["GenRequest", "SimClock", "make_workload", "workload_stats"]
+
+
+class SimClock:
+    """An injectable virtual clock: the drive loop advances it a fixed
+    ``dt`` per fleet tick, so arrivals, deadlines, heartbeat staleness
+    and predicted delays are all deterministic functions of tick counts —
+    the whole fleet fault drill replays bit-identically in CI."""
+
+    def __init__(self, t0: float = 0.0):
+        self.t = float(t0)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+@dataclasses.dataclass
+class GenRequest:
+    """One generated arrival: submit ``prompt`` at ``at_s`` with the
+    decode budget and SLO fields attached."""
+    at_s: float
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    deadline_s: Optional[float] = None
+    priority: int = 0
+    session_id: Optional[int] = None
+
+
+def _ragged(rng: np.random.RandomState, lo: int, hi: int,
+            sigma: float) -> int:
+    """Log-normal length around the geometric mean of [lo, hi], clipped.
+    sigma=0 degenerates to the geometric mean (deterministic lengths).
+    The lower bound is clamped to 1: lengths are counts (a 0 bound would
+    put 0 inside the log)."""
+    lo, hi = max(1, int(lo)), int(hi)
+    if hi <= lo:
+        return lo
+    mu = math.log(math.sqrt(lo * hi))
+    x = rng.lognormal(mu, sigma) if sigma > 0 else math.exp(mu)
+    return int(np.clip(int(round(x)), lo, hi))
+
+
+def make_workload(n_requests: int, vocab: int, *, seed: int = 0,
+                  rate_rps: float = 8.0, arrival: str = "poisson",
+                  burst_factor: float = 6.0, burst_len: float = 4.0,
+                  prompt_len: Tuple[int, int] = (2, 12),
+                  max_new: Tuple[int, int] = (2, 12),
+                  sigma: float = 0.6,
+                  n_sessions: int = 0, session_prefix_len: int = 6,
+                  p_session: float = 0.6,
+                  deadline_s=None, p_deadline: float = 1.0,
+                  priorities: Sequence[int] = (0,),
+                  priority_weights: Optional[Sequence[float]] = None,
+                  eos_id: Optional[int] = None,
+                  max_total: Optional[int] = None) -> List[GenRequest]:
+    """Generate ``n_requests`` seeded arrivals (see module docstring for
+    the model). ``deadline_s`` is a float or ``(lo, hi)`` range applied
+    to a ``p_deadline`` fraction of requests; ``max_total`` clamps
+    ``prompt + max_new`` to a slot capacity (the generator trims the
+    decode budget first, then the prompt tail)."""
+    if arrival not in ("poisson", "bursty"):
+        raise ValueError(f"arrival must be 'poisson'|'bursty', "
+                         f"got {arrival!r}")
+    rng = np.random.RandomState(seed)
+    prefixes = [list(rng.randint(1, vocab, session_prefix_len))
+                for _ in range(n_sessions)]
+    out: List[GenRequest] = []
+    t, in_burst = 0.0, False
+    for _ in range(n_requests):
+        rate = rate_rps * (burst_factor if in_burst else 1.0)
+        t += float(rng.exponential(1.0 / max(rate, 1e-9)))
+        if arrival == "bursty" and rng.rand() < 1.0 / max(burst_len, 1.0):
+            in_burst = not in_burst
+
+        plen = _ragged(rng, *prompt_len, sigma=sigma)
+        mnew = _ragged(rng, *max_new, sigma=sigma)
+        sid: Optional[int] = None
+        if n_sessions > 0 and rng.rand() < p_session:
+            sid = int(rng.randint(n_sessions))
+            tail = max(1, plen - session_prefix_len)
+            prompt = prefixes[sid] + list(rng.randint(1, vocab, tail))
+        else:
+            prompt = list(rng.randint(1, vocab, max(1, plen)))
+        if max_total is not None:
+            prompt = prompt[:max(1, max_total - 1)]
+            mnew = max(1, min(mnew, max_total - len(prompt)))
+
+        dl: Optional[float] = None
+        if deadline_s is not None and rng.rand() < p_deadline:
+            if isinstance(deadline_s, (tuple, list)):
+                dl = float(rng.uniform(deadline_s[0], deadline_s[1]))
+            else:
+                dl = float(deadline_s)
+        prio = int(rng.choice(list(priorities), p=priority_weights))
+        out.append(GenRequest(at_s=round(t, 6), prompt=prompt,
+                              max_new_tokens=mnew, eos_id=eos_id,
+                              deadline_s=dl, priority=prio,
+                              session_id=sid))
+    return out
+
+
+def workload_stats(workload: List[GenRequest]) -> dict:
+    """Shape summary of a generated workload (for bench records)."""
+    if not workload:
+        return {"n": 0}
+    gaps = np.diff([g.at_s for g in workload]) if len(workload) > 1 else [0]
+    return {
+        "n": len(workload),
+        "span_s": round(workload[-1].at_s - workload[0].at_s, 4),
+        "mean_gap_s": round(float(np.mean(gaps)), 4),
+        "max_gap_s": round(float(np.max(gaps)), 4),
+        "mean_prompt_len": round(float(np.mean(
+            [len(g.prompt) for g in workload])), 2),
+        "mean_max_new": round(float(np.mean(
+            [g.max_new_tokens for g in workload])), 2),
+        "with_deadline": sum(g.deadline_s is not None for g in workload),
+        "with_session": sum(g.session_id is not None for g in workload),
+        "sessions": len({g.session_id for g in workload
+                         if g.session_id is not None}),
+    }

@@ -1,0 +1,170 @@
+"""Fleet routing policy (ISSUE 11): which replica gets a request, and
+whether it gets one at all.
+
+The router is deliberately a POLICY object, separate from the fleet's
+mechanics: it reads replica health and load, and returns a
+:class:`RouteDecision` — place on this worker, park (no healthy
+capacity), or shed. The fleet owns delivery, lineage and resubmission.
+
+Three policy layers, in decision order:
+
+- **Health gating** reuses the PR-10 heartbeat machinery
+  (``parallel/multihost``): every replica worker writes an atomic
+  heartbeat file under the fleet root each tick, and
+  :meth:`FleetRouter.refresh_health` declares a replica dead when its
+  beat goes stale past ``heartbeat_timeout_s`` — the SAME evidence a
+  cross-process or cross-host deployment would use (the beats carry a
+  load payload for that future, though in-process placement reads the
+  scheduler directly). Death is declared from FILE staleness, never
+  from in-process knowledge: a killed replica is only treated as dead
+  once the watchdog could have known (Bamboo's lesson [R2] — death is
+  routine, and it is OBSERVED, not announced).
+- **Placement**: session affinity first — a session's requests re-land
+  on the replica already holding its prefix KV (the prefix-cache
+  locality the ROADMAP block-sharing item will exploit; mappings
+  self-heal when the pinned replica dies or drains) — then least-loaded
+  by ``pending_new_tokens`` (the tick-denominated backlog), replica id
+  breaking ties deterministically.
+- **Shedding**: a deadline-carrying request whose predicted completion
+  on the chosen replica already blows its remaining budget is rejected
+  NOW (``finish_reason="shed"``) instead of queued to die later; the
+  engine's structured :class:`~paddle_tpu.serve.engine.AdmitProbe`
+  reason rides the decision so the record says WHY ("blocks" pool
+  saturation vs plain queue delay). Resubmissions after a replica death
+  are never shed — the user already waited; the deadline eviction path
+  owns that verdict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from ..parallel import multihost
+
+__all__ = ["FleetRouter", "RouteDecision"]
+
+
+@dataclasses.dataclass
+class RouteDecision:
+    """The router's verdict for one request: ``worker`` (None = no
+    healthy capacity — park and retry), ``shed`` with the reason, the
+    predicted completion estimate that drove it, and the chosen
+    replica's structured admission backpressure."""
+    worker: Optional[Any] = None
+    shed: bool = False
+    shed_reason: Optional[str] = None
+    predicted_completion_s: Optional[float] = None
+    backpressure: Optional[str] = None
+    affinity_hit: bool = False
+
+
+class FleetRouter:
+    """Load-balances requests over ``workers`` (ReplicaWorker list) with
+    session affinity, heartbeat health gating, and SLO shedding."""
+
+    def __init__(self, workers: List[Any], root: str, *,
+                 heartbeat_timeout_s: float = 3.0,
+                 clock=time.perf_counter, affinity: bool = True,
+                 shed: bool = True, max_sessions: int = 4096):
+        self.workers = list(workers)
+        self.root = root
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.clock = clock
+        self.affinity = affinity
+        self.shed = shed
+        # session_id -> replica_id, LRU-bounded at max_sessions: pins
+        # are refreshed on every route, so evicting the coldest pin
+        # costs at worst one prefix-locality miss for a dormant session
+        # — never unbounded growth on a long-lived fleet
+        self.max_sessions = int(max_sessions)
+        self.sessions: Dict[int, int] = {}
+
+    # -- health ------------------------------------------------------------
+
+    def refresh_health(self, now: Optional[float] = None) -> List[Any]:
+        """Probe the heartbeat files and flip stale replicas to
+        ``"dead"``; returns the NEWLY dead workers (the fleet resubmits
+        their requests). Only live/draining replicas are expected to
+        beat — released ones left quietly."""
+        now = self.clock() if now is None else now
+        expected = [w.replica_id for w in self.workers
+                    if w.state in ("live", "draining")]
+        if not expected:
+            return []
+        stale = set(multihost.detect_dead_hosts(
+            self.root, self.heartbeat_timeout_s,
+            expected_hosts=expected, now=now))
+        newly = []
+        for w in self.workers:
+            if w.replica_id in stale and w.state in ("live", "draining"):
+                w.state = "dead"
+                newly.append(w)
+                # unpin this replica's sessions: they re-pin wherever
+                # their next request lands
+                for sid in [s for s, r in self.sessions.items()
+                            if r == w.replica_id]:
+                    del self.sessions[sid]
+        return newly
+
+    def candidates(self) -> List[Any]:
+        """Placeable replicas: live state (draining replicas finish what
+        they have but admit nothing new — the drain contract)."""
+        return [w for w in self.workers if w.state == "live"]
+
+    # -- placement ---------------------------------------------------------
+
+    def route(self, *, prompt_len: int, max_new_tokens: int,
+              deadline_s: Optional[float] = None,
+              session_id: Optional[int] = None,
+              submit_ts: Optional[float] = None,
+              now: Optional[float] = None,
+              allow_shed: bool = True) -> RouteDecision:
+        cands = self.candidates()
+        if not cands:
+            return RouteDecision(worker=None)
+        least = min(cands, key=lambda w: (
+            w.scheduler.pending_new_tokens(), w.replica_id))
+        chosen, hit = None, False
+        if self.affinity and session_id is not None:
+            pinned = self.sessions.get(session_id)
+            chosen = next((w for w in cands if w.replica_id == pinned),
+                          None)
+            hit = chosen is not None
+        if chosen is None:
+            chosen = least
+
+        def would_shed(worker):
+            if not (allow_shed and self.shed and deadline_s is not None):
+                return False
+            est = worker.scheduler.predicted_completion_s(max_new_tokens)
+            if est is None:
+                return False
+            t = self.clock() if now is None else now
+            waited = (0.0 if submit_ts is None
+                      else max(0.0, t - submit_ts))
+            return waited + est > deadline_s
+
+        if would_shed(chosen) and chosen is not least:
+            # affinity must not cost the deadline: before a terminal
+            # shed verdict, fall back to the least-loaded replica —
+            # losing prefix locality beats losing the request
+            chosen, hit = least, False
+        est = chosen.scheduler.predicted_completion_s(max_new_tokens)
+        probe = chosen.engine.admit_probe(
+            max(prompt_len + max_new_tokens - 1, prompt_len))
+        if would_shed(chosen):
+            return RouteDecision(
+                worker=None, shed=True,
+                shed_reason=probe.reason or "delay",
+                predicted_completion_s=est,
+                backpressure=probe.reason)
+        if self.affinity and session_id is not None:
+            # refresh the LRU pin (re-insert moves it to newest)
+            self.sessions.pop(session_id, None)
+            self.sessions[session_id] = chosen.replica_id
+            while len(self.sessions) > self.max_sessions:
+                self.sessions.pop(next(iter(self.sessions)))
+        return RouteDecision(worker=chosen, predicted_completion_s=est,
+                             backpressure=probe.reason, affinity_hit=hit)

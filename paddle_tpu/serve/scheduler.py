@@ -20,6 +20,18 @@ Per-request telemetry (the serving SLO vocabulary): **TTFT** (time to
 first token — submit to prefill's greedy token) and **TPOT** (time per
 output token — mean inter-token gap over the decode ticks), emitted as
 one ``kind="request"`` record per completed request.
+
+SLO-aware admission (ISSUE 11): because admission happens between ticks,
+the QUEUE ORDER is the whole scheduling policy surface — exactly Orca's
+point. ``order="fcfs"`` admits in arrival order, ``order="sjf"``
+shortest-job-first by decode budget (short requests stop dying behind
+stragglers — the goodput-under-deadline win the bench fleet gate
+measures), ``order="priority"`` by descending ``priority`` tier. On top,
+``shed=True`` rejects a deadline-carrying request AT SUBMIT when the
+predicted completion time already blows its deadline
+(``finish_reason="shed"``) — under overload the queue stops growing and
+p99 for admitted requests stays bounded, instead of every request
+timing out after burning a slot reservation.
 """
 
 from __future__ import annotations
@@ -29,7 +41,11 @@ import itertools
 import time
 from typing import Any, Dict, List, Optional
 
-__all__ = ["Request", "ContinuousBatchingScheduler"]
+__all__ = ["Request", "ContinuousBatchingScheduler", "ORDERS"]
+
+# queue-order policies: arrival order, shortest-decode-budget-first,
+# descending priority tier (ties broken by arrival in all three)
+ORDERS = ("fcfs", "sjf", "priority")
 
 
 @dataclasses.dataclass
@@ -41,13 +57,20 @@ class Request:
     ticks with ``finish_reason="timeout"`` and its blocks freed — a
     stuck/long request can no longer occupy a slot and its worst-case
     block reservation forever (ISSUE 10). ``finish_reason`` is
-    ``"length"`` | ``"eos"`` | ``"timeout"``, surfaced in the
-    per-request telemetry record."""
+    ``"length"`` | ``"eos"`` | ``"timeout"`` | ``"shed"`` (rejected at
+    submit) | ``"retried"`` (attempt abandoned and resubmitted on
+    another fleet replica — never terminal), surfaced in the per-request
+    telemetry record. ``priority``/``retries`` carry the SLO tier and
+    the fleet resubmission lineage; ``seq`` is the scheduler-local
+    arrival index the order policies tie-break on."""
     rid: int
     prompt: List[int]
     max_new_tokens: int
     eos_id: Optional[int] = None
     deadline_s: Optional[float] = None
+    priority: int = 0
+    retries: int = 0
+    seq: int = 0
     tokens: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
     submit_ts: float = 0.0
@@ -82,12 +105,16 @@ class Request:
             "slot": self.slot,
             "finish_reason": self.finish_reason,
             "deadline_s": self.deadline_s,
+            "priority": self.priority,
+            "retries": self.retries,
             "ttft_ms": round(self.ttft_ms, 4)
             if self.ttft_ms is not None else None,
             "tpot_ms": round(self.tpot_ms, 4)
             if self.tpot_ms is not None else None,
+            # `is not None`, not truthiness: a fake-clock run can finish
+            # at ts exactly 0.0 and must still record its wall time
             "wall_ms": round((self.finish_ts - self.submit_ts) * 1e3, 4)
-            if self.finish_ts else None,
+            if self.finish_ts is not None else None,
         }
 
 
@@ -100,42 +127,108 @@ class ContinuousBatchingScheduler:
     when EVERY slot is free and runs until all its members finish (the
     differential the bench serving gate measures: on ragged lengths
     continuous wins exactly the idle-lane ticks static burns).
+
+    ``order`` picks the admission policy over the queue (see module
+    docstring); ``shed=True`` enables submit-time load shedding, which
+    needs a tick-time estimate: pass ``est_tick_s`` as the cold-start
+    prior (the scheduler keeps an EMA over observed inter-step clock
+    deltas thereafter; with no estimate and no observations, nothing is
+    shed — reject-fast needs evidence).
     """
 
     def __init__(self, engine, telemetry=None, policy: str = "continuous",
+                 order: str = "fcfs", shed: bool = False,
+                 est_tick_s: Optional[float] = None,
                  clock=time.perf_counter):
         if policy not in ("continuous", "static"):
             raise ValueError(f"policy must be 'continuous'|'static', "
                              f"got {policy!r}")
+        if order not in ORDERS:
+            raise ValueError(f"order must be one of {ORDERS}, got {order!r}")
         self.engine = engine
         self.telemetry = (telemetry if telemetry is not None
                           else engine.telemetry)
         self.policy = policy
+        self.order = order
+        self.shed = shed
+        self.est_tick_s = est_tick_s
         # injectable wall clock: deadlines are tested deterministically
         # with a fake clock; production uses perf_counter
         self._clock = clock
         self.queue: List[Request] = []
         self.running: Dict[int, Request] = {}       # slot -> request
         self.completed: List[Request] = []
+        # the last refusal's structured reason ("blocks"|"width"), for
+        # router placement/shedding — None while admission is flowing
+        self.last_backpressure: Optional[str] = None
         self._rid = itertools.count()
+        self._seq = itertools.count()
+        self._last_step_ts: Optional[float] = None
+        self._was_busy = False
+
+    # -- load model --------------------------------------------------------
+
+    def pending_new_tokens(self) -> int:
+        """Decode tokens still owed: remaining budget of every running
+        slot plus the full budget of every queued request — the
+        scheduler's load number (one token per active slot per tick, so
+        this is a tick-denominated backlog)."""
+        run = sum(r.max_new_tokens - len(r.tokens)
+                  for r in self.running.values())
+        return run + sum(r.max_new_tokens for r in self.queue)
+
+    def predicted_completion_s(self, max_new_tokens: int
+                               ) -> Optional[float]:
+        """Predicted submit-to-finish seconds for a new request under the
+        current backlog, or None without a tick-time estimate. The model
+        is deliberately coarse — service rate is ``max_slots`` tokens per
+        tick (the full-batch upper bound), so the queue delay is
+        ``backlog / max_slots`` ticks and the run time ``max_new`` ticks.
+        It is an underestimate under partial occupancy, which biases
+        shedding conservative (shed less, queue more)."""
+        if self.est_tick_s is None:
+            return None
+        ticks = (self.pending_new_tokens() / max(1, self.engine.max_slots)
+                 + max_new_tokens)
+        return ticks * self.est_tick_s
 
     # -- submission --------------------------------------------------------
 
     def submit(self, prompt: List[int], max_new_tokens: int,
                eos_id: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               priority: int = 0, rid: Optional[int] = None,
+               submit_ts: Optional[float] = None,
+               retries: int = 0) -> Request:
+        """Queue one request. ``rid``/``submit_ts``/``retries`` are for
+        the fleet path: a resubmitted request keeps its GLOBAL id and its
+        ORIGINAL submit time, so TTFT/wall/deadline are end-to-end truth
+        (a user's deadline does not reset because a replica died). When
+        ``rid`` is supplied the caller owns id uniqueness."""
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if deadline_s is not None and deadline_s < 0:
             raise ValueError("deadline_s must be >= 0")
-        req = Request(rid=next(self._rid), prompt=list(prompt),
+        now = self._clock()
+        req = Request(rid=next(self._rid) if rid is None else rid,
+                      prompt=list(prompt),
                       max_new_tokens=max_new_tokens, eos_id=eos_id,
-                      deadline_s=deadline_s, submit_ts=self._clock())
+                      deadline_s=deadline_s, priority=priority,
+                      retries=retries, seq=next(self._seq),
+                      submit_ts=now if submit_ts is None else submit_ts)
         if len(req.prompt) + max_new_tokens > self.engine.context_width:
             raise ValueError(
                 f"prompt {len(req.prompt)} + max_new_tokens "
                 f"{max_new_tokens} exceeds slot capacity "
                 f"{self.engine.context_width}")
+        if self.shed and deadline_s is not None:
+            est = self.predicted_completion_s(max_new_tokens)
+            waited = max(0.0, now - req.submit_ts)
+            if est is not None and waited + est > deadline_s:
+                # reject-fast: no slot, no blocks, no staging — overload
+                # degrades goodput gracefully instead of collapsing p99
+                self._finish(req, "shed")
+                return req
         # stage the padded prefill array now — admission-path host prep
         # off the tick loop's critical path (the PR-3 staging move)
         req._staged = self.engine.stage_prompt(req.prompt)
@@ -156,6 +249,20 @@ class ContinuousBatchingScheduler:
         if self.telemetry is not None:
             self.telemetry.emit_event(req.record())
 
+    def _emit_evict(self, req: Request, where: str,
+                    blocks_freed: int) -> None:
+        """One ``kind="evict"`` record per deadline eviction — BOTH the
+        running-slot case and the queued-drop case are visible (ISSUE 11
+        satellite: a queued request dying of backpressure starvation must
+        show up in telemetry, not just slot evictions)."""
+        if self.telemetry is not None:
+            self.telemetry.emit_event({
+                "kind": "evict", "rid": req.rid, "where": where,
+                "blocks_freed": blocks_freed,
+                "deadline_s": req.deadline_s,
+                "queued": len(self.queue), "running": len(self.running),
+            })
+
     def _expire(self) -> None:
         """Deadline sweep, run BETWEEN ticks (the same boundary where
         admissions/evictions already happen — the compiled tick shape
@@ -170,24 +277,47 @@ class ContinuousBatchingScheduler:
 
         for slot, req in list(self.running.items()):
             if expired(req):
+                self._emit_evict(req, "running",
+                                 self.engine.cache.owned_count(slot))
                 self._finish(req, "timeout")
         for req in [r for r in self.queue if expired(r)]:
             self.queue.remove(req)
+            self._emit_evict(req, "queued", 0)
             self._finish(req, "timeout")
 
+    def _admit_order(self) -> List[Request]:
+        """The queue in admission order under the active policy. FCFS is
+        the queue itself; SJF sorts by decode budget (the dominant cost —
+        prefill is one tick regardless of prompt length); priority sorts
+        by descending tier. Arrival breaks every tie, so equal-key
+        requests never starve each other."""
+        if self.order == "sjf":
+            return sorted(self.queue, key=lambda r: (r.max_new_tokens,
+                                                     r.seq))
+        if self.order == "priority":
+            return sorted(self.queue, key=lambda r: (-r.priority, r.seq))
+        return list(self.queue)
+
     def _admit(self) -> None:
+        self.last_backpressure = None    # cleared even on the gang wait
         if self.policy == "static" and self.running:
             return                       # gang: wait for the whole batch
         free = self.engine.free_slots()
-        while self.queue and free:
-            req = self.queue[0]
+        for req in self._admit_order():
+            if not free:
+                break
             # a decode tick appends the pending token BEFORE sampling, so
             # the cache must hold prompt + all generated tokens except
             # the last sampled one: reserve prompt + max_new - 1
-            target = len(req.prompt) + req.max_new_tokens - 1
-            if not self.engine.can_admit(max(target, len(req.prompt))):
-                break                    # pool backpressure: try next tick
-            self.queue.pop(0)
+            target = max(len(req.prompt) + req.max_new_tokens - 1,
+                         len(req.prompt))
+            probe = self.engine.admit_probe(target, include_slots=False)
+            if not probe.ok:
+                # pool backpressure: stop in strict policy order (no
+                # smaller-request bypass — bypass would starve the head)
+                self.last_backpressure = probe.reason
+                break
+            self.queue.remove(req)
             slot = free.pop(0)
             tok = self.engine.admit(slot, req.prompt, reserve_len=target,
                                     staged=getattr(req, "_staged", None))
@@ -207,6 +337,19 @@ class ContinuousBatchingScheduler:
     def step(self) -> bool:
         """Expire deadlines, admit, run one decode tick, collect
         finished requests. Returns True while work remains."""
+        now = self._clock()
+        if self._last_step_ts is not None and self._was_busy:
+            # EMA over inter-step deltas: the shed predictor's tick-time
+            # evidence (deterministic under a fake clock — the injected
+            # advances ARE the observations). Only deltas between
+            # consecutive BUSY steps count: after an idle lull the gap
+            # is think time, not tick time, and folding it in would make
+            # the predictor shed against an empty engine.
+            dt = now - self._last_step_ts
+            if dt > 0:
+                self.est_tick_s = (dt if self.est_tick_s is None
+                                   else 0.7 * self.est_tick_s + 0.3 * dt)
+        self._last_step_ts = now
         self._expire()
         self._admit()
         if self.running:
@@ -215,7 +358,8 @@ class ContinuousBatchingScheduler:
                 tok = int(front[slot])
                 req.tokens.append(tok)
                 self._maybe_finish(slot, tok)
-        return bool(self.queue or self.running)
+        self._was_busy = bool(self.queue or self.running)
+        return self._was_busy
 
     def run(self, max_ticks: int = 100000) -> List[Request]:
         """Drive ticks until the queue drains; returns completed

@@ -44,6 +44,22 @@ Injection points (who checks them):
 - ``stager_error_at_group`` — the host-pipeline stager thread, at the
   group starting at that batch index: raises in the worker, surfacing
   through ``GroupStager``'s producer-error propagation.
+
+Serving points (ISSUE 11; checked by ``serve.fleet.ServingFleet``, keyed
+by fleet tick index or fleet request id — never wall clock):
+
+- ``kill_replica_at_tick`` — ``(tick, replica)``: that replica dies at
+  the start of that fleet tick (stops ticking AND heartbeating; the
+  router must OBSERVE the death via heartbeat staleness and resubmit).
+- ``stall_replica_at_tick`` — ``(tick, replica, n_ticks)``: the replica
+  hangs for ``n_ticks`` (no work, no beats) then wakes — the zombie
+  drill: if it was declared dead meanwhile it must self-fence.
+- ``drop_submit_at`` — fleet request id whose replica delivery is lost
+  after the router records the assignment (a lost RPC); the reconcile
+  sweep must notice and resubmit.
+- ``duplicate_submit_at`` — fleet request id delivered twice (an RPC
+  retry racing its original); the rid-keyed idempotency boundary must
+  drop the duplicate.
 """
 
 from __future__ import annotations
@@ -127,7 +143,12 @@ class FaultSchedule:
                  fail_save_at: Optional[int] = None,
                  corrupt_checkpoint_file: Optional[int] = None,
                  slow_save: Optional[Tuple[int, float]] = None,
-                 stager_error_at_group: Optional[int] = None):
+                 stager_error_at_group: Optional[int] = None,
+                 kill_replica_at_tick: Optional[Tuple[int, int]] = None,
+                 stall_replica_at_tick:
+                 Optional[Tuple[int, int, int]] = None,
+                 drop_submit_at: Optional[int] = None,
+                 duplicate_submit_at: Optional[int] = None):
         self.seed = int(seed)
         self.crash_at_step = crash_at_step
         self.preempt_at_step = preempt_at_step
@@ -135,6 +156,10 @@ class FaultSchedule:
         self.corrupt_checkpoint_file = corrupt_checkpoint_file
         self.slow_save = slow_save
         self.stager_error_at_group = stager_error_at_group
+        self.kill_replica_at_tick = kill_replica_at_tick
+        self.stall_replica_at_tick = stall_replica_at_tick
+        self.drop_submit_at = drop_submit_at
+        self.duplicate_submit_at = duplicate_submit_at
         self._lock = threading.Lock()
         self._save_count = 0
         # (point, key) tuples, in firing order — the sweep's assertions
@@ -160,6 +185,10 @@ class FaultSchedule:
                 "corrupt_checkpoint_file": self.corrupt_checkpoint_file,
                 "slow_save": self.slow_save,
                 "stager_error_at_group": self.stager_error_at_group,
+                "kill_replica_at_tick": self.kill_replica_at_tick,
+                "stall_replica_at_tick": self.stall_replica_at_tick,
+                "drop_submit_at": self.drop_submit_at,
+                "duplicate_submit_at": self.duplicate_submit_at,
                 "fired": list(self.fired)}
 
     # -- trainer step points -------------------------------------------------
@@ -206,6 +235,42 @@ class FaultSchedule:
                 and idx == self.corrupt_checkpoint_file \
                 and self._fire_once("corrupt_checkpoint_file", idx):
             corrupt_one_file(final_dir)
+
+    # -- serving-fleet points (ISSUE 11) -------------------------------------
+
+    def kill_replica_for_tick(self, tick: int) -> Optional[int]:
+        """The replica id to kill at fleet tick ``tick`` (one-shot), or
+        None. Checked by ``ServingFleet.tick`` before any work."""
+        if self.kill_replica_at_tick is not None \
+                and tick == self.kill_replica_at_tick[0] \
+                and self._fire_once("kill_replica_at_tick", tick):
+            return int(self.kill_replica_at_tick[1])
+        return None
+
+    def stall_replica_for_tick(self, tick: int
+                               ) -> Optional[Tuple[int, int]]:
+        """``(replica, n_ticks)`` to stall starting at fleet tick
+        ``tick`` (one-shot), or None."""
+        if self.stall_replica_at_tick is not None \
+                and tick == self.stall_replica_at_tick[0] \
+                and self._fire_once("stall_replica_at_tick", tick):
+            return (int(self.stall_replica_at_tick[1]),
+                    int(self.stall_replica_at_tick[2]))
+        return None
+
+    def should_drop_submit(self, rid: int) -> bool:
+        """True (once) when fleet request ``rid``'s replica delivery
+        should be lost."""
+        return (self.drop_submit_at is not None
+                and rid == self.drop_submit_at
+                and self._fire_once("drop_submit_at", rid))
+
+    def should_duplicate_submit(self, rid: int) -> bool:
+        """True (once) when fleet request ``rid`` should be delivered
+        twice."""
+        return (self.duplicate_submit_at is not None
+                and rid == self.duplicate_submit_at
+                and self._fire_once("duplicate_submit_at", rid))
 
     # -- stager point --------------------------------------------------------
 

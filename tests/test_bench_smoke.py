@@ -121,6 +121,25 @@ def test_bench_smoke_cpu_green_and_equal():
     assert flt["preempt"]["preempt_next_batch"] is not None
     assert flt["preempt"]["second_status"] == "completed"
     assert flt["preempt"]["params_equal"] is True
+    # ISSUE 11: the serving-fleet gate ran — a seeded bursty loadgen
+    # trace over 3 replicas survived one injected replica kill (detected
+    # via heartbeat staleness, requests resubmitted with retried
+    # lineage) and one mid-traffic drain; every request terminal with
+    # exactly one terminal record per rid, no KV-block leaks and zero
+    # retraces on survivors, p99 TTFT finite, shedding bounded, and SJF
+    # beats FCFS on goodput-under-deadline via the percentile metrics
+    fl = out["fleet"]
+    assert fl["ok"] is True, fl
+    assert fl["all_terminal"] is True and fl["lineage_ok"] is True
+    assert fl["no_leak_on_survivors"] is True
+    assert fl["zero_retraces_on_survivors"] is True
+    assert fl["p99_ttft_finite"] is True and fl["shed_bounded"] is True
+    assert fl["stats"]["resubmits"] >= 1
+    assert fl["stats"]["stale_completions"] == 0
+    assert "kill_replica_at_tick" in fl["faults_fired"]
+    assert fl["requests"]["ttft_ms_p99"] is not None
+    assert fl["sjf_beats_fcfs_goodput"] is True
+    assert fl["goodput_sjf_pct"] > fl["goodput_fcfs_pct"]
 
 
 def _write_bench(tmp_path, name, metrics):

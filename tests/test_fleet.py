@@ -1,0 +1,629 @@
+"""Serving-fleet resilience tests (ISSUE 11): the loadgen traffic shapes,
+the router's placement/health/shed policy, the SLO admission orders, and
+the acceptance contract — the kill-anywhere sweep: a replica killed
+before admit / post-prefill / mid-decode / during drain, with every
+request reaching a terminal ``finish_reason`` (retried lineage intact),
+zero retraces and zero leaked KV blocks on every surviving replica.
+
+Everything runs on a :class:`SimClock` advanced a fixed ``dt`` per fleet
+tick, so arrivals, heartbeat staleness, deadlines and predictions are
+deterministic functions of tick counts — the drills replay identically
+on every run."""
+
+import collections
+import tempfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.obs import (InMemorySink, Telemetry, percentile,
+                            summarize_requests)
+from paddle_tpu.serve import (ContinuousBatchingScheduler, DecodeEngine,
+                              ServingFleet, SimClock)
+from paddle_tpu.serve.loadgen import make_workload, workload_stats
+from paddle_tpu.train import FaultSchedule
+
+V, W, DIM, LAYERS, HEADS, FFN = 64, 24, 32, 2, 4, 64
+BS = 4                                    # block size
+
+# sim-time constants: dt per tick, heartbeat timeout (2.5 ticks)
+DT, HB = 0.1, 0.25
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = TransformerLM(vocab=V, dim=DIM, num_layers=LAYERS,
+                          num_heads=HEADS, ffn_hidden=FFN, max_len=W)
+    vs = model.init(jax.random.PRNGKey(0), jnp.zeros((1, W), jnp.int32))
+    return model, vs
+
+
+def _greedy_oracle(model, vs, prompt, n_new):
+    fwd = jax.jit(lambda v, i: model.apply(v, i))
+    seq, out = list(prompt), []
+    for _ in range(n_new):
+        pad = np.zeros((1, W), np.int32)
+        pad[0, :len(seq)] = seq
+        logits = fwd(vs, jnp.asarray(pad))
+        tok = int(np.argmax(np.asarray(logits[0, len(seq) - 1])))
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+def _fleet(model, vs, n, *, telemetry=None, faults=None, clock=None,
+           max_slots=2, **kw):
+    return ServingFleet.from_model(
+        model, vs, n,
+        engine_kwargs=dict(max_slots=max_slots, block_size=BS),
+        telemetry=telemetry, faults=faults,
+        clock=clock if clock is not None else SimClock(),
+        heartbeat_timeout_s=HB, est_tick_s=DT,
+        root=tempfile.mkdtemp(prefix="paddle_tpu_fleet_test_"), **kw)
+
+
+def _assert_lineage(mem, frs):
+    """One terminal record per rid, retried records <= retries, terminal
+    reason matches the fleet's."""
+    by_rid = collections.defaultdict(list)
+    for r in mem.by_kind("request"):
+        by_rid[r["rid"]].append(r)
+    for fr in frs:
+        recs = by_rid[fr.rid]
+        terminal = [r for r in recs if r["finish_reason"] != "retried"]
+        assert len(terminal) == 1, (fr.rid, recs)
+        assert terminal[0]["finish_reason"] == fr.finish_reason
+        retried = [r for r in recs if r["finish_reason"] == "retried"]
+        assert len(retried) <= fr.retries
+
+
+def _assert_survivor_invariants(fleet, exclude=()):
+    """Zero retraces and zero leaked blocks on every replica that did
+    not die (the acceptance drill's surviving-engine contract)."""
+    for w in fleet.workers:
+        if w.replica_id in exclude or w.killed or w.state == "dead":
+            continue
+        cache = w.engine.cache
+        assert cache.free_blocks == cache.num_blocks - 1, \
+            f"replica {w.replica_id} leaked blocks"
+        counts = w.engine.compile_counts()
+        assert set(counts.values()) <= {0, 1}, counts
+        if w.engine.ticks > 0:
+            assert counts == {"prefill": 1, "tick": 1}
+
+
+# ---------------------------------------------------------------------------
+# loadgen: seeded traffic shapes
+# ---------------------------------------------------------------------------
+
+def test_loadgen_deterministic_shapes_and_sessions():
+    kw = dict(seed=7, rate_rps=20.0, arrival="bursty", prompt_len=(2, 10),
+              max_new=(2, 8), n_sessions=3, session_prefix_len=4,
+              p_session=0.7, deadline_s=(1.0, 5.0), p_deadline=0.5,
+              priorities=(0, 1), priority_weights=(0.7, 0.3),
+              max_total=W)
+    a = make_workload(40, V, **kw)
+    b = make_workload(40, V, **kw)
+    assert [(g.at_s, g.prompt, g.max_new_tokens, g.deadline_s, g.priority,
+             g.session_id) for g in a] == \
+           [(g.at_s, g.prompt, g.max_new_tokens, g.deadline_s, g.priority,
+             g.session_id) for g in b]                 # same seed, same trace
+    c = make_workload(40, V, **{**kw, "seed": 8})
+    assert [g.prompt for g in a] != [g.prompt for g in c]
+    # arrivals monotone, lengths within bounds + capacity clamp
+    ats = [g.at_s for g in a]
+    assert ats == sorted(ats)
+    for g in a:
+        assert 1 <= len(g.prompt) <= 10
+        assert len(g.prompt) + g.max_new_tokens <= W
+        assert g.priority in (0, 1)
+    # sessions share their prefix verbatim
+    by_sid = collections.defaultdict(list)
+    for g in a:
+        if g.session_id is not None:
+            by_sid[g.session_id].append(g.prompt)
+    assert by_sid, "p_session=0.7 over 40 requests produced no sessions"
+    for prompts in by_sid.values():
+        if len(prompts) > 1:
+            pfx = prompts[0][:4]
+            assert all(p[:4] == pfx for p in prompts)
+    stats = workload_stats(a)
+    assert stats["n"] == 40 and stats["with_session"] > 0
+    assert stats["with_deadline"] > 0
+    with pytest.raises(ValueError, match="arrival"):
+        make_workload(4, V, arrival="nope")
+    # review fix: a 0 lower bound is a count floor of 1, not a log crash
+    zero_lo = make_workload(6, V, seed=1, prompt_len=(0, 6),
+                            max_new=(1, 4))
+    assert all(len(g.prompt) >= 1 for g in zero_lo)
+
+
+# ---------------------------------------------------------------------------
+# engine: structured admission probe (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+def test_admit_probe_structured_reasons(model_and_vars):
+    model, vs = model_and_vars
+    # pool of 3 usable blocks, 2 slots
+    eng = DecodeEngine(model, vs, max_slots=2, block_size=BS, num_blocks=4)
+    p = eng.admit_probe(2 * W)
+    assert (not p.ok) and p.reason == "width"
+    p = eng.admit_probe(8)
+    assert p.ok and p.reason is None and p.blocks_needed == 2
+    p = eng.admit_probe(16)                       # needs 4 > 3 free
+    assert (not p.ok) and p.reason == "blocks" and p.free_blocks == 3
+    eng.admit(0, [1, 2, 3])
+    eng.admit(1, [4, 5])
+    p = eng.admit_probe(4)
+    assert (not p.ok) and p.reason == "slots" and p.free_slots == 0
+    # can_admit keeps the historical contract: slots excluded
+    assert eng.can_admit(4) is True
+    assert eng.can_admit(16) is False
+
+
+# ---------------------------------------------------------------------------
+# scheduler: SLO admission orders + submit-time shedding
+# ---------------------------------------------------------------------------
+
+def test_scheduler_sjf_and_priority_orders(model_and_vars):
+    model, vs = model_and_vars
+    for order, expect_first in (("sjf", "short"), ("priority", "vip")):
+        eng = DecodeEngine(model, vs, max_slots=1, block_size=BS)
+        clock = SimClock()
+        sched = ContinuousBatchingScheduler(eng, order=order, clock=clock)
+        long_ = sched.submit([1, 2, 3], 8, priority=0)
+        short = sched.submit([4, 5], 2, priority=0)
+        vip = sched.submit([6, 7], 8, priority=3)
+        while sched.step():
+            clock.advance(DT)
+        done = {"long": long_, "short": short, "vip": vip}
+        first = min(done, key=lambda k: done[k].first_token_ts)
+        assert first == expect_first, (order, first)
+        assert all(r.finish_reason == "length" for r in done.values())
+    # fcfs baseline admits in arrival order
+    eng = DecodeEngine(model, vs, max_slots=1, block_size=BS)
+    sched = ContinuousBatchingScheduler(eng, order="fcfs")
+    a = sched.submit([1, 2, 3], 8)
+    b = sched.submit([4, 5], 2)
+    sched.run()
+    assert a.first_token_ts < b.first_token_ts
+    with pytest.raises(ValueError, match="order"):
+        ContinuousBatchingScheduler(eng, order="lifo")
+
+
+def test_scheduler_shed_rejects_fast(model_and_vars):
+    """With a tick-time estimate, a deadline-carrying request whose
+    predicted completion blows its deadline is rejected at SUBMIT:
+    finish_reason="shed", no slot, no blocks, one telemetry record."""
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    eng = DecodeEngine(model, vs, max_slots=2, block_size=BS,
+                       telemetry=Telemetry(sinks=[mem]))
+    clock = SimClock()
+    sched = ContinuousBatchingScheduler(eng, shed=True, est_tick_s=1.0,
+                                        clock=clock)
+    free0 = eng.cache.free_blocks
+    # backlog: 2 slots x 10-token budgets fill the predicted queue
+    keep = [sched.submit([1, 2, 3], 10) for _ in range(2)]
+    # 10 pending + 10/2 queue ticks + 4 run ticks >> 3s deadline: shed
+    shed = sched.submit([4, 5], 4, deadline_s=3.0)
+    assert shed.done and shed.finish_reason == "shed"
+    assert shed.slot is None and shed.tokens == []
+    assert eng.cache.free_blocks == free0    # shed took no blocks
+    # a loose deadline still queues
+    ok = sched.submit([6, 7], 2, deadline_s=100.0)
+    while sched.step():
+        clock.advance(1.0)
+    assert all(r.finish_reason == "length" for r in keep + [ok])
+    recs = {r["rid"]: r for r in mem.by_kind("request")}
+    assert recs[shed.rid]["finish_reason"] == "shed"
+    # without evidence (no est_tick_s), nothing is shed
+    eng2 = DecodeEngine(model, vs, max_slots=1, block_size=BS)
+    s2 = ContinuousBatchingScheduler(eng2, shed=True)
+    r = s2.submit([1, 2], 2, deadline_s=0.001)
+    assert not r.done and len(s2.queue) == 1
+
+
+def test_scheduler_idle_gap_does_not_poison_tick_estimate(model_and_vars):
+    """Review fix: the tick-time EMA only folds deltas between
+    consecutive BUSY steps — an idle lull between bursts is think time,
+    and must not inflate est_tick_s into shedding against an empty
+    engine."""
+    model, vs = model_and_vars
+    eng = DecodeEngine(model, vs, max_slots=2, block_size=BS)
+    clock = SimClock()
+    sched = ContinuousBatchingScheduler(eng, shed=True, est_tick_s=0.1,
+                                        clock=clock)
+    sched.submit([1, 2, 3], 3)
+    while sched.step():
+        clock.advance(0.1)
+    assert sched.est_tick_s == pytest.approx(0.1)
+    clock.advance(1000.0)                   # a long idle lull
+    ok = sched.submit([4, 5], 2, deadline_s=5.0)
+    assert not ok.done, "idle gap was folded into est_tick_s"
+    while sched.step():
+        clock.advance(0.1)
+    assert ok.finish_reason == "length"
+    assert sched.est_tick_s == pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# router: affinity + least-loaded placement
+# ---------------------------------------------------------------------------
+
+def test_router_affinity_and_least_loaded(model_and_vars):
+    model, vs = model_and_vars
+    fleet = _fleet(model, vs, 2)
+    # session 9 pins to its first replica across submissions
+    a = fleet.submit([1, 2, 3], 3, session_id=9)
+    spread = [fleet.submit([4, 5, 6], 3) for _ in range(2)]
+    b = fleet.submit([7, 8], 3, session_id=9)
+    assert a.replica == b.replica                    # affinity
+    assert {r.replica for r in spread + [a]} == {0, 1}   # least-loaded
+    while fleet.outstanding():
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert all(fr.finish_reason == "length"
+               for fr in fleet.requests.values())
+    _assert_survivor_invariants(fleet)
+
+
+def test_router_affinity_yields_before_shedding(model_and_vars, nprng):
+    """Review fix: a session pinned to a drowning replica falls back to
+    least-loaded before a terminal shed verdict — losing prefix
+    locality beats losing the request."""
+    model, vs = model_and_vars
+    fleet = _fleet(model, vs, 2)
+    pin = fleet.submit([1, 2, 3], 3, session_id=5)
+    # bury the pinned replica in backlog (no deadlines: nothing sheds)
+    for _ in range(6):
+        fleet.submit(list(nprng.randint(1, V, 4)), 10,
+                     session_id=5)
+    busy = fleet.workers[pin.replica]
+    assert busy.scheduler.pending_new_tokens() > 40
+    # deadline the pinned replica cannot meet, the idle one trivially can
+    saved = fleet.submit([7, 8], 2, deadline_s=1.5, session_id=5)
+    assert saved.finish_reason != "shed"
+    assert saved.replica != pin.replica
+    while fleet.outstanding():
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert saved.finish_reason == "length"
+    # the session re-pinned to the fallback replica
+    assert fleet.router.sessions[5] == saved.replica
+
+
+def test_router_session_map_is_lru_bounded(model_and_vars):
+    model, vs = model_and_vars
+    fleet = _fleet(model, vs, 2)
+    fleet.router.max_sessions = 3
+    for sid in range(5):
+        fleet.router.route(prompt_len=2, max_new_tokens=2,
+                           session_id=sid)
+    assert len(fleet.router.sessions) == 3
+    assert set(fleet.router.sessions) == {2, 3, 4}    # oldest evicted
+    fleet.router.route(prompt_len=2, max_new_tokens=2, session_id=2)
+    fleet.router.route(prompt_len=2, max_new_tokens=2, session_id=5)
+    # the refresh of 2 saved it; 3 (now coldest) was evicted for 5
+    assert set(fleet.router.sessions) == {4, 2, 5}
+
+
+def test_fleet_matches_single_engine_tokens(model_and_vars, nprng):
+    """A healthy fleet is semantically invisible: each request's tokens
+    equal the greedy full-forward oracle."""
+    model, vs = model_and_vars
+    fleet = _fleet(model, vs, 2)
+    prompts = [list(nprng.randint(1, V, nprng.randint(2, 7)))
+               for _ in range(4)]
+    frs = [fleet.submit(p, 4) for p in prompts]
+    while fleet.outstanding():
+        fleet.tick()
+        fleet.clock.advance(DT)
+    for p, fr in zip(prompts, frs):
+        assert fr.tokens == _greedy_oracle(model, vs, p, 4)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the kill-anywhere sweep
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("phase,kill_tick,drain_tick", [
+    ("before-admit", 0, None),       # killed before its first step
+    ("post-prefill", 1, None),       # admitted + first token, no decode
+    ("mid-decode", 3, None),         # several decode ticks in
+    ("during-drain", 3, 2),          # drained at 2, killed draining at 3
+])
+def test_fleet_kill_anywhere_sweep(model_and_vars, nprng, phase,
+                                   kill_tick, drain_tick):
+    """Kill replica 0 at every lifecycle phase: every request reaches a
+    terminal finish_reason, requests stranded on the dead replica carry
+    finish_reason="retried" lineage and complete with the oracle's
+    tokens on a survivor, and surviving engines keep zero retraces and
+    zero leaked blocks."""
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    faults = FaultSchedule(kill_replica_at_tick=(kill_tick, 0))
+    n = 3 if drain_tick is not None else 2
+    fleet = _fleet(model, vs, n, telemetry=Telemetry(sinks=[mem]),
+                   faults=faults)
+    prompts = [list(nprng.randint(1, V, 4)) for _ in range(6)]
+    frs = [fleet.submit(p, 6) for p in prompts]
+    assert {fr.replica for fr in frs} >= {0, 1}      # both got traffic
+    drains = {drain_tick: 0} if drain_tick is not None else {}
+    for t in range(400):
+        if t in drains:
+            fleet.drain(drains[t])
+        if not fleet.outstanding():
+            break
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert not fleet.outstanding(), fleet.stats()
+    assert all(fr.finish_reason == "length" for fr in frs)
+    retried = [fr for fr in frs if fr.retries > 0]
+    assert retried, f"{phase}: kill touched no request"
+    assert all(0 in fr.attempts for fr in retried)
+    # retried requests regenerate the oracle's exact tokens elsewhere
+    for fr in retried[:2]:
+        assert fr.tokens == _greedy_oracle(
+            model, vs, fr.prompt, fr.max_new_tokens)
+    _assert_lineage(mem, frs)
+    _assert_survivor_invariants(fleet, exclude=(0,))
+    assert fleet.stats()["finish_reasons"] == {"length": 6}
+
+
+def test_fleet_drain_reroutes_queue_and_releases(model_and_vars, nprng):
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    fleet = _fleet(model, vs, 2, telemetry=Telemetry(sinks=[mem]))
+    # overload replica queues so the drained one holds queued requests
+    frs = [fleet.submit(list(nprng.randint(1, V, 4)), 6)
+           for _ in range(8)]
+    fleet.tick(); fleet.clock.advance(DT)
+    w0 = fleet.workers[0]
+    assert w0.scheduler.running and w0.scheduler.queue
+    queued_rids = {r.rid for r in w0.scheduler.queue}
+    running_rids = {r.rid for r in w0.scheduler.running.values()}
+    fleet.drain(0)
+    assert w0.state == "draining"
+    # queued requests left immediately (retried lineage), running stayed
+    assert not w0.scheduler.queue
+    assert {r.rid for r in w0.scheduler.running.values()} == running_rids
+    for _ in range(300):
+        if not fleet.outstanding():
+            break
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert w0.state == "released"
+    assert all(fr.finish_reason == "length" for fr in frs)
+    # running slots finished ON the draining replica (not resubmitted)
+    assert all(fleet.requests[r].retries == 0 for r in running_rids)
+    assert all(fleet.requests[r].retries >= 1 for r in queued_rids)
+    events = [r["event"] for r in mem.by_kind("replica")]
+    assert events.count("draining") == 1 and events.count("released") == 1
+    _assert_lineage(mem, frs)
+    _assert_survivor_invariants(fleet)       # incl. the released replica
+    with pytest.raises(ValueError, match="last live"):
+        fleet.drain(1)
+    # ledger hygiene: everything terminal is prunable, nothing in flight
+    assert not fleet._active
+    assert fleet.prune_terminal() == len(frs) and not fleet.requests
+
+
+def test_fleet_play_arrivals_relative_to_replay_start(model_and_vars,
+                                                      nprng):
+    """Review fix: play() measures arrivals from the START of the
+    replay, not the clock's absolute value — a nonzero clock epoch
+    (perf_counter, a mid-run SimClock) must not collapse the whole
+    trace into one tick-0 burst."""
+    model, vs = model_and_vars
+    fleet = _fleet(model, vs, 2, clock=SimClock(t0=1234.5))
+    wl = make_workload(6, V, seed=2, rate_rps=4.0, prompt_len=(2, 5),
+                       max_new=(2, 4), max_total=W)
+    assert wl[-1].at_s > 3 * DT          # spread over several ticks
+    frs = fleet.play(wl, dt_s=DT)
+    assert all(fr.finish_reason == "length" for fr in frs)
+    # submit timestamps track the (offset) arrival spread, not one burst
+    spread = max(fr.submit_ts for fr in frs) - min(fr.submit_ts
+                                                   for fr in frs)
+    assert spread >= 2 * DT, [fr.submit_ts for fr in frs]
+    assert min(fr.submit_ts for fr in frs) >= 1234.5
+
+
+def test_fleet_drain_cancelled_when_race_strands_capacity(model_and_vars,
+                                                          nprng):
+    """Review fix: drain() can race an unobserved kill (the victim still
+    looks live). When parked work exists with zero live replicas, the
+    fleet cancels the drain instead of stranding requests forever."""
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    faults = FaultSchedule(kill_replica_at_tick=(0, 0))
+    fleet = _fleet(model, vs, 2, telemetry=Telemetry(sinks=[mem]),
+                   faults=faults)
+    frs = [fleet.submit(list(nprng.randint(1, V, 4)), 6)
+           for _ in range(4)]     # both replicas hold work
+    fleet.tick()                  # kill fires; replica 0 LOOKS live
+    fleet.clock.advance(DT)
+    fleet.drain(1)                # guard passes — the race
+    for _ in range(300):
+        if not fleet.outstanding():
+            break
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert all(fr.finish_reason == "length" for fr in frs)
+    assert fleet.workers[1].state == "live"       # drain was cancelled
+    events = [r["event"] for r in mem.by_kind("replica")]
+    assert "drain-cancelled" in events
+    _assert_lineage(mem, frs)
+
+
+def test_fleet_shed_under_overload(model_and_vars, nprng):
+    """Tight deadlines against a saturated fleet: the router rejects
+    fast (finish_reason="shed" with the structured reason), admitted
+    requests still finish, and nothing leaks."""
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    fleet = _fleet(model, vs, 2, telemetry=Telemetry(sinks=[mem]),
+                   max_slots=2)
+    frs = [fleet.submit(list(nprng.randint(1, V, 4)), 10,
+                        deadline_s=2.0) for _ in range(10)]
+    while fleet.outstanding():
+        fleet.tick()
+        fleet.clock.advance(DT)
+    reasons = collections.Counter(fr.finish_reason for fr in frs)
+    assert reasons["shed"] >= 1, reasons
+    assert reasons["shed"] + reasons.get("length", 0) \
+        + reasons.get("timeout", 0) == 10
+    shed = [fr for fr in frs if fr.finish_reason == "shed"]
+    assert all(fr.tokens == [] and fr.record["wall_ms"] == 0.0
+               for fr in shed)
+    recs = {r["rid"]: r for r in mem.by_kind("request")}
+    assert all(recs[fr.rid].get("shed_reason") in ("delay", "blocks",
+                                                   "slots")
+               for fr in shed)
+    _assert_lineage(mem, frs)
+    _assert_survivor_invariants(fleet)
+
+
+# ---------------------------------------------------------------------------
+# idempotency faults: duplicate + dropped deliveries, the fenced zombie
+# ---------------------------------------------------------------------------
+
+def test_fleet_duplicate_submit_is_idempotent(model_and_vars, nprng):
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    faults = FaultSchedule(duplicate_submit_at=1)
+    fleet = _fleet(model, vs, 2, telemetry=Telemetry(sinks=[mem]),
+                   faults=faults)
+    frs = [fleet.submit(list(nprng.randint(1, V, 4)), 4)
+           for _ in range(3)]
+    while fleet.outstanding():
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert fleet.duplicates_dropped == 1
+    assert ("duplicate_submit_at", 1) in faults.fired
+    assert all(fr.finish_reason == "length" for fr in frs)
+    _assert_lineage(mem, frs)                  # exactly ONE terminal rec
+
+
+def test_fleet_drop_submit_reconciles(model_and_vars, nprng):
+    """A delivery lost after assignment (the lost-RPC fault): the
+    reconcile sweep notices the replica never learned the rid and
+    resubmits — the request completes with retries >= 1."""
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    faults = FaultSchedule(drop_submit_at=0)
+    fleet = _fleet(model, vs, 2, telemetry=Telemetry(sinks=[mem]),
+                   faults=faults)
+    frs = [fleet.submit(list(nprng.randint(1, V, 4)), 4)
+           for _ in range(3)]
+    assert frs[0].local is None                # delivery was eaten
+    while fleet.outstanding():
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert frs[0].finish_reason == "length" and frs[0].retries >= 1
+    assert all(fr.finish_reason == "length" for fr in frs)
+    assert frs[0].tokens == _greedy_oracle(model, vs, frs[0].prompt, 4)
+    _assert_lineage(mem, frs)
+
+
+def test_fleet_stalled_replica_fences_on_wake(model_and_vars, nprng):
+    """A replica that stalls past the heartbeat timeout is declared dead
+    and its requests re-homed; when it wakes it self-fences — every slot
+    evicted, blocks freed, and it never completes a re-homed request
+    (zero stale completions)."""
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    faults = FaultSchedule(stall_replica_at_tick=(1, 0, 12))
+    fleet = _fleet(model, vs, 2, telemetry=Telemetry(sinks=[mem]),
+                   faults=faults)
+    frs = [fleet.submit(list(nprng.randint(1, V, 4)), 6)
+           for _ in range(4)]
+    for _ in range(40):                       # run past the wake tick
+        fleet.tick()
+        fleet.clock.advance(DT)
+        if not fleet.outstanding() and fleet.ticks > 15:
+            break
+    w0 = fleet.workers[0]
+    assert w0.state == "dead" and w0._fenced
+    cache = w0.engine.cache
+    assert cache.free_blocks == cache.num_blocks - 1   # fence freed all
+    assert not w0.scheduler.running and not w0.known
+    assert all(fr.finish_reason == "length" for fr in frs)
+    assert any(fr.retries > 0 and 0 in fr.attempts for fr in frs)
+    assert fleet.stale_completions == 0
+    _assert_lineage(mem, frs)
+    _assert_survivor_invariants(fleet, exclude=(0,))
+
+
+# ---------------------------------------------------------------------------
+# percentiles + goodput aggregation (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+def test_percentile_nearest_rank():
+    assert percentile([], 50) is None
+    assert percentile([None, None], 99) is None
+    assert percentile([3.0, 1.0, 2.0, None], 50) == 2.0
+    assert percentile([1, 2, 3, 4], 50) == 2
+    assert percentile([1, 2, 3, 4], 95) == 4
+    assert percentile(range(1, 101), 99) == 99
+    assert percentile([7.0], 99) == 7.0
+
+
+def test_summarize_requests_goodput_and_lineage_filter():
+    def rec(rid, reason, ttft=10.0, tpot=5.0, wall=100.0, deadline=None,
+            new_tokens=4):
+        return {"kind": "request", "rid": rid, "finish_reason": reason,
+                "ttft_ms": ttft, "tpot_ms": tpot, "wall_ms": wall,
+                "deadline_s": deadline, "new_tokens": new_tokens}
+
+    records = [
+        rec(0, "length", wall=100.0, deadline=1.0),        # met
+        rec(1, "length", wall=5000.0, deadline=1.0),       # late
+        rec(2, "timeout", wall=2000.0, deadline=1.0),      # missed
+        rec(3, "shed", ttft=None, tpot=None, wall=0.0,
+            deadline=1.0, new_tokens=0),                   # rejected
+        rec(4, "retried", wall=50.0),                      # lineage only
+        rec(4, "eos", wall=400.0),                         # its terminal
+        {"kind": "decode_tick", "tick": 1},                # ignored
+    ]
+    s = summarize_requests(records)
+    assert s["requests"] == 5 and s["retried_attempts"] == 1
+    assert s["finish_reasons"] == {"length": 2, "timeout": 1,
+                                   "shed": 1, "eos": 1}
+    assert s["deadline_requests"] == 4 and s["deadline_met"] == 1
+    assert s["goodput_pct"] == 25.0 and s["goodput_tokens"] == 4
+    assert s["shed"] == 1 and s["timeout"] == 1
+    assert s["ttft_ms_p50"] == 10.0
+    assert s["wall_ms_p99"] == 5000.0      # retried row's wall excluded
+    # review fix: the shed row's wall_ms=0 must not drag the latency
+    # percentiles down (latency inputs: 100, 5000, 2000, 400)
+    assert s["wall_ms_p50"] == 400.0
+    assert summarize_requests([{"kind": "step"}]) is None
+
+
+def test_report_summarize_includes_serving_block(tmp_path):
+    """The obs.report CLI path grows the serving block when the JSONL
+    carries request records."""
+    import json
+    from paddle_tpu.obs import report as report_lib
+    path = tmp_path / "run.jsonl"
+    rows = [
+        {"kind": "request", "rid": 0, "finish_reason": "length",
+         "ttft_ms": 12.0, "tpot_ms": 3.0, "wall_ms": 40.0,
+         "deadline_s": 1.0, "new_tokens": 8},
+        {"kind": "request", "rid": 1, "finish_reason": "shed",
+         "ttft_ms": None, "tpot_ms": None, "wall_ms": 0.0,
+         "deadline_s": 0.5, "new_tokens": 0},
+        {"kind": "evict", "rid": 2, "where": "queued"},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    s = report_lib.summarize(report_lib.load_records(str(path)))
+    assert s["serving"]["requests"] == 2
+    assert s["serving"]["shed"] == 1
+    assert s["serving"]["goodput_pct"] == 50.0
+    text = report_lib.format_summary(s)
+    assert "serving requests" in text and "goodput under deadline" in text
+    assert report_lib.main([str(path)]) == 0

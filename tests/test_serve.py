@@ -364,6 +364,67 @@ def test_deadline_drops_expired_queued_request(model_and_vars, nprng):
     assert eng.cache.free_blocks == free0
 
 
+def test_deadline_evictions_emit_records_and_return_blocks(model_and_vars,
+                                                           nprng):
+    """ISSUE 11 satellite: BOTH deadline-eviction paths are visible in
+    telemetry — the queued drop emits a kind="evict" record (previously
+    only slot evictions were distinguishable), and the running slot's
+    exact block ids land back on the BlockAllocator free list (leak
+    regression)."""
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    eng = DecodeEngine(model, vs, max_slots=1, block_size=BS,
+                       telemetry=Telemetry(sinks=[mem]))
+    clock = _FakeClock()
+    sched = ContinuousBatchingScheduler(eng, clock=clock)
+    running = sched.submit(list(nprng.randint(0, V, 4)), 18,
+                           deadline_s=2.5)
+    starved = sched.submit(list(nprng.randint(0, V, 4)), 4,
+                           deadline_s=2.0)   # expires before a slot frees
+    sched.step()                      # admit `running`; blocks reserved
+    owned = list(eng.cache._owned[running.slot])
+    assert owned, "admission reserved no blocks"
+    while sched.step():
+        clock.t += 1.0
+    assert running.finish_reason == "timeout"
+    assert starved.finish_reason == "timeout" and starved.slot is None
+    # the evicted slot's block ids are ON the free list, not just counted
+    assert set(owned) <= set(eng.cache.allocator._free)
+    assert eng.cache.free_blocks == eng.cache.num_blocks - 1
+    evicts = {r["rid"]: r for r in mem.by_kind("evict")}
+    assert evicts[running.rid]["where"] == "running"
+    assert evicts[running.rid]["blocks_freed"] == len(owned)
+    assert evicts[starved.rid]["where"] == "queued"
+    assert evicts[starved.rid]["blocks_freed"] == 0
+
+
+def test_scheduler_surfaces_structured_backpressure(model_and_vars,
+                                                    nprng):
+    """ISSUE 11 satellite: when admission stalls on the pool, the
+    scheduler records WHY (blocks vs slots) so a router doesn't guess."""
+    model, vs = model_and_vars
+    eng = DecodeEngine(model, vs, max_slots=4, block_size=BS,
+                       num_blocks=2 * 3 + 1)
+    sched = ContinuousBatchingScheduler(eng)
+    for _ in range(4):
+        sched.submit(list(nprng.randint(0, V, 5)), 6)
+    sched.step()
+    assert sched.last_backpressure == "blocks"    # pool, not slots
+    sched.run()
+    assert sched.last_backpressure is None        # cleared when flowing
+    # the static gang-wait path clears it too (no stale reason while
+    # the gang runs)
+    eng2 = DecodeEngine(model, vs, max_slots=2, block_size=BS)
+    s2 = ContinuousBatchingScheduler(eng2, policy="static")
+    for _ in range(2):
+        s2.submit(list(nprng.randint(0, V, 5)), 4)
+    s2.step()
+    s2.last_backpressure = "blocks"               # simulate a stale read
+    s2.step()                                     # gang still running
+    assert s2.last_backpressure is None
+
+
 def test_deadline_none_is_unchanged_and_validation(model_and_vars, nprng):
     model, vs = model_and_vars
     eng = DecodeEngine(model, vs, max_slots=2, block_size=BS)
