@@ -573,6 +573,9 @@ PLANS = {
     # serving decode throughput (own child protocol:
     # run_serving_bench_child; n/k unused)
     "transformer_decode": dict(n=0, k=1, budget=2400),
+    # speculative-vs-plain decode differential (own child protocol:
+    # run_serving_spec_bench_child; n/k unused)
+    "transformer_decode_spec": dict(n=0, k=1, budget=2400),
 }
 
 
@@ -1281,11 +1284,107 @@ def run_serving_child():
     records_ok = (cont["request_records"] == 16     # warmup + timed runs
                   and cont["sample_request"] is not None
                   and cont["sample_request"].get("ttft_ms") is not None)
+
+    # --- ISSUE 12 leg (a): copy-on-write prefix sharing — a shared-
+    # prefix workload admits with FEWER fresh block allocations than
+    # sharing-off, produces bit-identical tokens, and leaks nothing
+    pre = list(rng.randint(0, V, 9))
+    shared_prompts = [pre + list(rng.randint(0, V, 3)) for _ in range(6)]
+
+    def run_shared(share):
+        eng = DecodeEngine(model, vs, max_slots=4, block_size=4,
+                           share_prefix=share)
+        sched = ContinuousBatchingScheduler(eng)
+        reqs = [sched.submit(p, 4) for p in shared_prompts]
+        sched.run()
+        return eng, [r.tokens for r in reqs]
+
+    eng_on, toks_on = run_shared(True)
+    eng_off, toks_off = run_shared(False)
+    share_leg = {
+        "tokens_identical": toks_on == toks_off,
+        "fresh_allocs_shared": eng_on.cache.allocator.total_allocs,
+        "fresh_allocs_unshared": eng_off.cache.allocator.total_allocs,
+        "prefix_hit_blocks": eng_on.cache.prefix_hit_blocks,
+        "leak_free": eng_on.cache.free_blocks
+        == eng_on.cache.num_blocks - 1,
+        "compile_counts": eng_on.compile_counts(),
+    }
+    share_ok = (share_leg["tokens_identical"] and share_leg["leak_free"]
+                and share_leg["fresh_allocs_shared"]
+                < share_leg["fresh_allocs_unshared"]
+                and share_leg["compile_counts"]
+                == {"prefill": 1, "tick": 1})
+
+    # --- ISSUE 12 leg (b): lossless speculative decoding — token-
+    # identical to the plain greedy engine with STRICTLY fewer ticks
+    def run_spec(k):
+        eng = DecodeEngine(model, vs, max_slots=4, block_size=4,
+                           speculative=k)
+        sched = ContinuousBatchingScheduler(eng)
+        reqs = [sched.submit(p, m) for p, m in zip(prompts, maxnew)]
+        sched.run()
+        return eng, [r.tokens for r in reqs]
+
+    eng_b, toks_b = run_spec(0)
+    eng_s, toks_s = run_spec(3)
+    spec_leg = {
+        "tokens_identical": toks_s == toks_b,
+        "ticks_baseline": eng_b.ticks,
+        "ticks_speculative": eng_s.ticks,
+        "draft_accept_rate": round(
+            eng_s.draft_accepted / eng_s.draft_proposed, 4)
+        if eng_s.draft_proposed else None,
+        "compile_counts": eng_s.compile_counts(),
+    }
+    spec_ok = (spec_leg["tokens_identical"]
+               and spec_leg["ticks_speculative"]
+               < spec_leg["ticks_baseline"]
+               and spec_leg["compile_counts"]
+               == {"prefill": 1, "tick": 1})
+
+    # --- ISSUE 12 leg (c): chunked prefill — a long admission
+    # interleaves with running slots' decode ticks (TPOT keeps flowing)
+    # instead of stalling them behind one monolithic prefill
+    long_prompt = list(rng.randint(0, V, 24))
+    short_prompt = list(rng.randint(0, V, 4))
+
+    def run_chunk(chunk):
+        eng = DecodeEngine(model, vs, max_slots=2, block_size=4,
+                           prefill_chunk=chunk)
+        sched = ContinuousBatchingScheduler(eng)
+        short = sched.submit(list(short_prompt), 24)
+        for _ in range(3):
+            sched.step()
+        before = len(short.tokens)
+        long_req = sched.submit(long_prompt, 2)
+        while long_req.first_token_ts is None and sched.step():
+            pass
+        interleaved = len(short.tokens) - before
+        sched.run()
+        return interleaved, short.tokens, long_req.tokens, eng
+
+    il_chunk, short_c, long_c, eng_ck = run_chunk(6)
+    il_full, short_f, long_f, _ = run_chunk(None)
+    chunk_leg = {
+        "interleaved_tokens_chunked": il_chunk,
+        "interleaved_tokens_monolithic": il_full,
+        "tokens_identical": short_c == short_f and long_c == long_f,
+        "prefill_chunks": eng_ck.prefill_chunks,
+        "compile_counts": eng_ck.compile_counts(),
+    }
+    chunk_ok = (chunk_leg["tokens_identical"]
+                and chunk_leg["interleaved_tokens_chunked"]
+                > chunk_leg["interleaved_tokens_monolithic"]
+                and chunk_leg["compile_counts"]
+                == {"prefill": 1, "tick": 1})
+
     ok = (cont["completed"] == 8 and stat["completed"] == 8
           and no_retrace and records_ok
           and cont["tokens_per_sec"] > stat["tokens_per_sec"]
           and cont["ticks"] < stat["ticks"]
-          and decode_block.get("bound") == "memory")
+          and decode_block.get("bound") == "memory"
+          and share_ok and spec_ok and chunk_ok)
     print(json.dumps({
         "child": "serving", "ok": bool(ok),
         "requests": 8, "max_slots": 4, "block_size": 4,
@@ -1297,6 +1396,9 @@ def run_serving_child():
         "decode_bound": decode_block.get("bound"),
         "decode_intensity_flops_per_byte":
             decode_block.get("intensity_flops_per_byte"),
+        "prefix_sharing": {**share_leg, "ok": bool(share_ok)},
+        "speculative": {**spec_leg, "ok": bool(spec_ok)},
+        "chunked_prefill": {**chunk_leg, "ok": bool(chunk_ok)},
         "device": jax.devices()[0].device_kind,
     }))
     return 0 if ok else 1
@@ -1610,6 +1712,90 @@ def bench_serving(budget=None):
         "prompt_len": r["prompt_len"], "dim": r["dim"],
         "layers": r["layers"], "attention": r["attention"],
         "device": r["device"],
+        "baseline": None, "vs_baseline": None,
+    }
+
+
+def run_serving_spec_bench_child(max_slots=4, block_size=16, seq_len=256,
+                                 dim=256, layers=4, heads=8, vocab=8000,
+                                 prompt_len=32, speculative=4,
+                                 warmup_ticks=4, timed_ticks=24):
+    """The ``transformer_decode_spec`` metric: steady-state ACCEPTED
+    tokens/sec through the speculative verify tick vs the plain q_len=1
+    tick on the SAME engine shape and a repetitive (draft-friendly)
+    workload — the measured answer to "how much does n-gram
+    self-drafting buy on a memory-bound decode". Periodic prompts make
+    the self-drafter's lookup hit, so the accept rate reflects the
+    mechanism, not a random-token worst case. Prints one JSON line."""
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.serve import DecodeEngine
+
+    ffn = 4 * dim
+    model = TransformerLM(vocab=vocab, dim=dim, num_layers=layers,
+                          num_heads=heads, ffn_hidden=ffn, max_len=seq_len)
+    vs = model.init(jax.random.PRNGKey(0),
+                    jnp.zeros((1, seq_len), jnp.int32))
+    rng = np.random.RandomState(0)
+    # periodic prompts: the n-gram drafter exists for exactly this shape
+    period = rng.randint(1, vocab, 4)
+    prompts = [list(np.tile(period, prompt_len // 4 + 1)[:prompt_len])
+               for _ in range(max_slots)]
+
+    def timed(k):
+        eng = DecodeEngine(model, vs, max_slots=max_slots,
+                           block_size=block_size, speculative=k)
+        target = eng.context_width
+        for slot in range(max_slots):
+            eng.admit(slot, prompts[slot], reserve_len=target)
+        for _ in range(warmup_ticks):
+            eng.decode_tick()
+        tok0 = eng.tokens_generated
+        t0 = time.perf_counter()
+        for _ in range(timed_ticks):
+            eng.decode_tick()
+        wall = time.perf_counter() - t0
+        toks = eng.tokens_generated - tok0
+        return {"tokens": toks, "wall_s": round(wall, 4),
+                "tokens_per_sec": round(toks / wall, 2),
+                "ms_per_tick": round(wall / timed_ticks * 1e3, 3),
+                "draft_accept_rate": round(
+                    eng.draft_accepted / eng.draft_proposed, 4)
+                if eng.draft_proposed else None,
+                "compile_counts": eng.compile_counts()}
+
+    base = timed(0)
+    spec = timed(speculative)
+    print(json.dumps({
+        "child": "transformer_decode_spec",
+        "decode_spec_tokens_per_sec": spec["tokens_per_sec"],
+        "baseline_tokens_per_sec": base["tokens_per_sec"],
+        "speedup": round(spec["tokens_per_sec"]
+                         / base["tokens_per_sec"], 3)
+        if base["tokens_per_sec"] else None,
+        "draft_accept_rate": spec["draft_accept_rate"],
+        "speculative": speculative, "max_slots": max_slots,
+        "block_size": block_size, "prompt_len": prompt_len,
+        "timed_ticks": timed_ticks, "dim": dim, "layers": layers,
+        "vocab": vocab, "base": base, "spec": spec,
+        "device": jax.devices()[0].device_kind,
+    }))
+
+
+def bench_serving_spec(budget=None):
+    """Fresh-subprocess wrapper for run_serving_spec_bench_child."""
+    budget = budget or PLANS["transformer_decode_spec"]["budget"]
+    r = _spawn_child("transformer_decode_spec", 0, 1, budget)
+    return {
+        "metric": "transformer_decode_spec_tokens_per_sec",
+        "unit": "tokens/sec",
+        "value": r["decode_spec_tokens_per_sec"],
+        "baseline_tokens_per_sec": r["baseline_tokens_per_sec"],
+        "speedup": r["speedup"],
+        "draft_accept_rate": r["draft_accept_rate"],
+        "speculative": r["speculative"],
+        "max_slots": r["max_slots"], "block_size": r["block_size"],
+        "prompt_len": r["prompt_len"], "dim": r["dim"],
+        "layers": r["layers"], "device": r["device"],
         "baseline": None, "vs_baseline": None,
     }
 
@@ -1950,8 +2136,8 @@ def bench_scaling(per_device_batch=32, iters=2, steps_per_call=4):
 # committed artifacts are SCALING_r05.json (proxy + analytic projection).
 DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_fused",
                 "transformer_dp_overlap", "transformer_pipelined",
-                "transformer_decode", "transformer_big", "lstm",
-                "lstm_h256", "lstm_h1280"]
+                "transformer_decode", "transformer_decode_spec",
+                "transformer_big", "lstm", "lstm_h256", "lstm_h1280"]
 
 
 _KNOWN_FLAGS = ("--metric", "--child", "--probe", "--n", "--k",
@@ -2041,6 +2227,8 @@ def main():
             run_pipelined_child()
         elif metric == "transformer_decode":
             run_serving_bench_child()
+        elif metric == "transformer_decode_spec":
+            run_serving_spec_bench_child()
         else:
             run_timed_child(metric, flag("--timed-steps", 100, int),
                             flag("--steps-per-call", 1, int))
@@ -2049,10 +2237,12 @@ def main():
     if metric == "scaling":
         print(json.dumps(bench_scaling()))
         return
-    if metric in ("transformer_pipelined", "transformer_decode"):
+    if metric in ("transformer_pipelined", "transformer_decode",
+                  "transformer_decode_spec"):
         try:
             out = (bench_pipelined() if metric == "transformer_pipelined"
-                   else bench_serving())
+                   else bench_serving() if metric == "transformer_decode"
+                   else bench_serving_spec())
         except (RuntimeError, subprocess.TimeoutExpired, ValueError,
                 IndexError, KeyError) as e:
             print(json.dumps({"metric": metric, "error": str(e)[-800:],
@@ -2064,7 +2254,7 @@ def main():
     if metric is not None and metric not in PREPS:
         print(json.dumps(
             {"error": f"unknown metric {metric!r}; choose from "
-                      f"{sorted(PREPS) + ['scaling', 'transformer_pipelined', 'transformer_decode']}"
+                      f"{sorted(PREPS) + ['scaling', 'transformer_pipelined', 'transformer_decode', 'transformer_decode_spec']}"
              }))
         sys.exit(2)
     if metric in PREPS:
@@ -2093,6 +2283,8 @@ def main():
                     results[name] = bench_pipelined()
                 elif name == "transformer_decode":
                     results[name] = bench_serving()
+                elif name == "transformer_decode_spec":
+                    results[name] = bench_serving_spec()
                 else:
                     results[name] = bench_differential(name)
                 errors.pop(name, None)

@@ -127,6 +127,32 @@ class TransformerBlock(Module):
             out = self.residual_sharding(out)
         return out, pages_k, pages_v
 
+    def decode_span(self, x, pages_k, pages_v, tables, start, n, active,
+                    attn_impl: str = "xla", write_from=None):
+        """A span of consecutive new tokens per slot: the forward block
+        with the attention sublayer swapped for
+        :meth:`MultiHeadAttention.decode_span` (multi-token paged
+        scatter + per-row q_len=1-exact attention). Shared by the
+        speculative verify tick and chunked prefill (ISSUE 12).
+        ``x`` [S, Q, D]; returns ``(out, pages_k, pages_v)``."""
+        with jax.named_scope("attn"):
+            a, pages_k, pages_v = self.attn.decode_span(
+                self.ln1(x), pages_k, pages_v, tables, start, n, active,
+                impl=attn_impl, write_from=write_from)
+            h = x + a
+        if self.residual_sharding is not None:
+            h = self.residual_sharding(h)
+        with jax.named_scope("ffn"):
+            z = self.ln2(h)
+            if self.moe_experts > 0:
+                y, _aux = self.ffn(z, return_aux=True)
+            else:
+                y = self.ffn2(self.ffn1(z))
+            out = h + y
+        if self.residual_sharding is not None:
+            out = self.residual_sharding(out)
+        return out, pages_k, pages_v
+
     def _maybe_drop(self, x, train):
         if self.dropout is not None and train:
             return self.dropout(x, train=True)
@@ -296,6 +322,47 @@ class TransformerLM(Module):
             with jax.named_scope("head"):
                 logits = self.emb.attend(self.ln_f(x))
         return logits[:, 0], (pages_k, pages_v, tables)
+
+    def decode_span(self, tokens, kv, start, n, active=None,
+                    attn_impl: str = "xla", write_from=None):
+        """Serving span step: ``Q`` consecutive new tokens per slot
+        against the paged KV cache — ONE compiled dispatch that the
+        speculative verify tick (``Q = 1 + draft_k``) and chunked
+        prefill (``Q = chunk``) both ride (ISSUE 12). ``tokens``
+        ``[S, Q]`` int32 (token ``j`` of slot ``s`` at position
+        ``start[s] + j``); ``n`` ``[S]`` live token counts (rows past
+        ``n`` are padding — null-block scatter, garbage logits);
+        ``write_from`` ``[S]`` optional scatter floor for shared-prefix
+        re-reads. Returns ``(logits [S, Q, vocab], kv')``; row ``j`` of
+        a live slot is bit-equal (f32) to what :meth:`decode_step`
+        would produce at that position — the structural losslessness
+        the serve tests pin."""
+        pages_k, pages_v, tables = kv
+        S, Q = tokens.shape
+        if active is None:
+            active = jnp.ones((S,), bool)
+        pos = jnp.minimum(start[:, None]
+                          + jnp.arange(Q, dtype=jnp.int32)[None, :],
+                          self.max_len - 1)
+        with jax.named_scope("decode/span"):
+            with jax.named_scope("embed"):
+                x = self.emb(tokens) + self.pos(pos)
+            block0, stacked = self._stacked_blocks()
+
+            def body(h, xs):
+                bp, pk, pv = xs
+                y, pk, pv = block0.apply(
+                    {"params": {block0._name: bp}}, h, pk, pv, tables,
+                    start, n, active, attn_impl=attn_impl,
+                    write_from=write_from, method="decode_span")
+                return y, (pk, pv)
+
+            with jax.named_scope("block_scan"):
+                x, (pages_k, pages_v) = lax.scan(
+                    body, x, (stacked, pages_k, pages_v))
+            with jax.named_scope("head"):
+                logits = self.emb.attend(self.ln_f(x))
+        return logits, (pages_k, pages_v, tables)
 
     def grad_sync_scan_paths(self):
         """The ``parallel.overlap`` in-scan protocol: fnmatch patterns (over

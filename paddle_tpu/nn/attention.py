@@ -269,36 +269,113 @@ class MultiHeadAttention(Module):
                         q[:, 0], pages_k, pages_v, tables, eff_len)
                     ctx = ctx.reshape(S, 1, h, hd).astype(pol.compute_dtype)
             else:
-                # mirrors the forward "sdpa_xla" branch op for op — WITH
-                # the single query row broadcast to all W rows, so every
-                # op in the chain has the training forward's exact shape.
-                # XLA's CPU gemm is row-stable across row counts but the
-                # q_len=1 PV contraction lowers with a DIFFERENT
-                # k-accumulation order (measured: ~1 ulp drift), so
-                # shape-matching is what makes decode logits bit-equal
-                # (f32) to the full-sequence forward's row. O(W^2) — this
-                # is the correctness-oracle path; the paged Pallas kernel
-                # is the decode-shaped production path.
                 with jax.named_scope("sdpa_xla"):
                     kg = gather_pages(pages_k, tables)      # [S, W, h, hd]
                     vg = gather_pages(pages_v, tables)
-                    W = kg.shape[1]
-                    qb = jnp.broadcast_to(q, (S, W, h, hd))
-                    logits = jnp.einsum("bqhd,bkhd->bhqk", qb, kg) \
-                        / np.sqrt(hd)
-                    logits = logits.astype(jnp.float32)
-                    mask = jnp.arange(W)[None, :] < eff_len[:, None]
-                    logits = jnp.where(mask[:, None, None, :], logits, -1e9)
-                    w = jax.nn.softmax(logits, axis=-1) \
-                        .astype(pol.compute_dtype)
-                    ctx = jnp.einsum("bhqk,bkhd->bqhd", w, vg)[:, :1]
-                    # a length-0 lane's softmax is uniform over -1e9
-                    # logits (an average of stale pages, not zeros) —
-                    # zero it to match the paged kernel's convention;
-                    # active lanes pass through bit-unchanged
-                    ctx = jnp.where((eff_len > 0)[:, None, None, None],
-                                    ctx, 0.0)
+                    ctx = self._sdpa_row(q, kg, vg, eff_len, pol, hd)
             ctx = ctx.reshape(S, 1, h * hd)
+            with jax.named_scope("out_proj"):
+                out = proj("wo", ctx, out_d)
+            return out, pages_k, pages_v
+
+    @staticmethod
+    def _sdpa_row(q, kg, vg, eff_len, pol, hd):
+        """ONE query row against the gathered paged context ``kg``/``vg``
+        ``[S, W, h, hd]`` -> ``ctx [S, 1, h, hd]`` — the single op chain
+        BOTH :meth:`decode` (q_len=1) and every :meth:`decode_span` row
+        share, so their bit-equality lock-step is structural: an edit
+        here changes the tick and the verify/chunk span together, never
+        one without the other.
+
+        Mirrors the forward "sdpa_xla" branch op for op — WITH the
+        single query row broadcast to all W rows, so every op in the
+        chain has the training forward's exact shape. XLA's CPU gemm is
+        row-stable across row counts but the q_len=1 PV contraction
+        lowers with a DIFFERENT k-accumulation order (measured: ~1 ulp
+        drift), so shape-matching is what makes decode logits bit-equal
+        (f32) to the full-sequence forward's row. O(W^2) — this is the
+        correctness-oracle path; the paged Pallas kernel is the
+        decode-shaped production path."""
+        S, W = kg.shape[:2]
+        h = q.shape[2]
+        qb = jnp.broadcast_to(q, (S, W, h, hd))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qb, kg) / np.sqrt(hd)
+        logits = logits.astype(jnp.float32)
+        mask = jnp.arange(W)[None, :] < eff_len[:, None]
+        logits = jnp.where(mask[:, None, None, :], logits, -1e9)
+        w = jax.nn.softmax(logits, axis=-1).astype(pol.compute_dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", w, vg)[:, :1]
+        # a length-0 lane's softmax is uniform over -1e9 logits (an
+        # average of stale pages, not zeros) — zero it to match the
+        # paged kernel's convention; live lanes pass through unchanged
+        return jnp.where((eff_len > 0)[:, None, None, None], ctx, 0.0)
+
+    def decode_span(self, q_in, pages_k, pages_v, tables, start, n,
+                    active, impl: str = "xla", write_from=None):
+        """A SPAN of consecutive new tokens per slot against the paged
+        KV cache — the multi-query generalization of :meth:`decode`
+        shared by the speculative verify tick (``Q = 1 + draft_k``) and
+        chunked prefill (``Q = chunk``), ISSUE 12.
+
+        Args: ``q_in`` [S, Q, D] (token ``j`` of slot ``s`` sits at
+        position ``start[s] + j``); ``n`` [S] live token count per slot
+        (rows ``>= n`` are padding: null-block scatter, garbage logits
+        the host ignores); ``active`` [S]; ``write_from`` [S] optional
+        absolute position below which the scatter is masked (a chunk
+        re-attending a shared prefix must not write co-owned pages).
+        Returns ``(out [S, Q, out_d], pages_k, pages_v)``.
+
+        Only ``impl="xla"`` exists: each row is computed by the EXACT
+        q_len=1 broadcast-to-W op sequence (an unrolled loop over the
+        static ``Q``), so every position's output is bit-equal (f32) to
+        what a sequence of single-token :meth:`decode` ticks would have
+        produced — the lossless-speculation and chunked-prefill
+        bit-equality guarantees are structural, not tolerances. The
+        paged Pallas kernel is q_len=1-shaped; a multi-query kernel is
+        the ROADMAP follow-up."""
+        from ..serve.kv_cache import gather_pages, scatter_span
+        if impl != "xla":
+            raise ValueError(
+                f"decode_span supports impl='xla' only (got {impl!r}); "
+                "the paged Pallas kernel is q_len=1-shaped")
+        with self.scope():
+            pol = current_policy()
+            d_model = q_in.shape[-1]
+            h = self.num_heads
+            hd = self.head_dim or d_model // h
+            out_d = self.out_dim or d_model
+            S, Q = q_in.shape[:2]
+
+            def proj(name, x, feats):
+                w = self.param(name, I.xavier_uniform, (x.shape[-1], feats))
+                return jnp.dot(pol.cast_compute(x), pol.cast_compute(w),
+                               preferred_element_type=pol.accum_dtype)
+
+            with jax.named_scope("qkv_proj"):
+                q = proj("wq", q_in, h * hd).reshape(S, Q, h, hd)
+                k = proj("wk", q_in, h * hd).reshape(S, Q, h, hd)
+                v = proj("wv", q_in, h * hd).reshape(S, Q, h, hd)
+            n_eff = jnp.where(active, n, 0)
+            with jax.named_scope("kv_scatter"):
+                pages_k = scatter_span(pages_k, k, tables, start, n_eff,
+                                       write_from)
+                pages_v = scatter_span(pages_v, v, tables, start, n_eff,
+                                       write_from)
+            with jax.named_scope("sdpa_xla"):
+                kg = gather_pages(pages_k, tables)      # [S, W, h, hd]
+                vg = gather_pages(pages_v, tables)
+                ctxs = []
+                for j in range(Q):
+                    # row j sees context start+j+1 (itself included);
+                    # later span rows sit beyond the mask, and masked
+                    # logits are the constant -1e9 regardless of page
+                    # content — identical to the sequential tick's view
+                    eff_len = jnp.where(active & (j < n_eff),
+                                        start + j + 1, 0)
+                    ctxs.append(self._sdpa_row(q[:, j:j + 1], kg, vg,
+                                               eff_len, pol, hd))
+                ctx = jnp.concatenate(ctxs, axis=1)     # [S, Q, h, hd]
+            ctx = ctx.reshape(S, Q, h * hd)
             with jax.named_scope("out_proj"):
                 out = proj("wo", ctx, out_d)
             return out, pages_k, pages_v

@@ -72,6 +72,23 @@ def summarize_requests(records: List[Dict[str, Any]]
         for p in (50, 95, 99):
             v = percentile(vals, p)
             out[f"{key}_p{p}"] = round(v, 4) if v is not None else None
+    # serving-throughput aggregates (ISSUE 12): how much of the paged
+    # pool the prefix cache deduplicated, how often COW actually forked,
+    # and what fraction of speculative drafts the target model accepted
+    hit = sum(r.get("prefix_hit_blocks") or 0 for r in terminal)
+    reserved = sum(r.get("blocks_reserved") or 0 for r in terminal)
+    out["prefix_hit_blocks"] = hit
+    out["block_sharing_ratio"] = (round(hit / reserved, 4)
+                                  if reserved else None)
+    out["cow_forks"] = sum(r.get("cow_forks") or 0 for r in terminal)
+    out["prefill_chunks"] = sum(r.get("prefill_chunks") or 0
+                                for r in terminal)
+    # weighted by draft volume, not a mean of per-request ratios — a
+    # 2-draft request must not average equally with a 500-draft one
+    proposed = sum(r.get("draft_proposed") or 0 for r in terminal)
+    accepted = sum(r.get("draft_accepted") or 0 for r in terminal)
+    out["draft_accept_rate"] = (round(accepted / proposed, 4)
+                                if proposed else None)
     dl = [r for r in terminal if r.get("deadline_s") is not None]
     met = [r for r in dl
            if r.get("finish_reason") in GOODPUT_REASONS

@@ -214,6 +214,19 @@ def format_summary(s: Dict[str, Any]) -> str:
                          f"({sv.get('deadline_met')}/"
                          f"{sv.get('deadline_requests')}, "
                          f"{sv.get('goodput_tokens')} tokens)")
+        # serving-throughput aggregates (ISSUE 12): only rendered when
+        # the engine features actually fired
+        if sv.get("prefix_hit_blocks"):
+            lines.append(f"  {'prefix-cache block sharing':<28}"
+                         f"{sv['prefix_hit_blocks']} blocks "
+                         f"({sv.get('block_sharing_ratio')} of reserved, "
+                         f"{sv.get('cow_forks', 0)} COW forks)")
+        if sv.get("draft_accept_rate") is not None:
+            lines.append(f"  {'speculative accept rate':<28}"
+                         f"{sv['draft_accept_rate']}")
+        if sv.get("prefill_chunks"):
+            lines.append(f"  {'prefill chunks':<28}"
+                         f"{sv['prefill_chunks']}")
     return "\n".join(lines)
 
 

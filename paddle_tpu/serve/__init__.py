@@ -7,17 +7,22 @@ The layers mirror the serving literature the design follows (PAPERS.md
 [S1] PagedAttention, [S2] Orca, [R2] Bamboo for the death-is-routine
 doctrine):
 
-- :mod:`.kv_cache` — the paged KV pool: fixed-size blocks shared by all
-  concurrent sequences, host-side block tables/alloc/free, ``jnp``-pure
+- :mod:`.kv_cache` — the paged KV pool: fixed-size REFCOUNTED blocks
+  shared by all concurrent sequences, host-side block
+  tables/alloc/free, the content-addressed :class:`PrefixCache` behind
+  copy-on-write prefix sharing (ISSUE 12), and the ``jnp``-pure
   gather/scatter used by the compiled programs.
 - :mod:`.engine` — :class:`DecodeEngine`: the two compiled fixed-shape
-  programs (padded-width prefill, max-slot decode tick with an active
-  mask), donated KV carry, greedy sampling, retrace accounting, and the
-  structured :class:`AdmitProbe` backpressure verdict.
+  programs (padded-width or chunked prefill, max-slot decode tick with
+  an active mask — optionally the ``[S, 1+k]`` speculative verify
+  tick), donated KV carry, greedy or seeded-stochastic sampling
+  (:class:`SamplingConfig`), COW fork-on-write, retrace accounting, and
+  the structured :class:`AdmitProbe` backpressure verdict.
 - :mod:`.scheduler` — :class:`ContinuousBatchingScheduler`: iteration-
   level request admission/eviction between decode ticks with
-  FCFS/SJF/priority queue policies, submit-time load shedding, deadline
-  eviction, and per-request TTFT/TPOT telemetry.
+  FCFS/SJF/priority queue policies, chunked-prefill interleaving,
+  submit-time load shedding, deadline eviction, and per-request
+  TTFT/TPOT + sharing/speculation telemetry.
 - :mod:`.router` / :mod:`.fleet` — :class:`FleetRouter` +
   :class:`ServingFleet` (ISSUE 11): N replica workers behind
   session-affine least-loaded routing, heartbeat health gating (the
@@ -28,17 +33,19 @@ doctrine):
   the :class:`SimClock` that makes fleet fault drills deterministic.
 """
 
-from .kv_cache import (BlockAllocator, PagedKVCache, gather_pages,
-                       scatter_prefill, scatter_token)
-from .engine import AdmitProbe, DecodeEngine
+from .kv_cache import (BlockAllocator, PagedKVCache, PrefixCache,
+                       PrefixMatch, gather_pages, scatter_prefill,
+                       scatter_token, scatter_span)
+from .engine import AdmitProbe, DecodeEngine, SamplingConfig
 from .scheduler import ContinuousBatchingScheduler, Request
 from .router import FleetRouter, RouteDecision
 from .fleet import FleetRequest, ReplicaWorker, ServingFleet
 from .loadgen import GenRequest, SimClock, make_workload, workload_stats
 
-__all__ = ["BlockAllocator", "PagedKVCache", "DecodeEngine", "AdmitProbe",
+__all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "PrefixMatch",
+           "DecodeEngine", "AdmitProbe", "SamplingConfig",
            "ContinuousBatchingScheduler", "Request", "gather_pages",
-           "scatter_prefill", "scatter_token",
+           "scatter_prefill", "scatter_token", "scatter_span",
            "FleetRouter", "RouteDecision", "ServingFleet",
            "ReplicaWorker", "FleetRequest",
            "GenRequest", "SimClock", "make_workload", "workload_stats"]

@@ -7,16 +7,30 @@ that retraces when a request arrives or finishes pays seconds of XLA
 compile mid-traffic. So the engine compiles exactly TWO programs and
 reuses them for the whole process lifetime:
 
-- **prefill** at the fixed padded width ``[1, W]`` (``W`` = the cache's
-  per-slot context capacity): runs :meth:`TransformerLM.prefill`, writes
-  the prompt's per-layer K/V into the slot's pool pages, and returns the
-  first greedy token. Every prompt, whatever its length, runs this one
-  shape.
-- the **decode tick** at the fixed slot count ``[S]``: one
-  :meth:`TransformerLM.decode_step` over ALL slots with an ``active``
-  mask — empty slots ride along as masked lanes (null-block scatter,
-  zero-length attention), so admissions and evictions between ticks are
-  pure host-side table edits that never change the compiled shape.
+- **prefill**: at the fixed padded width ``[1, W]`` (``W`` = the cache's
+  per-slot context capacity) by default, or — with
+  ``prefill_chunk=C`` — at the fixed CHUNK width ``[1, C]``, so a long
+  prompt becomes ``ceil(P/C)`` cheap calls the scheduler interleaves
+  between decode ticks instead of one monolithic stall (ISSUE 12:
+  chunked prefill bounds running slots' TPOT under long admissions).
+- the **decode tick** at the fixed slot count ``[S]`` — or, with
+  ``speculative=k``, at ``[S, 1+k]``: every tick carries each slot's
+  pending token plus ``k`` n-gram self-drafted guesses, one batched
+  dispatch verifies all of them, and the host accepts the longest
+  draft prefix the model agrees with plus the model's own next token.
+  Greedy output is BIT-IDENTICAL to the non-speculative engine by
+  construction (each span row is computed by the exact q_len=1 op
+  sequence) — speculation only changes how many tokens one memory-bound
+  tick retires, never which tokens. The drafted width is a static
+  shape, so ``compile_counts()`` stays pinned at {prefill: 1, tick: 1}.
+
+**Copy-on-write prefix sharing** (``share_prefix=True``): admission
+looks the prompt up in the cache-resident prefix index and maps every
+full-block hit into the slot's table BY REFERENCE (refcounted — zero
+new HBM, zero re-scatter); only the divergent tail allocates and
+prefills fresh blocks. An exact-duplicate prompt additionally shares
+the partial boundary block and forks it (one-block device copy) at the
+first divergent write — the OS COW page move at the divergence point.
 
 The KV pools are the tick's DONATED carry: the pool buffers flip between
 two XLA allocations instead of reallocating per token. Block tables,
@@ -24,8 +38,14 @@ lengths, and the token front are small host-authoritative arrays pushed
 per call (bytes, not megabytes — the pools never cross the host
 boundary).
 
-Sampling is greedy (argmax) — deterministic, which is what lets the serve
-tests pin engine output against the training forward bit-for-bit.
+Sampling is greedy (argmax) by default — deterministic, which is what
+lets the serve tests pin engine output against the training forward
+bit-for-bit. ``sampling=SamplingConfig(...)`` switches the tick to
+seeded stochastic sampling (temperature / top-k / top-p with per-slot,
+per-tick PRNG keys); it composes with sharing and chunked prefill but
+not with speculation (the verify rule is greedy-exact — lossless
+stochastic verification is the Leviathan rejection-sampling follow-up,
+PAPERS.md [S3]).
 """
 
 from __future__ import annotations
@@ -40,7 +60,7 @@ import jax.numpy as jnp
 
 from .kv_cache import PagedKVCache, scatter_prefill
 
-__all__ = ["DecodeEngine", "AdmitProbe"]
+__all__ = ["DecodeEngine", "AdmitProbe", "SamplingConfig"]
 
 
 @dataclasses.dataclass
@@ -56,6 +76,51 @@ class AdmitProbe:
     blocks_needed: int
     free_blocks: int
     free_slots: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """Stochastic decoding knobs (ISSUE 12 satellite). Applied inside
+    the compiled tick with a per-slot, per-tick PRNG key
+    (``fold_in(fold_in(seed, tick), slot)``) so a fixed seed replays the
+    exact token stream — seeded-deterministic, not merely "random".
+    Filters compose in the conventional order: temperature scaling,
+    then top-k truncation, then top-p (nucleus) truncation."""
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: int = 0
+
+    def validate(self, vocab: int) -> None:
+        if not self.temperature > 0:
+            raise ValueError(f"temperature must be > 0 (greedy is "
+                             f"sampling=None), got {self.temperature}")
+        if self.top_k is not None and not 1 <= self.top_k <= vocab:
+            raise ValueError(f"top_k must be in [1, {vocab}], "
+                             f"got {self.top_k}")
+        if self.top_p is not None and not 0 < self.top_p <= 1:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+
+def _sample_tokens(cfg: SamplingConfig, logits, keys):
+    """Traced sampler: ``logits [S, V]``, ``keys [S, 2]`` -> ``[S]``
+    int32. Top-k keeps the k highest logits; top-p keeps the smallest
+    descending-probability set whose mass reaches p (the head token
+    always survives both)."""
+    x = logits.astype(jnp.float32) / cfg.temperature
+    if cfg.top_k is not None:
+        kth = jnp.sort(x, axis=-1)[:, -cfg.top_k][:, None]
+        x = jnp.where(x >= kth, x, -jnp.inf)
+    if cfg.top_p is not None:
+        sorted_x = jnp.sort(x, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_x, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep entries whose PRECEDING cumulative mass is < p (the
+        # first token always survives); find the cutoff logit value
+        keep = (cum - probs) < cfg.top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_x, jnp.inf), axis=-1)
+        x = jnp.where(x >= cutoff[:, None], x, -jnp.inf)
+    return jax.vmap(jax.random.categorical)(keys, x).astype(jnp.int32)
 
 
 def _resolve_attention(attention: str) -> str:
@@ -93,11 +158,23 @@ class DecodeEngine:
         ``model.max_len // block_size``, and must keep the capacity
         within ``model.max_len`` — positions are embedded).
       attention: ``"auto" | "paged" | "xla"`` — see
-        :func:`_resolve_attention`.
+        :func:`_resolve_attention`. Speculation forces the span path,
+        which is XLA-only today.
+      share_prefix: copy-on-write physical block sharing between
+        resident sequences with a common prompt prefix (default ON —
+        the PagedAttention production win, ISSUE 12).
+      speculative: number of n-gram self-drafted tokens verified per
+        tick (0 = off). Greedy-lossless by construction; incompatible
+        with ``sampling``.
+      prefill_chunk: prefill chunk width C (None = legacy one-shot
+        full-width prefill). Long prompts prefill in ``ceil(P/C)``
+        calls the scheduler interleaves between decode ticks.
+      sampling: a :class:`SamplingConfig` for stochastic decoding
+        (None = greedy).
       telemetry: optional :class:`paddle_tpu.obs.Telemetry`; the engine
         emits one ``kind="decode_tick"`` record per tick (dispatch wall,
-        active slots, tokens/sec) and the scheduler adds per-request
-        records through the same object.
+        active slots, tokens/sec, sharing/speculation counters) and the
+        scheduler adds per-request records through the same object.
       dtype: KV pool dtype. f32 default matches the projections' f32
         accumulation under both the f32 and bf16-compute policies.
     """
@@ -105,12 +182,32 @@ class DecodeEngine:
     def __init__(self, model, variables, *, max_slots: int = 4,
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  max_blocks_per_seq: Optional[int] = None,
-                 attention: str = "auto", telemetry=None,
-                 dtype=jnp.float32):
+                 attention: str = "auto", share_prefix: bool = True,
+                 speculative: int = 0,
+                 prefill_chunk: Optional[int] = None,
+                 sampling: Optional[SamplingConfig] = None,
+                 telemetry=None, dtype=jnp.float32):
         self.model = model
         self.variables = variables
         self.telemetry = telemetry
         self.attention = _resolve_attention(attention)
+        if speculative < 0:
+            raise ValueError(f"speculative must be >= 0, "
+                             f"got {speculative}")
+        if speculative and sampling is not None:
+            raise ValueError(
+                "speculative decoding verifies greedily (lossless by "
+                "construction) and cannot compose with sampling= — "
+                "lossless stochastic verification is the [S3] "
+                "rejection-sampling follow-up (ROADMAP)")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, "
+                             f"got {prefill_chunk}")
+        if sampling is not None:
+            sampling.validate(model.emb.vocab)
+        self.speculative = int(speculative)
+        self.prefill_chunk = prefill_chunk
+        self.sampling = sampling
         num_layers = len(model.blocks)
         num_heads = model.blocks[0].attn.num_heads
         dim = model.emb.dim
@@ -126,41 +223,117 @@ class DecodeEngine:
         self.cache = PagedKVCache(
             num_layers, num_heads, head_dim, num_blocks, block_size,
             max_slots=max_slots, max_blocks_per_seq=max_blocks_per_seq,
-            dtype=dtype)
+            dtype=dtype, share_prefix=share_prefix)
         self.max_slots = max_slots
         # host-authoritative slot state beside the cache's tables/lengths
         self.active = np.zeros((max_slots,), bool)
         self.tokens = np.zeros((max_slots,), np.int32)   # next to decode
+        # per-slot token history (prompt + accepted generations): the
+        # n-gram self-drafter's corpus — tiny host lists, always kept.
+        # The drafter's lookup is incremental: per-slot maps of bigram/
+        # token -> (latest index, previous-latest index), maintained on
+        # append, so each proposal is O(k) instead of rescanning the
+        # history per tick
+        self.history: List[List[int]] = [[] for _ in range(max_slots)]
+        self._bigram_idx: List[Dict] = [{} for _ in range(max_slots)]
+        self._unigram_idx: List[Dict] = [{} for _ in range(max_slots)]
+        self._tick_counters: Dict[str, int] = {}
+        # chunked-prefill cursors: slot -> (prompt, cursor, shared_len)
+        self._prefilling: Dict[int, Dict[str, Any]] = {}
         self.ticks = 0
         self.tokens_generated = 0
+        self.prefill_chunks = 0          # cumulative chunk calls
+        self.draft_proposed = 0          # cumulative drafted tokens
+        self.draft_accepted = 0          # cumulative accepted drafts
+        # per-slot attribution for request-level telemetry
+        self.slot_stats: List[Dict[str, int]] = [
+            {} for _ in range(max_slots)]
+        # what the last tick retired per slot (list of accepted tokens;
+        # [tok] for the non-speculative tick) — the scheduler's view
+        self.last_accepted: Dict[int, List[int]] = {}
 
         W = self.cache.context_width
         attn_impl = self.attention
+        K1 = 1 + self.speculative
+        cfg = self.sampling
 
-        def prefill_fn(variables, pages_k, pages_v, ids, length, table):
-            # ids [1, W] padded; length [1]; table [1, MB]
-            logits, (ks, vs) = model.apply(variables, ids,
-                                           method="prefill")
-            scat = jax.vmap(scatter_prefill, in_axes=(0, 0, None, None))
-            pages_k = scat(pages_k, ks.astype(pages_k.dtype), table, length)
-            pages_v = scat(pages_v, vs.astype(pages_v.dtype), table, length)
-            last = jnp.take_along_axis(
-                logits, (length - 1)[:, None, None], axis=1)[0, 0]
-            return pages_k, pages_v, jnp.argmax(last).astype(jnp.int32)
+        def first_token(last_logits, key):
+            if cfg is None:
+                return jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            return _sample_tokens(cfg, last_logits[None], key[None])[0]
 
-        def tick_fn(variables, pages_k, pages_v, tables, lengths, tokens,
-                    active):
-            logits, (pages_k, pages_v, _) = model.apply(
-                variables, tokens, (pages_k, pages_v, tables), lengths,
-                active, attn_impl=attn_impl, method="decode_step")
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return pages_k, pages_v, nxt
+        if prefill_chunk is None:
+            def prefill_fn(variables, pages_k, pages_v, ids, length,
+                           start, table, key):
+                # ids [1, W] padded; length/start [1]; table [1, MB]
+                logits, (ks, vs) = model.apply(variables, ids,
+                                               method="prefill")
+                scat = jax.vmap(scatter_prefill,
+                                in_axes=(0, 0, None, None, None))
+                pages_k = scat(pages_k, ks.astype(pages_k.dtype), table,
+                               length, start)
+                pages_v = scat(pages_v, vs.astype(pages_v.dtype), table,
+                               length, start)
+                last = jnp.take_along_axis(
+                    logits, (length - 1)[:, None, None], axis=1)[0, 0]
+                return pages_k, pages_v, first_token(last, key)
+        else:
+            C = prefill_chunk
+
+            def prefill_fn(variables, pages_k, pages_v, ids, start, n,
+                           write_from, table, key):
+                # ids [1, C]: tokens at positions start..start+n-1;
+                # rows >= n are padding; scatter floored at write_from
+                # (shared-prefix rows are co-owned — never rewritten)
+                logits, (pages_k, pages_v, _) = model.apply(
+                    variables, ids, (pages_k, pages_v, table), start, n,
+                    jnp.ones((1,), bool), attn_impl="xla",
+                    write_from=write_from, method="decode_span")
+                last = jnp.take_along_axis(
+                    logits, (n - 1)[:, None, None], axis=1)[0, 0]
+                return pages_k, pages_v, first_token(last, key)
+
+        if self.speculative == 0:
+            def tick_fn(variables, pages_k, pages_v, tables, lengths,
+                        tokens, active, keys):
+                logits, (pages_k, pages_v, _) = model.apply(
+                    variables, tokens, (pages_k, pages_v, tables), lengths,
+                    active, attn_impl=attn_impl, method="decode_step")
+                if cfg is None:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    nxt = _sample_tokens(cfg, logits, keys)
+                return pages_k, pages_v, nxt[:, None]
+        else:
+            def tick_fn(variables, pages_k, pages_v, tables, lengths,
+                        tokens, n, active):
+                # tokens [S, 1+k]: pending + drafts; ONE span dispatch
+                # verifies every draft (greedy argmax per row)
+                logits, (pages_k, pages_v, _) = model.apply(
+                    variables, tokens, (pages_k, pages_v, tables),
+                    lengths, n, active, attn_impl="xla",
+                    method="decode_span")
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return pages_k, pages_v, nxt        # [S, 1+k]
 
         # donate the KV pools: the tick's carry flips between two
         # allocations instead of growing HBM per token
         self._prefill_fn = jax.jit(prefill_fn, donate_argnums=(1, 2))
         self._tick_fn = jax.jit(tick_fn, donate_argnums=(1, 2))
+        # COW block copy: [L, bs, H, hd] pages move pool-internally, one
+        # tiny donated program (not an engine entry point — not counted
+        # in compile_counts, traced once for the process lifetime)
+        self._cow_fn = jax.jit(
+            lambda pages, src, dst: pages.at[:, dst].set(pages[:, src]),
+            donate_argnums=(0,))
+        self._zero_keys = jnp.zeros((max_slots, 2), jnp.uint32)
+        seed = sampling.seed if sampling is not None else 0
+        self._tick_keys = jax.jit(lambda t: jax.vmap(
+            lambda s: jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(seed), t), s))(
+                    jnp.arange(max_slots)))
         self._W = W
+        self._K1 = K1
 
     # -- introspection -----------------------------------------------------
 
@@ -171,12 +344,15 @@ class DecodeEngine:
     def compile_counts(self) -> Dict[str, int]:
         """Distinct traced programs per entry point — the no-retrace
         invariant is both == 1 after warmup, across any admit/evict
-        churn (the bench serving gate asserts it)."""
+        churn AND with speculation/chunking/sharing on (drafted width
+        and chunk width are static shapes; the bench serving gate
+        asserts it)."""
         return {"prefill": int(self._prefill_fn._cache_size()),
                 "tick": int(self._tick_fn._cache_size())}
 
     def free_slots(self) -> List[int]:
-        return [s for s in range(self.max_slots) if not self.active[s]]
+        return [s for s in range(self.max_slots)
+                if not self.active[s] and s not in self._prefilling]
 
     def admit_probe(self, total_len: int,
                     include_slots: bool = True) -> AdmitProbe:
@@ -186,7 +362,10 @@ class DecodeEngine:
         (exceeds slot capacity), ``"slots"`` (no free decode lane;
         skipped with ``include_slots=False`` for callers that manage
         slots themselves, like the scheduler), ``"blocks"`` (KV pool
-        can't cover the worst-case reservation)."""
+        can't cover the worst-case reservation). Deliberately ignores
+        prefix-cache hits: the probe is the conservative no-sharing
+        bound, so an admitted request can never strand mid-decode even
+        if every co-owner forks."""
         blocks_needed = self.cache.blocks_needed(total_len)
         free_slots = len(self.free_slots())
         if total_len > self._W:
@@ -214,86 +393,327 @@ class DecodeEngine:
     # -- request lifecycle -------------------------------------------------
 
     def stage_prompt(self, prompt: List[int]) -> np.ndarray:
-        """Pad a prompt to the fixed prefill width ``[1, W]`` — pure host
-        work the scheduler runs at SUBMIT time (the PR-3 staging move:
-        admission-path host prep happens off the tick's critical path)."""
+        """Pad a prompt to the fixed prefill width — pure host work the
+        scheduler runs at SUBMIT time (the PR-3 staging move:
+        admission-path host prep happens off the tick's critical
+        path). Chunked engines stage per-chunk at prefill time (the
+        arrays are C-sized — already cheap)."""
         P = len(prompt)
         if not 0 < P <= self._W:
             raise ValueError(f"prompt length {P} not in [1, {self._W}]")
+        if self.prefill_chunk is not None:
+            return np.asarray([prompt], np.int32)    # chunked: raw ids
         ids = np.zeros((1, self._W), np.int32)
         ids[0, :P] = prompt
         return ids
 
-    def admit(self, slot: int, prompt: List[int],
-              reserve_len: Optional[int] = None,
-              staged: Optional[np.ndarray] = None) -> int:
-        """Prefill ``prompt`` into ``slot`` and return the first greedy
-        token. ``reserve_len`` (default: prompt length) eagerly allocates
-        blocks for the sequence's full growth target; ``staged`` is an
-        already-padded :meth:`stage_prompt` array."""
-        assert not self.active[slot], f"slot {slot} is occupied"
+    def _reserve(self, slot: int, prompt: List[int],
+                 reserve_len: Optional[int]) -> Dict[str, int]:
+        """Shared admission prologue: prefix-cache adopt + worst-case
+        block reservation. Returns the slot's sharing stats."""
         P = len(prompt)
         target = max(P, reserve_len or P)
+        match = self.cache.match_prefix(prompt)
+        shared_len, hit_blocks = 0, 0
+        if match is not None and match.blocks:
+            self.cache.adopt_prefix(slot, match)
+            shared_len, hit_blocks = match.length, match.hit_blocks
         if not self.cache.ensure_capacity(slot, target):
+            self.cache.free_slot(slot)     # roll back the adoption
             raise RuntimeError(
                 f"KV pool exhausted admitting slot {slot} "
                 f"(need {self.cache.blocks_needed(target)} blocks, "
                 f"{self.cache.free_blocks} free) — gate admissions on "
                 f"can_admit()")
-        ids = staged if staged is not None else self.stage_prompt(prompt)
-        self.cache.k, self.cache.v, tok = self._prefill_fn(
-            self.variables, self.cache.k, self.cache.v,
-            jnp.asarray(ids), jnp.asarray([P], jnp.int32),
-            jnp.asarray(self.cache.tables[slot:slot + 1]))
+        stats = {"prefix_hit_blocks": hit_blocks,
+                 "shared_len": shared_len,
+                 "blocks_reserved": self.cache.owned_count(slot),
+                 "cow_forks": 0, "prefill_chunks": 0,
+                 "draft_proposed": 0, "draft_accepted": 0}
+        self.slot_stats[slot] = stats
+        return stats
+
+    def _prefill_key(self) -> jnp.ndarray:
+        """Per-admission PRNG key for a sampled first token (greedy
+        engines trace the same operand but never use it)."""
+        seed = self.sampling.seed if self.sampling is not None else 0
+        return jax.random.fold_in(jax.random.PRNGKey(seed),
+                                  1 + self.prefill_chunks + self.ticks)
+
+    def admit(self, slot: int, prompt: List[int],
+              reserve_len: Optional[int] = None,
+              staged: Optional[np.ndarray] = None) -> int:
+        """Prefill ``prompt`` into ``slot`` and return the first
+        token. ``reserve_len`` (default: prompt length) eagerly
+        allocates blocks for the sequence's full growth target;
+        ``staged`` is an already-padded :meth:`stage_prompt` array. On
+        a chunked engine this drives :meth:`begin_prefill` /
+        :meth:`prefill_step` to completion in one call — schedulers
+        interleave the steps instead."""
+        self.begin_prefill(slot, prompt, reserve_len=reserve_len,
+                           staged=staged)
+        while True:
+            tok = self.prefill_step(slot)
+            if tok is not None:
+                return tok
+
+    def begin_prefill(self, slot: int, prompt: List[int],
+                      reserve_len: Optional[int] = None,
+                      staged: Optional[np.ndarray] = None) -> None:
+        """Reserve ``slot`` for ``prompt`` (prefix-cache adoption +
+        worst-case block reservation) and queue its prefill work.
+        :meth:`prefill_step` runs one compiled prefill call at a time —
+        the whole prompt for a legacy engine, one C-token chunk for a
+        chunked one — and returns the first token when done."""
+        assert not self.active[slot], f"slot {slot} is occupied"
+        assert slot not in self._prefilling, f"slot {slot} is prefilling"
+        P = len(prompt)
+        if not 0 < P <= self._W:
+            raise ValueError(f"prompt length {P} not in [1, {self._W}]")
+        stats = self._reserve(slot, prompt, reserve_len)
+        shared = stats["shared_len"]
+        # an exact-duplicate prompt shares every block; still re-attend
+        # the final position (writes masked) for the first-token logits
+        cursor = min(shared, P - 1)
+        self._prefilling[slot] = {
+            "prompt": list(prompt), "cursor": cursor,
+            "shared_len": shared, "staged": staged}
+
+    def prefill_step(self, slot: int) -> Optional[int]:
+        """Run ONE compiled prefill call for a :meth:`begin_prefill`'d
+        slot. Returns the first generated token when the prompt is fully
+        processed (the slot is then live for decode ticks), else None —
+        call again, ideally with decode ticks in between (that
+        interleaving is chunked prefill's whole point)."""
+        st = self._prefilling[slot]
+        prompt, P = st["prompt"], len(st["prompt"])
+        stats = self.slot_stats[slot]
+        if self.prefill_chunk is None:
+            ids = st["staged"] if st["staged"] is not None \
+                else self.stage_prompt(prompt)
+            self.cache.k, self.cache.v, tok = self._prefill_fn(
+                self.variables, self.cache.k, self.cache.v,
+                jnp.asarray(ids), jnp.asarray([P], jnp.int32),
+                jnp.asarray([st["shared_len"]], jnp.int32),
+                jnp.asarray(self.cache.tables[slot:slot + 1]),
+                self._prefill_key())
+            stats["prefill_chunks"] += 1
+            self.prefill_chunks += 1
+            done = True
+        else:
+            C = self.prefill_chunk
+            cur = st["cursor"]
+            n = min(C, P - cur)
+            ids = np.zeros((1, C), np.int32)
+            ids[0, :n] = prompt[cur:cur + n]
+            self.cache.k, self.cache.v, tok = self._prefill_fn(
+                self.variables, self.cache.k, self.cache.v,
+                jnp.asarray(ids), jnp.asarray([cur], jnp.int32),
+                jnp.asarray([n], jnp.int32),
+                jnp.asarray([st["shared_len"]], jnp.int32),
+                jnp.asarray(self.cache.tables[slot:slot + 1]),
+                self._prefill_key())
+            st["cursor"] = cur + n
+            stats["prefill_chunks"] += 1
+            self.prefill_chunks += 1
+            done = st["cursor"] >= P
+        if not done:
+            return None
+        del self._prefilling[slot]
         self.cache.lengths[slot] = P
         self.active[slot] = True
-        self.tokens[slot] = int(tok)
-        return int(tok)
+        tok = int(tok)
+        self.tokens[slot] = tok
+        self.history[slot] = []
+        self._bigram_idx[slot] = {}
+        self._unigram_idx[slot] = {}
+        self._history_append(slot, list(prompt) + [tok])
+        self.cache.register_prefix(slot, prompt)
+        return tok
 
     def evict(self, slot: int) -> None:
-        """Free ``slot``'s blocks back to the pool; the lane masks off at
-        the next tick. Stale pool contents are not wiped (finite, always
-        length-masked) — reuse is a table edit."""
+        """Free ``slot``'s blocks back to the pool (shared blocks
+        survive until their LAST owner lets go); the lane masks off at
+        the next tick. Stale pool contents are not wiped (finite,
+        always length-masked) — reuse is a table edit."""
         self.cache.free_slot(slot)
         self.active[slot] = False
         self.tokens[slot] = 0
+        self.history[slot] = []
+        self._bigram_idx[slot] = {}
+        self._unigram_idx[slot] = {}
+        self._prefilling.pop(slot, None)
 
-    def decode_tick(self) -> np.ndarray:
-        """One compiled decode step over every slot. Appends each active
-        slot's pending token to its KV, samples the next greedy token,
-        and returns the new token front ``[S]`` (inactive lanes 0)."""
-        t0 = time.perf_counter()
-        # the new token lands at position lengths[slot]: every active slot
-        # must own that block, or the scatter would silently route to the
-        # null block / clamp onto live data — fail loud instead
+    # -- speculation -------------------------------------------------------
+
+    def _history_append(self, slot: int, toks: List[int]) -> None:
+        """Append accepted tokens to the slot's history and keep the
+        drafter's bigram/unigram occurrence maps current (each key holds
+        the latest and previous-latest index — exactly what "most
+        recent EARLIER occurrence of the tail" needs)."""
+        h = self.history[slot]
+        big, uni = self._bigram_idx[slot], self._unigram_idx[slot]
+        for t in toks:
+            h.append(t)
+            j = len(h) - 1
+            if j >= 1:
+                key = (h[j - 1], t)
+                big[key] = (j - 1, big.get(key, (None,))[0])
+            uni[t] = (j, uni.get(t, (None,))[0])
+
+    def _propose_drafts(self, slot: int) -> List[int]:
+        """N-gram self-drafting (prompt-lookup decoding): find the most
+        recent earlier occurrence of the history's tail bigram (then
+        unigram) and propose its continuation; pad with the last
+        proposed/known token (greedy tiny-model generations converge to
+        short cycles, which is exactly what this predicts). Wrong drafts
+        cost nothing but masked verify lanes — acceptance never drops
+        below the non-speculative one token per tick. O(k) per call:
+        the occurrence maps are maintained on append."""
+        k = self.speculative
+        h = self.history[slot]
+        cont: List[int] = []
+        if len(h) >= 2:
+            cur, *prev = self._bigram_idx[slot].get((h[-2], h[-1]),
+                                                    (None, None))
+            i = prev[0] if cur == len(h) - 2 else cur
+            if i is not None:
+                cont = h[i + 2:i + 2 + k]
+        if not cont and h:
+            cur, *prev = self._unigram_idx[slot].get(h[-1], (None, None))
+            i = prev[0] if cur == len(h) - 1 else cur
+            if i is not None:
+                cont = h[i + 1:i + 1 + k]
+        pad = cont[-1] if cont else h[-1]
+        return (cont + [pad] * k)[:k]
+
+    # -- the tick ----------------------------------------------------------
+
+    def _pre_tick_guard(self) -> np.ndarray:
+        """Host guard before every tick: each active slot must own the
+        block(s) its writes land in (fail loud, never a silent
+        null-block scatter), and any ADOPTED shared block in the write
+        range forks first — the copy-on-write point. Returns the live
+        token count per slot ``n [S]`` (1 + accepted-capacity-clamped
+        drafts)."""
+        n = np.zeros((self.max_slots,), np.int32)
         for slot in np.flatnonzero(self.active):
-            need = self.cache.blocks_needed(int(self.cache.lengths[slot]) + 1)
+            p = int(self.cache.lengths[slot])
+            need = self.cache.blocks_needed(p + 1)
             if need > len(self.cache._owned[slot]):
                 raise RuntimeError(
                     f"slot {slot} decoding past its reservation (length "
-                    f"{int(self.cache.lengths[slot])} needs block {need}, "
-                    f"owns {len(self.cache._owned[slot])}) — admit with a "
+                    f"{p} needs block {need}, owns "
+                    f"{len(self.cache._owned[slot])}) — admit with a "
                     f"larger reserve_len or call cache.ensure_capacity")
+            cap = len(self.cache._owned[slot]) * self.cache.block_size - p
+            n[slot] = max(1, min(self._K1, cap))
+            for idx in self.cache.cow_targets(slot, p, p + int(n[slot])
+                                              - 1):
+                src, dst = self.cache.fork_block(slot, idx)
+                src_i = jnp.asarray(src, jnp.int32)
+                dst_i = jnp.asarray(dst, jnp.int32)
+                self.cache.k = self._cow_fn(self.cache.k, src_i, dst_i)
+                self.cache.v = self._cow_fn(self.cache.v, src_i, dst_i)
+                self.slot_stats[slot]["cow_forks"] = \
+                    self.slot_stats[slot].get("cow_forks", 0) + 1
+        return n
+
+    def decode_tick(self) -> np.ndarray:
+        """One compiled decode step over every slot. Appends each active
+        slot's pending token (plus, with ``speculative=k``, its drafted
+        guesses) to its KV, verifies/samples, and returns the new token
+        front ``[S]`` (inactive lanes 0). ``last_accepted`` maps each
+        active slot to the list of tokens it retired this tick — one for
+        the plain tick, up to ``k+1`` under speculation."""
+        t0 = time.perf_counter()
+        n = self._pre_tick_guard()
         tables, lengths = self.cache.device_tables()
-        self.cache.k, self.cache.v, nxt = self._tick_fn(
-            self.variables, self.cache.k, self.cache.v, tables, lengths,
-            jnp.asarray(self.tokens), jnp.asarray(self.active))
+        drafted_tick, accepted_tick = 0, 0
+        if self.speculative == 0:
+            if self.sampling is None:
+                keys = self._zero_keys      # greedy: unused operand
+            else:
+                keys = self._tick_keys(self.ticks)
+            self.cache.k, self.cache.v, nxt = self._tick_fn(
+                self.variables, self.cache.k, self.cache.v, tables,
+                lengths, jnp.asarray(self.tokens),
+                jnp.asarray(self.active), keys)
+        else:
+            toks = np.zeros((self.max_slots, self._K1), np.int32)
+            for slot in np.flatnonzero(self.active):
+                drafts = self._propose_drafts(slot)
+                toks[slot, 0] = self.tokens[slot]
+                toks[slot, 1:] = drafts
+                drafted_tick += int(n[slot]) - 1
+            self.cache.k, self.cache.v, nxt = self._tick_fn(
+                self.variables, self.cache.k, self.cache.v, tables,
+                lengths, jnp.asarray(toks), jnp.asarray(n),
+                jnp.asarray(self.active))
         # the dispatch is async: host bookkeeping that doesn't need the
         # sampled tokens runs UNDER the in-flight device call (the PR-3
-        # overlap move at tick scale); np.asarray(nxt) is the drain
+        # overlap move at tick scale) — the plain tick advances every
+        # active slot by exactly one, so its length bump overlaps;
+        # speculative lengths depend on acceptance and must wait.
+        # np.asarray(nxt) is the drain.
         n_active = int(self.active.sum())
-        self.cache.lengths[self.active] += 1
-        nxt = np.asarray(nxt)
-        self.tokens = np.where(self.active, nxt, 0).astype(np.int32)
+        if self.speculative == 0:
+            self.cache.lengths[self.active] += 1
+        nxt = np.asarray(nxt)                    # [S, 1] or [S, 1+k]
+        self.last_accepted = {}
+        front = np.zeros((self.max_slots,), np.int32)
+        tokens_tick = 0
+        for slot in np.flatnonzero(self.active):
+            if self.speculative == 0:
+                accepted = [int(nxt[slot, 0])]
+            else:
+                # accept the longest draft prefix the model reproduced,
+                # plus the model's own token after it — identical to
+                # the sequential greedy stream by induction
+                take = 1
+                while (take < int(n[slot])
+                       and int(toks[slot, take]) == int(nxt[slot,
+                                                            take - 1])):
+                    take += 1
+                accepted = [int(t) for t in nxt[slot, :take]]
+                accepted_tick += take - 1
+                self.cache.lengths[slot] += len(accepted)
+            self.last_accepted[slot] = accepted
+            front[slot] = accepted[-1]
+            self._history_append(slot, accepted)
+            tokens_tick += len(accepted)
+            st = self.slot_stats[slot]
+            st["draft_proposed"] = st.get("draft_proposed", 0) \
+                + (int(n[slot]) - 1 if self.speculative else 0)
+            st["draft_accepted"] = st.get("draft_accepted", 0) \
+                + len(accepted) - 1
+        self.tokens = front
         self.ticks += 1
-        self.tokens_generated += n_active
+        self.tokens_generated += tokens_tick
+        self.draft_proposed += drafted_tick
+        self.draft_accepted += accepted_tick
         if self.telemetry is not None:
             wall = time.perf_counter() - t0
+            # sharing/chunk counters are emitted as PER-TICK DELTAS
+            # (admissions land between ticks, so their hits show up on
+            # the next record): every decode_tick field aggregates the
+            # same way — sum over records — with no cumulative mix-ins
+            snap = {"prefix_hit_blocks": self.cache.prefix_hit_blocks,
+                    "cow_forks": self.cache.cow_forks,
+                    "prefill_chunks": self.prefill_chunks}
+            delta = {key: val - self._tick_counters.get(key, 0)
+                     for key, val in snap.items()}
+            self._tick_counters = snap
             self.telemetry.emit_event({
                 "kind": "decode_tick", "tick": self.ticks,
                 "active_slots": n_active, "wall_ms": round(wall * 1e3, 4),
-                "tokens_per_sec": round(n_active / wall, 2) if wall else None,
+                "tokens": tokens_tick,
+                "tokens_per_sec": round(tokens_tick / wall, 2)
+                if wall else None,
                 "free_blocks": self.cache.free_blocks,
+                "draft_accept_rate": round(accepted_tick / drafted_tick,
+                                           4) if drafted_tick else None,
+                **delta,
             })
         return self.tokens.copy()
 
@@ -310,9 +730,19 @@ class DecodeEngine:
         from ..obs import hloprof
         from ..obs.telemetry import lowered_hlo_flops
         tables, lengths = self.cache.device_tables()
-        lowered = self._tick_fn.lower(
-            self.variables, self.cache.k, self.cache.v, tables, lengths,
-            jnp.asarray(self.tokens), jnp.asarray(self.active))
+        if self.speculative == 0:
+            keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
+            lowered = self._tick_fn.lower(
+                self.variables, self.cache.k, self.cache.v, tables,
+                lengths, jnp.asarray(self.tokens),
+                jnp.asarray(self.active), keys)
+        else:
+            lowered = self._tick_fn.lower(
+                self.variables, self.cache.k, self.cache.v, tables,
+                lengths,
+                jnp.zeros((self.max_slots, self._K1), jnp.int32),
+                jnp.ones((self.max_slots,), jnp.int32),
+                jnp.asarray(self.active))
         compiled = lowered.compile()
         analysis = hloprof.parse_module(compiled.as_text())
         report = attr_lib.build_report(
@@ -323,7 +753,8 @@ class DecodeEngine:
             meta={"program": "decode_tick", "max_slots": self.max_slots,
                   "context_width": self._W,
                   "block_size": self.cache.block_size,
-                  "attention": self.attention})
+                  "attention": self.attention,
+                  "speculative": self.speculative})
         if emit and self.telemetry is not None:
             self.telemetry.emit_event(report)
         return report

@@ -118,7 +118,11 @@ class ReplicaWorker:
             extra={"role": "serving-replica",
                    "pending_new_tokens": self.scheduler.pending_new_tokens(),
                    "running": len(self.scheduler.running),
-                   "queued": len(self.scheduler.queue)})
+                   "queued": len(self.scheduler.queue),
+                   # the prefix-locality payoff rides the beat: a
+                   # cross-process router could weigh affinity against
+                   # load on the same evidence it health-checks
+                   "prefix_hit_blocks": self.engine.cache.prefix_hit_blocks})
 
     def reset(self) -> None:
         """Self-fence: evict every slot (blocks back to the pool), drop
@@ -126,7 +130,10 @@ class ReplicaWorker:
         find itself declared dead — its requests live elsewhere now."""
         for slot in list(self.scheduler.running):
             self.engine.evict(slot)
+        for slot in list(self.scheduler.prefilling):
+            self.engine.evict(slot)
         self.scheduler.running.clear()
+        self.scheduler.prefilling.clear()
         self.scheduler.queue.clear()
         self.known.clear()
 
@@ -489,13 +496,15 @@ class ServingFleet:
         for w in self.router.refresh_health(now):
             self._replica_event(
                 "dead", w,
-                orphans=len(w.scheduler.queue) + len(w.scheduler.running))
+                orphans=len(w.scheduler.queue) + len(w.scheduler.running)
+                + len(w.scheduler.prefilling))
         self._reconcile(now)
         for w in self.workers:
             w.tick(now, t)
         self._collect()
         for w in self.workers:
             if (w.state == "draining" and not w.scheduler.running
+                    and not w.scheduler.prefilling
                     and not w.scheduler.queue):
                 w.state = "released"
                 self._replica_event(
@@ -571,11 +580,17 @@ class ServingFleet:
             "stale_completions": self.stale_completions,
             "unplaced": len(self._unplaced),
             "ticks": self.ticks,
+            "prefix_hit_blocks": sum(
+                w.engine.cache.prefix_hit_blocks for w in self.workers),
+            "cow_forks": sum(
+                w.engine.cache.cow_forks for w in self.workers),
             "replicas": {
                 w.replica_id: {
                     "state": w.state, "killed": w.killed,
                     "engine_ticks": w.engine.ticks,
                     "free_blocks": w.engine.cache.free_blocks,
+                    "prefix_hit_blocks":
+                        w.engine.cache.prefix_hit_blocks,
                     "compile_counts": w.engine.compile_counts(),
                 } for w in self.workers},
         }

@@ -26,18 +26,35 @@ Block id 0 is reserved as the **null block**: unallocated table entries and
 masked-off scatter rows all target it, so every scatter is total (no
 dynamic shapes, no OOB) and its contents are unspecified-but-finite —
 reads through it are always masked by the length before use.
+
+**Copy-on-write prefix sharing** (ISSUE 12, PAPERS.md [S1][S4]): blocks
+are REFCOUNTED, and a :class:`PrefixCache` maps content hashes of
+block-aligned prompt prefixes to the physical blocks already holding
+their KV. Admission walks the new prompt's full-block chain through the
+cache; every hit is adopted by reference (incref — zero new HBM, zero
+prefill scatter for those rows), and only the divergent tail allocates
+fresh blocks. A sharer never writes a multiply-owned block: the engine
+FORKS it first (allocate + device-copy the one block + decref the
+original) — the classic COW page-table move, confined to the partial
+boundary block at the divergence point. Eviction decrefs; a block
+returns to the free list exactly once, when its LAST owner lets go, and
+its cache entries are invalidated at that same moment — sharing is
+between concurrently-resident sequences, so churn can never serve stale
+pool bytes.
 """
 
 from __future__ import annotations
 
 import collections
-from typing import List, Optional, Tuple
+import dataclasses
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import jax.numpy as jnp
 
-__all__ = ["BlockAllocator", "PagedKVCache", "gather_pages",
-           "scatter_prefill", "scatter_token", "NULL_BLOCK"]
+__all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "PrefixMatch",
+           "gather_pages", "scatter_prefill", "scatter_token",
+           "scatter_span", "NULL_BLOCK"]
 
 # block 0 never holds live data: it is the scatter target for padding rows
 # and the gather source for unallocated table entries (always masked)
@@ -60,17 +77,23 @@ def gather_pages(pages, table):
     return pages[table].reshape(S, MB * bs, H, hd)
 
 
-def scatter_prefill(pages, kv, table, length):
+def scatter_prefill(pages, kv, table, length, start=0):
     """Write a prefill's per-layer K (or V) rows into the paged pool.
 
     ``kv`` ``[B, W, H, hd]`` holds projections for positions ``0..W-1``
-    (``W`` = the fixed padded prefill width); only rows ``< length`` are
-    live — the rest are routed to the null block. Returns the updated
-    pool. ``table`` ``[B, MB]``, ``length`` ``[B]``."""
+    (``W`` = the fixed padded prefill width); only rows in
+    ``[start, length)`` are live — the rest are routed to the null
+    block. ``start`` (scalar or ``[B]``) masks off a prefix-cache hit:
+    shared rows already live in the donor's blocks and a sharer must
+    never write a multiply-owned page (the COW discipline). Returns the
+    updated pool. ``table`` ``[B, MB]``, ``length`` ``[B]``."""
     B, W = kv.shape[:2]
     bs = pages.shape[1]
+    start = jnp.broadcast_to(jnp.asarray(start, jnp.int32), (B,))
     pos = jnp.arange(W, dtype=jnp.int32)
-    blk = jnp.where(pos[None, :] < length[:, None],
+    live = ((pos[None, :] < length[:, None])
+            & (pos[None, :] >= start[:, None]))
+    blk = jnp.where(live,
                     jnp.take_along_axis(table, pos[None, :] // bs, axis=1),
                     NULL_BLOCK)                                   # [B, W]
     off = jnp.broadcast_to(pos % bs, (B, W))
@@ -93,34 +116,209 @@ def scatter_token(pages, kv, table, position, active):
     return pages.at[blk, off].set(kv)
 
 
+def scatter_span(pages, kv, table, start, n, write_from=None):
+    """Write a span of consecutive tokens' per-layer K (or V) for every
+    slot — the multi-token generalization of :func:`scatter_token` used
+    by the speculative verify tick and chunked prefill.
+
+    ``kv`` ``[S, Q, H, hd]``: token ``j`` of slot ``s`` lands at
+    position ``start[s] + j``; only tokens ``j < n[s]`` are live (the
+    rest — draft padding, chunk tail — route to the null block).
+    ``write_from`` ``[S]`` (optional) additionally masks positions below
+    it — a chunk re-reading a fully shared prefix for its logits must
+    not write the co-owned pages. Returns the updated pool."""
+    S, Q = kv.shape[:2]
+    bs = pages.shape[1]
+    MB = table.shape[1]
+    pos = start[:, None] + jnp.arange(Q, dtype=jnp.int32)[None, :]  # [S, Q]
+    live = jnp.arange(Q, dtype=jnp.int32)[None, :] < n[:, None]
+    if write_from is not None:
+        live = live & (pos >= write_from[:, None])
+    # clip the table index: masked-off rows may point past the table
+    # width; they route to the null block anyway
+    idx = jnp.clip(pos // bs, 0, MB - 1)
+    blk = jnp.where(live, jnp.take_along_axis(table, idx, axis=1),
+                    NULL_BLOCK)                                   # [S, Q]
+    off = pos % bs
+    return pages.at[blk, off].set(kv)
+
+
 # ---------------------------------------------------------------------------
 # host side: allocation / free (between-tick bookkeeping, never traced)
 # ---------------------------------------------------------------------------
 
 class BlockAllocator:
-    """Free-list allocator over pool block ids ``1..num_blocks-1`` (block 0
-    is the reserved null block). FIFO reuse keeps churn deterministic —
-    tests pin that re-admitted sequences land on recycled blocks."""
+    """Refcounted free-list allocator over pool block ids
+    ``1..num_blocks-1`` (block 0 is the reserved null block). FIFO reuse
+    keeps churn deterministic — tests pin that re-admitted sequences
+    land on recycled blocks.
+
+    Refcounts are what make physical prefix sharing safe: ``alloc``
+    hands out blocks at refcount 1, a prefix-cache hit ``incref``\\ s the
+    donor's block instead of allocating, and ``decref`` returns a block
+    to the free list exactly once — when its LAST owner drops it. The
+    legacy ``free`` is a decref loop, so single-owner code paths keep
+    their exact historical behavior."""
 
     def __init__(self, num_blocks: int):
         assert num_blocks >= 2, "need at least one non-null block"
         self.num_blocks = num_blocks
         self._free = collections.deque(range(1, num_blocks))
+        self._rc: Dict[int, int] = {}
+        # cumulative alloc counter: the "fresh blocks" denominator the
+        # sharing tests/bench diff against (adoptions don't bump it)
+        self.total_allocs = 0
 
     @property
     def num_free(self) -> int:
         return len(self._free)
 
+    def ref_count(self, block: int) -> int:
+        """Current owner count (0 for free blocks)."""
+        return self._rc.get(block, 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Pop ``n`` block ids, or None (and no change) if unavailable."""
+        """Pop ``n`` block ids at refcount 1, or None (and no change)
+        if unavailable."""
         if n > len(self._free):
             return None
-        return [self._free.popleft() for _ in range(n)]
+        got = [self._free.popleft() for _ in range(n)]
+        for b in got:
+            self._rc[b] = 1
+        self.total_allocs += len(got)
+        return got
+
+    def incref(self, block: int) -> None:
+        """Adopt an allocated block (a prefix-cache hit: one more owner
+        of the same physical pages)."""
+        assert block != NULL_BLOCK, "cannot adopt the null block"
+        assert self._rc.get(block, 0) > 0, \
+            f"incref of unallocated block {block}"
+        self._rc[block] += 1
+
+    def decref(self, block: int) -> bool:
+        """Drop one ownership; returns True when this was the LAST owner
+        and the block went back on the free list."""
+        assert block != NULL_BLOCK, "cannot free the null block"
+        rc = self._rc.get(block, 0)
+        assert rc > 0, f"decref of free block {block} (double free)"
+        if rc > 1:
+            self._rc[block] = rc - 1
+            return False
+        del self._rc[block]
+        self._free.append(block)
+        return True
 
     def free(self, blocks: List[int]) -> None:
         for b in blocks:
-            assert b != NULL_BLOCK, "cannot free the null block"
-            self._free.append(b)
+            self.decref(b)
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """One admission's prefix-cache verdict: the physical blocks to
+    adopt by reference (in table order) and the token count they cover.
+    ``partial`` flags that the LAST adopted block is the donor's partial
+    boundary block (shared mid-block — the engine must fork it before
+    any write lands there: the copy-on-write point)."""
+    blocks: List[int]
+    length: int
+    partial: bool = False
+
+    @property
+    def hit_blocks(self) -> int:
+        return len(self.blocks)
+
+
+class PrefixCache:
+    """Content-addressed index of resident prompt-prefix KV blocks
+    (the RadixAttention idea [S4] at block granularity).
+
+    Keys are CUMULATIVE hashes: ``h_i = H(h_{i-1}, tokens[i*bs:(i+1)*bs])``
+    — a chain hit guarantees the whole prefix matches, not just one
+    block's tokens, so two prompts can never alias through a colliding
+    interior block. Full blocks map ``h_i -> block``; the boundary
+    partial block of a registered prompt maps ``(h_parent, tail_tokens)
+    -> block`` and is only shared on an EXACT tail match (a duplicate
+    prompt — retry storms, identical few-shot calls), because a sharer
+    reads every row below its own length and will write the rest: any
+    non-exact partial share would fork immediately for zero saved work.
+
+    The cache holds NO references of its own: entries are invalidated
+    the moment their block's last owner decrefs it (``PagedKVCache``
+    wires the hook), so a recycled block can never serve stale bytes
+    and every block still returns to the free list exactly once."""
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self._full: Dict[Tuple, int] = {}
+        self._partial: Dict[Tuple, int] = {}
+        self._by_block: Dict[int, List[Tuple[str, Tuple]]] = {}
+
+    def __len__(self) -> int:
+        return len(self._full) + len(self._partial)
+
+    @staticmethod
+    def _chain(parent, chunk) -> Tuple:
+        return (parent, tuple(int(t) for t in chunk))
+
+    def match(self, tokens: List[int]) -> PrefixMatch:
+        """Longest resident prefix of ``tokens``: full-block chain hits,
+        then an exact-tail partial boundary hit."""
+        bs = self.block_size
+        blocks: List[int] = []
+        parent: Tuple = ()
+        nf = len(tokens) // bs
+        for i in range(nf):
+            key = self._chain(parent, tokens[i * bs:(i + 1) * bs])
+            b = self._full.get(key)
+            if b is None:
+                break
+            blocks.append(b)
+            parent = key
+        matched = len(blocks) * bs
+        rem = tokens[matched:]
+        if len(blocks) == nf and rem:
+            pb = self._partial.get(self._chain(parent, rem))
+            if pb is not None:
+                return PrefixMatch(blocks + [pb], len(tokens),
+                                   partial=True)
+        return PrefixMatch(blocks, matched)
+
+    def register(self, tokens: List[int], blocks: List[int]) -> int:
+        """Publish a freshly-prefilled prompt's blocks (table order).
+        First writer wins — duplicate content keeps the existing entry
+        so concurrent owners converge on ONE physical block chain.
+        Returns how many new entries were added."""
+        bs = self.block_size
+        added = 0
+        parent: Tuple = ()
+        nf = len(tokens) // bs
+        for i in range(nf):
+            key = self._chain(parent, tokens[i * bs:(i + 1) * bs])
+            if key not in self._full:
+                self._full[key] = blocks[i]
+                self._by_block.setdefault(blocks[i], []).append(
+                    ("full", key))
+                added += 1
+            parent = key
+        rem = tokens[nf * bs:]
+        if rem and nf < len(blocks):
+            key = self._chain(parent, rem)
+            if key not in self._partial:
+                self._partial[key] = blocks[nf]
+                self._by_block.setdefault(blocks[nf], []).append(
+                    ("partial", key))
+                added += 1
+        return added
+
+    def invalidate_block(self, block: int) -> None:
+        """Drop every entry resolving to ``block`` (its last owner just
+        freed it — the pool may recycle the pages any time now)."""
+        for kind, key in self._by_block.pop(block, ()):
+            table = self._full if kind == "full" else self._partial
+            if table.get(key) == block:
+                del table[key]
 
 
 class PagedKVCache:
@@ -136,7 +334,8 @@ class PagedKVCache:
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  num_blocks: int, block_size: int, max_slots: int,
-                 max_blocks_per_seq: int, dtype=jnp.float32):
+                 max_blocks_per_seq: int, dtype=jnp.float32,
+                 share_prefix: bool = False):
         self.num_layers = num_layers
         self.num_heads = num_heads
         self.head_dim = head_dim
@@ -152,6 +351,16 @@ class PagedKVCache:
         self.tables = np.zeros((max_slots, max_blocks_per_seq), np.int32)
         self.lengths = np.zeros((max_slots,), np.int32)
         self._owned: List[List[int]] = [[] for _ in range(max_slots)]
+        # COW bookkeeping: which table indices this slot ADOPTED (vs
+        # allocated), and the admission-reserved fork target
+        self._adopted: List[set] = [set() for _ in range(max_slots)]
+        self._fork_reserve: List[Optional[int]] = [None] * max_slots
+        self.share_prefix = share_prefix
+        self.prefix_cache = PrefixCache(block_size) if share_prefix \
+            else None
+        # cumulative sharing counters (telemetry feeds off these)
+        self.prefix_hit_blocks = 0
+        self.cow_forks = 0
 
     # -- derived -----------------------------------------------------------
 
@@ -195,15 +404,117 @@ class PagedKVCache:
         return True
 
     def free_slot(self, slot: int) -> None:
-        """Return ``slot``'s blocks to the pool and clear its table row.
-        The pool data itself is NOT zeroed — stale block contents are
-        finite and always masked by length, so reuse is a table update,
-        not a memory wipe (the paged design's whole point)."""
-        if self._owned[slot]:
-            self.allocator.free(self._owned[slot])
+        """Decref ``slot``'s blocks (a shared block survives while other
+        sequences still reference it; the LAST owner's decref frees it
+        and invalidates its prefix-cache entries) and clear the table
+        row. The pool data itself is NOT zeroed — stale block contents
+        are finite and always masked by length, so reuse is a table
+        update, not a memory wipe (the paged design's whole point)."""
+        for b in self._owned[slot]:
+            self._decref(b)
+        if self._fork_reserve[slot] is not None:
+            self._decref(self._fork_reserve[slot])
+            self._fork_reserve[slot] = None
         self._owned[slot] = []
+        self._adopted[slot] = set()
         self.tables[slot] = NULL_BLOCK
         self.lengths[slot] = 0
+
+    def _decref(self, block: int) -> bool:
+        freed = self.allocator.decref(block)
+        if freed and self.prefix_cache is not None:
+            self.prefix_cache.invalidate_block(block)
+        return freed
+
+    # -- copy-on-write prefix sharing --------------------------------------
+    #
+    # Ownership discipline: the slot that ALLOCATED a block is its
+    # writer and appends in place; a slot that ADOPTED a block via the
+    # prefix cache reads rows below the registered coverage and must
+    # FORK before its first write into it (``cow_targets`` names the
+    # blocks, ``fork_block`` swaps them). Only the partial boundary
+    # block of an exact-duplicate prompt is ever in that position —
+    # adopted FULL blocks cover prompt positions strictly below the
+    # sharer's write range — so admission reserves exactly one fork
+    # block when the match includes a partial boundary.
+
+    def match_prefix(self, tokens: List[int]) -> Optional[PrefixMatch]:
+        """The resident shared prefix of ``tokens`` (None with sharing
+        off, an empty match when nothing resident matches)."""
+        if self.prefix_cache is None:
+            return None
+        return self.prefix_cache.match(tokens)
+
+    def adopt_prefix(self, slot: int, match: PrefixMatch) -> None:
+        """Map ``match``'s physical blocks into ``slot``'s table by
+        reference (incref each) — the admission-side half of sharing.
+        Must run on an empty slot, before ``ensure_capacity`` sizes the
+        fresh-tail allocation. A partial boundary match also reserves
+        the copy-on-write fork target so the first divergent write can
+        never strand on an exhausted pool."""
+        assert not self._owned[slot], "adopt_prefix on a non-empty slot"
+        for i, b in enumerate(match.blocks):
+            self.allocator.incref(b)
+            self.tables[slot, i] = b
+        self._owned[slot] = list(match.blocks)
+        self._adopted[slot] = set(range(len(match.blocks)))
+        self.prefix_hit_blocks += len(match.blocks)
+        if match.partial:
+            got = self.allocator.alloc(1)
+            if got is None:
+                self.free_slot(slot)       # roll back the adoption
+                raise RuntimeError(
+                    f"KV pool exhausted reserving the COW fork block for "
+                    f"slot {slot} — gate admissions on can_admit()")
+            self._fork_reserve[slot] = got[0]
+
+    def register_prefix(self, slot: int, tokens: List[int]) -> int:
+        """Publish ``slot``'s freshly-written prompt blocks to the
+        prefix cache (no-op with sharing off)."""
+        if self.prefix_cache is None:
+            return 0
+        return self.prefix_cache.register(tokens, self._owned[slot])
+
+    def cow_targets(self, slot: int, lo: int, hi: int) -> List[int]:
+        """Table indices of ``slot``'s ADOPTED, still multiply-owned
+        blocks covering write positions ``[lo, hi]`` — the engine forks
+        exactly these before a tick scatters there. An adopted block
+        whose co-owners all evicted promotes to write-in-place (its
+        cache coverage is below every write this slot will ever do)."""
+        bs = self.block_size
+        out = []
+        for i in sorted(self._adopted[slot]):
+            if not lo // bs <= i <= hi // bs:
+                continue
+            if self.allocator.ref_count(self._owned[slot][i]) > 1:
+                out.append(i)
+            else:
+                self._adopted[slot].discard(i)
+        return out
+
+    def fork_block(self, slot: int, index: int) -> Tuple[int, int]:
+        """Copy-on-write fork of ``slot``'s table entry ``index``:
+        point the slot at the admission-reserved fork target (or a
+        fresh allocation) and decref the shared original. Returns
+        ``(src, dst)`` — the CALLER owns the device copy of the pool
+        pages (host tables know nothing about HBM)."""
+        src = self._owned[slot][index]
+        dst = self._fork_reserve[slot]
+        if dst is None:
+            got = self.allocator.alloc(1)
+            if got is None:
+                raise RuntimeError(
+                    f"KV pool exhausted forking shared block {src} for "
+                    f"slot {slot} — admission must reserve fork headroom")
+            dst = got[0]
+        else:
+            self._fork_reserve[slot] = None
+        self._owned[slot][index] = dst
+        self.tables[slot, index] = dst
+        self._adopted[slot].discard(index)
+        self._decref(src)
+        self.cow_forks += 1
+        return src, dst
 
     def device_tables(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """The current (tables, lengths) as device operands for a tick."""

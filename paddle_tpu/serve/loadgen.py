@@ -144,11 +144,37 @@ def make_workload(n_requests: int, vocab: int, *, seed: int = 0,
     return out
 
 
+def _shareable_prefix_tokens(workload: List[GenRequest]) -> int:
+    """Tokens a perfect prefix cache could avoid re-storing: for each
+    request, the longest common prefix with the BEST earlier request in
+    the trace (first occurrences share nothing — someone must pay for
+    the prefix once). Session traces make this essentially
+    ``session_prefix_len`` per repeat visit; the fleet gate sizes its
+    expected prefix-cache hits from exactly this number (ISSUE 12)."""
+    seen: List[List[int]] = []
+    total = 0
+    for g in workload:
+        best = 0
+        for prev in seen:
+            lcp = 0
+            for a, b in zip(prev, g.prompt):
+                if a != b:
+                    break
+                lcp += 1
+            best = max(best, lcp)
+        total += best
+        seen.append(g.prompt)
+    return total
+
+
 def workload_stats(workload: List[GenRequest]) -> dict:
-    """Shape summary of a generated workload (for bench records)."""
+    """Shape summary of a generated workload (for bench records and
+    fleet-gate sizing)."""
     if not workload:
         return {"n": 0}
     gaps = np.diff([g.at_s for g in workload]) if len(workload) > 1 else [0]
+    prompt_tokens = sum(len(g.prompt) for g in workload)
+    shareable = _shareable_prefix_tokens(workload)
     return {
         "n": len(workload),
         "span_s": round(workload[-1].at_s - workload[0].at_s, 4),
@@ -162,4 +188,8 @@ def workload_stats(workload: List[GenRequest]) -> dict:
         "with_session": sum(g.session_id is not None for g in workload),
         "sessions": len({g.session_id for g in workload
                          if g.session_id is not None}),
+        "prompt_tokens_total": prompt_tokens,
+        "shareable_prefix_tokens": shareable,
+        "shareable_prefix_ratio": round(shareable / prompt_tokens, 4)
+        if prompt_tokens else 0.0,
     }

@@ -77,6 +77,15 @@ class Request:
     first_token_ts: Optional[float] = None
     finish_ts: Optional[float] = None
     finish_reason: Optional[str] = None
+    # ISSUE 12 telemetry: prefix-sharing / COW / speculation / chunked-
+    # prefill attribution, copied from the engine's per-slot stats at
+    # finish (None for requests that never took a slot)
+    prefix_hit_blocks: Optional[int] = None
+    blocks_reserved: Optional[int] = None
+    cow_forks: Optional[int] = None
+    prefill_chunks: Optional[int] = None
+    draft_proposed: Optional[int] = None
+    draft_accepted: Optional[int] = None
 
     @property
     def done(self) -> bool:
@@ -115,6 +124,18 @@ class Request:
             # at ts exactly 0.0 and must still record its wall time
             "wall_ms": round((self.finish_ts - self.submit_ts) * 1e3, 4)
             if self.finish_ts is not None else None,
+            "prefix_hit_blocks": self.prefix_hit_blocks,
+            "blocks_reserved": self.blocks_reserved,
+            "cow_forks": self.cow_forks,
+            "prefill_chunks": self.prefill_chunks,
+            # raw counts ride along so aggregates can weight by volume
+            # (a 2-draft request must not average equally with a
+            # 500-draft one)
+            "draft_proposed": self.draft_proposed,
+            "draft_accepted": self.draft_accepted,
+            "draft_accept_rate": round(
+                self.draft_accepted / self.draft_proposed, 4)
+            if self.draft_proposed else None,
         }
 
 
@@ -157,6 +178,9 @@ class ContinuousBatchingScheduler:
         self._clock = clock
         self.queue: List[Request] = []
         self.running: Dict[int, Request] = {}       # slot -> request
+        # chunked prefill in flight: slot -> request (the slot is
+        # reserved; one chunk advances per step, between decode ticks)
+        self.prefilling: Dict[int, Request] = {}
         self.completed: List[Request] = []
         # the last refusal's structured reason ("blocks"|"width"), for
         # router placement/shedding — None while admission is flowing
@@ -175,7 +199,8 @@ class ContinuousBatchingScheduler:
         this is a tick-denominated backlog)."""
         run = sum(r.max_new_tokens - len(r.tokens)
                   for r in self.running.values())
-        return run + sum(r.max_new_tokens for r in self.queue)
+        return (run + sum(r.max_new_tokens for r in self.queue)
+                + sum(r.max_new_tokens for r in self.prefilling.values()))
 
     def predicted_completion_s(self, max_new_tokens: int
                                ) -> Optional[float]:
@@ -238,13 +263,25 @@ class ContinuousBatchingScheduler:
     # -- the tick loop -----------------------------------------------------
 
     def _finish(self, req: Request, reason: str) -> None:
-        """Common completion path: stamp reason + timestamp, free the
-        slot's blocks (when running), record telemetry."""
+        """Common completion path: stamp reason + timestamp, copy the
+        engine's per-slot sharing/speculation stats into the request,
+        free the slot's blocks (when running or mid-prefill), record
+        telemetry."""
         req.finish_ts = self._clock()
         req.finish_reason = reason
-        if req.slot is not None and self.running.get(req.slot) is req:
-            del self.running[req.slot]
-            self.engine.evict(req.slot)        # blocks back to the pool
+        slot = req.slot
+        if slot is not None and (self.running.get(slot) is req
+                                 or self.prefilling.get(slot) is req):
+            st = self.engine.slot_stats[slot]
+            req.prefix_hit_blocks = st.get("prefix_hit_blocks")
+            req.blocks_reserved = st.get("blocks_reserved")
+            req.cow_forks = st.get("cow_forks")
+            req.prefill_chunks = st.get("prefill_chunks")
+            req.draft_proposed = st.get("draft_proposed")
+            req.draft_accepted = st.get("draft_accepted")
+            self.running.pop(slot, None)
+            self.prefilling.pop(slot, None)
+            self.engine.evict(slot)            # blocks back to the pool
         self.completed.append(req)
         if self.telemetry is not None:
             self.telemetry.emit_event(req.record())
@@ -280,6 +317,11 @@ class ContinuousBatchingScheduler:
                 self._emit_evict(req, "running",
                                  self.engine.cache.owned_count(slot))
                 self._finish(req, "timeout")
+        for slot, req in list(self.prefilling.items()):
+            if expired(req):
+                self._emit_evict(req, "prefilling",
+                                 self.engine.cache.owned_count(slot))
+                self._finish(req, "timeout")
         for req in [r for r in self.queue if expired(r)]:
             self.queue.remove(req)
             self._emit_evict(req, "queued", 0)
@@ -300,7 +342,7 @@ class ContinuousBatchingScheduler:
 
     def _admit(self) -> None:
         self.last_backpressure = None    # cleared even on the gang wait
-        if self.policy == "static" and self.running:
+        if self.policy == "static" and (self.running or self.prefilling):
             return                       # gang: wait for the whole batch
         free = self.engine.free_slots()
         for req in self._admit_order():
@@ -319,13 +361,29 @@ class ContinuousBatchingScheduler:
                 break
             self.queue.remove(req)
             slot = free.pop(0)
-            tok = self.engine.admit(slot, req.prompt, reserve_len=target,
-                                    staged=getattr(req, "_staged", None))
+            self.engine.begin_prefill(slot, req.prompt,
+                                      reserve_len=target,
+                                      staged=getattr(req, "_staged",
+                                                     None))
             req.slot = slot
-            req.tokens.append(tok)
-            req.first_token_ts = self._clock()
-            self.running[slot] = req
-            self._maybe_finish(slot, tok)
+            self.prefilling[slot] = req
+            # one prefill call now: the whole prompt on a legacy
+            # engine (admission behavior unchanged), the first chunk
+            # on a chunked one — the rest interleave with decode ticks
+            self._advance_prefill(slot)
+
+    def _advance_prefill(self, slot: int) -> None:
+        """One compiled prefill call for a reserved slot; promotes the
+        request to running when its first token lands."""
+        req = self.prefilling[slot]
+        tok = self.engine.prefill_step(slot)
+        if tok is None:
+            return
+        del self.prefilling[slot]
+        req.tokens.append(tok)
+        req.first_token_ts = self._clock()
+        self.running[slot] = req
+        self._maybe_finish(slot, tok)
 
     def _maybe_finish(self, slot: int, tok: int) -> None:
         req = self.running[slot]
@@ -351,14 +409,29 @@ class ContinuousBatchingScheduler:
                                    else 0.7 * self.est_tick_s + 0.3 * dt)
         self._last_step_ts = now
         self._expire()
+        # chunked prefill: ONE chunk per already-prefilling slot per
+        # step, BETWEEN decode ticks — a 4k-token admit becomes many
+        # cheap calls instead of one monolithic stall of every running
+        # slot (fresh admissions below run their first chunk inside
+        # _admit)
+        for slot in list(self.prefilling):
+            self._advance_prefill(slot)
         self._admit()
         if self.running:
-            front = self.engine.decode_tick()
+            self.engine.decode_tick()
+            # the tick may retire several tokens per slot (speculative
+            # accepts); feed them through the same finish rules one at
+            # a time so eos/length semantics match the sequential
+            # engine exactly
+            accepted = self.engine.last_accepted
             for slot, req in list(self.running.items()):
-                tok = int(front[slot])
-                req.tokens.append(tok)
-                self._maybe_finish(slot, tok)
-        self._was_busy = bool(self.queue or self.running)
+                for tok in accepted.get(slot, ()):
+                    req.tokens.append(tok)
+                    self._maybe_finish(slot, tok)
+                    if req.done:
+                        break
+        self._was_busy = bool(self.queue or self.running
+                              or self.prefilling)
         return self._was_busy
 
     def run(self, max_ticks: int = 100000) -> List[Request]:

@@ -102,6 +102,26 @@ def test_bench_smoke_cpu_green_and_equal():
             > srv["static"]["tokens_per_sec"])
     assert srv["continuous"]["ticks"] < srv["static"]["ticks"]
     assert srv["decode_bound"] == "memory"
+    # ISSUE 12: the serving-throughput legs — a shared-prefix workload
+    # admits with FEWER fresh block allocations than sharing-off
+    # (bit-identical tokens, zero leaks after full churn), speculative
+    # greedy decode is token-identical with strictly fewer ticks and
+    # the compile counts stay pinned, and chunked prefill interleaves a
+    # long admission with running slots' decode ticks instead of
+    # stalling them
+    ps = srv["prefix_sharing"]
+    assert ps["ok"] is True and ps["tokens_identical"] is True
+    assert ps["fresh_allocs_shared"] < ps["fresh_allocs_unshared"]
+    assert ps["leak_free"] is True and ps["prefix_hit_blocks"] >= 1
+    sp = srv["speculative"]
+    assert sp["ok"] is True and sp["tokens_identical"] is True
+    assert sp["ticks_speculative"] < sp["ticks_baseline"]
+    assert sp["compile_counts"] == {"prefill": 1, "tick": 1}
+    ck = srv["chunked_prefill"]
+    assert ck["ok"] is True and ck["tokens_identical"] is True
+    assert (ck["interleaved_tokens_chunked"]
+            > ck["interleaved_tokens_monolithic"])
+    assert ck["compile_counts"] == {"prefill": 1, "tick": 1}
     # ISSUE 10: the fault-tolerance gate ran — the supervisor resumed an
     # injected crash, a corrupted latest pass was quarantined (renamed
     # .corrupt, never deleted) with fallback to the previous readable
@@ -246,6 +266,29 @@ def test_bench_serving_child_builds(capsys):
     assert out["decode_tokens_per_sec"] > 0
     assert out["compile_counts"] == {"prefill": 1, "tick": 1}
     assert out["context_width"] == 64
+
+
+def test_bench_serving_spec_child_builds(capsys):
+    """ISSUE 12: the transformer_decode_spec metric child runs at a tiny
+    config — the speculative engine retires MORE tokens than ticks
+    (accepted drafts), matches the plain engine's program pins, and
+    reports a finite accept rate on the draft-friendly periodic
+    workload."""
+    sys.path.insert(0, REPO)
+    import bench
+    bench.run_serving_spec_bench_child(
+        max_slots=2, block_size=4, seq_len=64, dim=32, layers=2, heads=4,
+        vocab=64, prompt_len=8, speculative=3, warmup_ticks=2,
+        timed_ticks=6)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["child"] == "transformer_decode_spec"
+    assert out["decode_spec_tokens_per_sec"] > 0
+    assert out["spec"]["compile_counts"] == {"prefill": 1, "tick": 1}
+    assert out["base"]["compile_counts"] == {"prefill": 1, "tick": 1}
+    # periodic prompts: drafts hit, so a tick retires > 1 token/slot
+    assert out["spec"]["tokens"] > out["base"]["tokens"]
+    assert out["draft_accept_rate"] is not None
+    assert 0 < out["draft_accept_rate"] <= 1
 
 
 def test_bench_prep_transformer_dp_overlap_builds():

@@ -141,6 +141,54 @@ def test_loadgen_deterministic_shapes_and_sessions():
     assert all(len(g.prompt) >= 1 for g in zero_lo)
 
 
+def test_workload_stats_shareable_prefix_ratio():
+    """ISSUE 12 satellite: workload_stats reports the shareable-prefix
+    ratio of a trace — the number the fleet gate sizes its expected
+    prefix-cache hits from. Session traces share their prefix on every
+    repeat visit; random traces share ~nothing."""
+    kw = dict(seed=5, prompt_len=(9, 12), max_new=(2, 4), max_total=W)
+    sess = make_workload(30, V, n_sessions=3, session_prefix_len=6,
+                         p_session=1.0, **kw)
+    st = workload_stats(sess)
+    assert st["prompt_tokens_total"] > 0
+    # 27 repeat visits x 6-token prefix, minimum (same-session repeats)
+    assert st["shareable_prefix_tokens"] >= 20
+    assert 0 < st["shareable_prefix_ratio"] <= 1
+    rand = make_workload(30, V, n_sessions=0, **kw)
+    st2 = workload_stats(rand)
+    assert st2["shareable_prefix_ratio"] < st["shareable_prefix_ratio"]
+    assert workload_stats([]) == {"n": 0}
+
+
+def test_affinity_routes_sessions_onto_warm_prefix_caches(model_and_vars):
+    """ISSUE 12: router session affinity now has a MEASURED payoff — a
+    session trace played with affinity on lands repeat visits on the
+    replica already holding the session's prefix blocks, so fleet-wide
+    prefix-cache hits exceed the affinity-off (pure least-loaded)
+    placement, with identical terminal outcomes."""
+    model, vs = model_and_vars
+
+    def run(affinity):
+        fleet = _fleet(model, vs, 2, affinity=affinity, shed=False,
+                       max_slots=4)
+        wl = make_workload(14, V, seed=2, rate_rps=40.0,
+                           n_sessions=2, session_prefix_len=2 * BS,
+                           p_session=1.0, prompt_len=(9, 11),
+                           max_new=(6, 9), sigma=0.3, max_total=W)
+        frs = fleet.play(wl, dt_s=DT)
+        assert all(fr.done for fr in frs)
+        return fleet.stats()
+
+    on, off = run(True), run(False)
+    assert on["prefix_hit_blocks"] > off["prefix_hit_blocks"]
+    # the payoff also rides each replica's heartbeat payload
+    fleet = _fleet(model, vs, 1, shed=False, max_slots=4)
+    from paddle_tpu.parallel import multihost
+    fleet.workers[0].beat(fleet.clock())
+    beats = multihost.read_heartbeats(fleet.root)
+    assert "prefix_hit_blocks" in beats[0]
+
+
 # ---------------------------------------------------------------------------
 # engine: structured admission probe (ISSUE 11 satellite)
 # ---------------------------------------------------------------------------
