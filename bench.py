@@ -1536,7 +1536,7 @@ def run_faults_child():
 # ---------------------------------------------------------------------------
 
 def run_fleet_child():
-    """The serving fleet's CI gate, two legs on a SimClock —
+    """The serving fleet's CI gate, three legs on a SimClock —
 
     - **fault drill**: a seeded bursty loadgen trace (sessions with
       shared prefixes, ragged lengths, deadlines) over 3 replicas; a
@@ -1550,13 +1550,24 @@ def run_fleet_child():
       of 4 short deadline-carrying jobs, one engine, fixed 1s ticks)
       under order="fcfs" vs order="sjf" — SJF's goodput-under-deadline
       must beat FCFS's, reported through the new percentile metrics.
+    - **process-isolation drill** (ISSUE 13): two replicas as REAL
+      child processes behind the submit/complete transport; the
+      schedule hangs one transport reply (per-message timeout +
+      retransmit recovers the cached reply), garbles another
+      (classified corrupt, recovered), then SIGKILLs replica 0
+      mid-decode — the router never crashes, death is observed via
+      heartbeat staleness, every request stays terminal with one
+      terminal record per rid and oracle-identical tokens, the live
+      survivors are leak- and retrace-free (evidence from each child's
+      own stats probe), and the autoscaler cold-spawns a replacement
+      within its restart budget.
 
     Prints the verdict as one JSON line."""
     import collections
     import tempfile
     from paddle_tpu.models import TransformerLM
     from paddle_tpu.obs import InMemorySink, Telemetry, summarize_requests
-    from paddle_tpu.serve import (ContinuousBatchingScheduler,
+    from paddle_tpu.serve import (Autoscaler, ContinuousBatchingScheduler,
                                   DecodeEngine, ServingFleet, SimClock)
     from paddle_tpu.serve.loadgen import make_workload, workload_stats
     from paddle_tpu.train import FaultSchedule
@@ -1624,9 +1635,109 @@ def run_fleet_child():
                 and sjf["goodput_pct"] is not None
                 and sjf["goodput_pct"] > fcfs["goodput_pct"])
 
+    # -- leg 3: process-isolated replicas + supervised autoscaler
+    # (ISSUE 13). Transport faults first (hang -> timeout+retransmit,
+    # corrupt -> classified+retransmit), then SIGKILL replica 0
+    # mid-decode; min_replicas=2 makes the autoscaler cold-spawn a
+    # replacement child when the death is observed.
+    oracle_fwd = jax.jit(lambda v, i: model.apply(v, i))
+
+    def greedy_oracle(prompt, n_new):
+        seq, out = list(prompt), []
+        for _ in range(n_new):
+            pad = np.zeros((1, W), np.int32)
+            pad[0, :len(seq)] = seq
+            logits = oracle_fwd(vs, jnp.asarray(pad))
+            tok = int(np.argmax(np.asarray(logits[0, len(seq) - 1])))
+            out.append(tok)
+            seq.append(tok)
+        return out
+
+    mem3 = InMemorySink()
+    clock3 = SimClock()
+    faults3 = FaultSchedule(sigkill_replica_at_tick=(6, 0),
+                            transport_hang_at=(3, 1),
+                            corrupt_reply_at=(4, 1))
+    scaler = Autoscaler(min_replicas=2, max_replicas=3, up_delay_s=60.0,
+                        idle_grace_ticks=1000, cooldown_ticks=5,
+                        max_replacements=1)
+    fleet3 = ServingFleet.from_model(
+        model, vs, 2, engine_kwargs=dict(max_slots=2, block_size=4),
+        replica_mode="process", telemetry=Telemetry(sinks=[mem3]),
+        clock=clock3, heartbeat_timeout_s=0.25, est_tick_s=0.1,
+        # generous per-message budget: a child's FIRST tick includes
+        # its jit compiles, and a slow CI host must not turn that into
+        # a false transport_down (only the injected hang pays it)
+        faults=faults3, transport_timeout_s=5.0, autoscaler=scaler,
+        root=tempfile.mkdtemp(prefix="paddle_tpu_fleet_proc_"))
+    wl3 = make_workload(8, V, seed=7, rate_rps=30.0, prompt_len=(2, 6),
+                        max_new=(3, 8), max_total=W)
+    try:
+        frs3 = fleet3.play(wl3, dt_s=0.1)
+        stats3 = fleet3.stats()
+        term3 = collections.Counter(
+            r["rid"] for r in mem3.by_kind("request")
+            if r["finish_reason"] != "retried")
+        proc_all_terminal = all(fr.record is not None for fr in frs3)
+        proc_lineage = (set(term3) == {fr.rid for fr in frs3}
+                        and all(v == 1 for v in term3.values()))
+        retried3 = [fr for fr in frs3 if fr.retries > 0]
+        # re-homed requests regenerate the oracle's exact tokens —
+        # process isolation is semantically invisible
+        oracle_ok = all(
+            fr.tokens == greedy_oracle(fr.prompt, fr.max_new_tokens)
+            for fr in (retried3[:2] or frs3[:2]))
+        probes = {w.replica_id: w.stats_probe(clock3())
+                  for w in fleet3.workers
+                  if w.state == "live" and not w.killed}
+        proc_no_leak = bool(probes) and all(
+            p is not None and p["free_blocks"] == p["num_blocks"] - 1
+            for p in probes.values())
+        proc_no_retrace = all(
+            p["compile_counts"] == {"prefill": 1, "tick": 1}
+            for p in probes.values()
+            if p is not None and p["ticks"] > 0)
+        transports = {w.replica_id: w.transport_stats()
+                      for w in fleet3.workers
+                      if w.transport_stats() is not None}
+        hang_recovered = any(t["timeouts"] >= 1 and t["retransmits"] >= 1
+                             for t in transports.values())
+        corrupt_classified = any(t["corrupt_replies"] >= 1
+                                 for t in transports.values())
+        replaced = any(e["action"] == "replace" for e in scaler.events)
+        proc = {
+            "ok": bool(proc_all_terminal and proc_lineage and oracle_ok
+                       and proc_no_leak and proc_no_retrace
+                       and hang_recovered and corrupt_classified
+                       and replaced
+                       and stats3["stale_completions"] == 0
+                       and stats3["resubmits"] >= 1
+                       and scaler.replacements <= 1),
+            "all_terminal": bool(proc_all_terminal),
+            "lineage_ok": bool(proc_lineage),
+            "oracle_tokens_ok": bool(oracle_ok),
+            "no_leak_on_survivors": bool(proc_no_leak),
+            "zero_retraces_on_survivors": bool(proc_no_retrace),
+            "transport_hang_recovered": bool(hang_recovered),
+            "corrupt_reply_classified": bool(corrupt_classified),
+            "replacement_spawned": bool(replaced),
+            "replacements_within_budget": scaler.replacements,
+            "retried_requests": len(retried3),
+            "transports": transports,
+            "scale_events": [{k: e[k] for k in
+                              ("action", "reason", "tick",
+                               "replicas_before", "replicas_after")}
+                             for e in scaler.events],
+            "stats": stats3,
+            "faults_fired": [p for p, _ in faults3.fired],
+        }
+    finally:
+        fleet3.shutdown()
+
     ok = (all_terminal and lineage_ok and no_leak and no_retrace
           and p99_finite and shed_bounded and stats["resubmits"] >= 1
-          and stats["stale_completions"] == 0 and sjf_wins)
+          and stats["stale_completions"] == 0 and sjf_wins
+          and proc["ok"])
     print(json.dumps({
         "child": "fleet", "ok": bool(ok),
         "workload": workload_stats(wl),
@@ -1641,6 +1752,7 @@ def run_fleet_child():
         "goodput_sjf_pct": sjf["goodput_pct"],
         "stats": stats, "requests": summary,
         "faults_fired": [p for p, _ in faults.fired],
+        "process": proc,
         "device": jax.devices()[0].device_kind,
     }))
     return 0 if ok else 1

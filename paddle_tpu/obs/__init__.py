@@ -53,7 +53,8 @@ from .hloprof import (DCN_BYTES_PER_S, HBM_BANDWIDTH, ICI_BANDWIDTH,
                       collective_inventory, parse_collectives, parse_module)
 from .health import (HEALTH_KEYS, health_scalars, tree_l2_norm,
                      tree_nonfinite_count)
-from .percentiles import GOODPUT_REASONS, percentile, summarize_requests
+from .percentiles import (GOODPUT_REASONS, percentile,
+                          summarize_requests, summarize_scale)
 from .sinks import InMemorySink, JsonlSink, LoggingSink, Sink
 from .telemetry import (PEAK_FLOPS, Telemetry, device_memory_stats,
                         device_peak_flops, lowered_hlo_flops)
@@ -70,5 +71,6 @@ __all__ = [
     "parse_module", "collective_inventory", "parse_collectives",
     "build_report", "format_report", "parse_profile_trace",
     "ICI_BANDWIDTH", "DCN_BYTES_PER_S", "HBM_BANDWIDTH",
-    "percentile", "summarize_requests", "GOODPUT_REASONS",
+    "percentile", "summarize_requests", "summarize_scale",
+    "GOODPUT_REASONS",
 ]

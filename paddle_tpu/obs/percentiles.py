@@ -26,7 +26,8 @@ import collections
 import math
 from typing import Any, Dict, Iterable, List, Optional
 
-__all__ = ["percentile", "summarize_requests", "GOODPUT_REASONS"]
+__all__ = ["percentile", "summarize_requests", "summarize_scale",
+           "GOODPUT_REASONS"]
 
 # finish reasons that count as useful completed work
 GOODPUT_REASONS = ("length", "eos")
@@ -102,3 +103,27 @@ def summarize_requests(records: List[Dict[str, Any]]
     out["shed"] = out["finish_reasons"].get("shed", 0)
     out["timeout"] = out["finish_reasons"].get("timeout", 0)
     return out
+
+
+def summarize_scale(records: List[Dict[str, Any]]
+                    ) -> Optional[Dict[str, Any]]:
+    """Aggregate the autoscaler's ``kind="scale"`` events (ISSUE 13
+    satellite): how often capacity moved, which way, why, and where it
+    ended up — so an elastic run is judgeable as numbers next to its
+    latency percentiles. None when the stream has no scale events
+    (fixed-capacity fleets don't grow the block)."""
+    evs = [r for r in records if r.get("kind") == "scale"]
+    if not evs:
+        return None
+    actions = collections.Counter(r.get("action") or "?" for r in evs)
+    return {
+        "events": len(evs),
+        "up": actions.get("up", 0),
+        "down": actions.get("down", 0),
+        "replace": actions.get("replace", 0),
+        "reasons": dict(collections.Counter(
+            r.get("reason") or "?" for r in evs)),
+        "final_replicas": evs[-1].get("replicas_after"),
+        "max_replicas_seen": max((r.get("replicas_after") or 0)
+                                 for r in evs),
+    }

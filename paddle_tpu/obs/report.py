@@ -28,7 +28,7 @@ import os
 import sys
 from typing import Any, Dict, List, Optional
 
-from .percentiles import summarize_requests
+from .percentiles import summarize_requests, summarize_scale
 
 __all__ = ["load_records", "summarize", "format_summary", "main"]
 
@@ -136,6 +136,11 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     serving = summarize_requests(records)
     if serving is not None:
         out["serving"] = serving
+    # autoscaler decisions (ISSUE 13): kind="scale" events aggregate
+    # into the serving block (up/down/replace counts, final capacity)
+    scale = summarize_scale(records)
+    if scale is not None:
+        out.setdefault("serving", {})["scale"] = scale
     return out
 
 
@@ -191,7 +196,7 @@ def format_summary(s: Dict[str, Any]) -> str:
                 continue
         lines.append(f"  {label:<28}{val}")
     sv = s.get("serving")
-    if sv:
+    if sv and sv.get("requests") is not None:
         lines.append("serving requests")
         lines.append(f"  {'requests (terminal / retried)':<28}"
                      f"{sv.get('requests')} / "
@@ -227,6 +232,20 @@ def format_summary(s: Dict[str, Any]) -> str:
         if sv.get("prefill_chunks"):
             lines.append(f"  {'prefill chunks':<28}"
                          f"{sv['prefill_chunks']}")
+    # autoscaler decisions (ISSUE 13) — rendered whenever scale events
+    # exist, even for a stream with no request records
+    sc = (sv or {}).get("scale")
+    if sc:
+        lines.append("autoscaler")
+        lines.append(f"  {'scale events (up/down/repl)':<28}"
+                     f"{sc['events']} ({sc['up']}/{sc['down']}/"
+                     f"{sc['replace']})")
+        lines.append(f"  {'final replicas':<28}{sc['final_replicas']}")
+        reasons = sc.get("reasons") or {}
+        if reasons:
+            lines.append(f"  {'scale reasons':<28}"
+                         + ", ".join(f"{k}={v}" for k, v in
+                                     sorted(reasons.items())))
     return "\n".join(lines)
 
 

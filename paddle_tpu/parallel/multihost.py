@@ -44,7 +44,8 @@ from ..data import reader as reader_lib
 
 __all__ = ["initialize", "is_initialized", "host_sharded_reader",
            "multihost_mesh", "HostHeartbeat", "heartbeat_path",
-           "write_heartbeat", "read_heartbeats", "detect_dead_hosts",
+           "write_heartbeat", "read_heartbeats", "retire_heartbeat",
+           "detect_dead_hosts",
            "ReformPlan", "plan_reform", "reform"]
 
 _log = logging.getLogger("paddle_tpu.multihost")
@@ -139,6 +140,31 @@ def write_heartbeat(root: str, host_id: Optional[int] = None,
         json.dump(payload, f)
     os.replace(tmp, path)
     return path
+
+
+def retire_heartbeat(root: str, host_id: int) -> Optional[str]:
+    """Retire a released/dead host's heartbeat file (ISSUE 13 satellite):
+    RENAME it aside (``host-NNNNN.json.retired``, a fresh numbered
+    suffix when one already exists — the PR-10 quarantine rule, never
+    delete), so :func:`detect_dead_hosts` and any watchdog scanning the
+    root stop re-reporting a ghost that left the fleet on purpose.
+    Returns the retired path (None when there was no beat to retire).
+    The bytes stay on disk for forensics; ``read_heartbeats`` skips the
+    suffix by construction (it only reads ``host-*.json``)."""
+    path = heartbeat_path(root, host_id)
+    if not os.path.exists(path):
+        return None
+    dest = f"{path}.retired"
+    n = 0
+    while os.path.exists(dest):
+        n += 1
+        dest = f"{path}.retired.{n}"
+    try:
+        os.replace(path, dest)
+    except OSError:
+        _log.exception("failed to retire heartbeat %s", path)
+        return None
+    return dest
 
 
 def read_heartbeats(root: str) -> Dict[int, Dict]:

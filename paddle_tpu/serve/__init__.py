@@ -31,6 +31,18 @@ doctrine):
 - :mod:`.loadgen` — seeded traffic shapes (Poisson/bursty arrivals,
   ragged lengths, shareable-prefix sessions, deadlines/priorities) and
   the :class:`SimClock` that makes fleet fault drills deterministic.
+- :mod:`.transport` / :mod:`.replica_proc` (ISSUE 13) — the
+  length-prefixed submit/complete IPC frames (per-message timeout,
+  seq-numbered at-least-once delivery, classified corruption) and the
+  child-process replica entrypoint behind
+  ``ServingFleet(replica_mode="process")``: a SIGKILL, hang, or corrupt
+  reply is contained in one process, observed via heartbeat staleness,
+  and healed by the same reconcile path.
+- :mod:`.autoscaler` — the supervised elastic-capacity policy loop on
+  top of ``drain()`` and ``spawn_replica()``: scale up on
+  predicted-delay breach, down on sustained idle, hysteresis against
+  flapping, cold-spawn replacement of dead replicas under a loud
+  restart budget.
 """
 
 from .kv_cache import (BlockAllocator, PagedKVCache, PrefixCache,
@@ -39,13 +51,22 @@ from .kv_cache import (BlockAllocator, PagedKVCache, PrefixCache,
 from .engine import AdmitProbe, DecodeEngine, SamplingConfig
 from .scheduler import ContinuousBatchingScheduler, Request
 from .router import FleetRouter, RouteDecision
-from .fleet import FleetRequest, ReplicaWorker, ServingFleet
+from .fleet import (FleetRequest, ProcReplicaWorker, ReplicaWorker,
+                    ServingFleet, build_proc_spec)
 from .loadgen import GenRequest, SimClock, make_workload, workload_stats
+from .autoscaler import Autoscaler, AutoscalerGaveUp
+from .transport import (ReplicaTransport, TransportClosed,
+                        TransportCorrupt, TransportError,
+                        TransportTimeout)
 
 __all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "PrefixMatch",
            "DecodeEngine", "AdmitProbe", "SamplingConfig",
            "ContinuousBatchingScheduler", "Request", "gather_pages",
            "scatter_prefill", "scatter_token", "scatter_span",
            "FleetRouter", "RouteDecision", "ServingFleet",
-           "ReplicaWorker", "FleetRequest",
+           "ReplicaWorker", "ProcReplicaWorker", "FleetRequest",
+           "build_proc_spec",
+           "Autoscaler", "AutoscalerGaveUp",
+           "ReplicaTransport", "TransportError", "TransportTimeout",
+           "TransportCorrupt", "TransportClosed",
            "GenRequest", "SimClock", "make_workload", "workload_stats"]

@@ -1,0 +1,234 @@
+"""Supervised autoscaler for the serving fleet (ISSUE 13): elastic
+capacity as a POLICY LOOP over evidence the fleet already publishes.
+
+The mechanics existed before this module: ``drain()`` is lossless
+scale-down (PR 11), :meth:`~paddle_tpu.serve.fleet.ServingFleet.
+spawn_replica` is scale-up, and every replica's load rides its PR-10
+heartbeat file. The autoscaler adds only the decisions, and the
+discipline that keeps decisions from flapping:
+
+- **Sense from the files.** Load is read from the heartbeat payloads
+  (``pending_new_tokens`` per live replica, the child-reported tick-time
+  EMA) — the same evidence a watchdog on another host would have, not a
+  private pointer into a scheduler. The predicted queue delay is the
+  PR-11 shed model fleet-wide: ``backlog / (live · max_slots)`` ticks at
+  the observed tick time.
+- **Scale up on predicted-delay breach** (``up_delay_s``): capacity is
+  added when the backlog's predicted delay says requests queued NOW will
+  wait too long — before deadlines start shedding, not after.
+- **Scale down on sustained idle** (``idle_grace_ticks`` consecutive
+  ticks with zero backlog AND zero in-flight requests): one idle instant
+  is a gap between bursts; only a sustained lull pays back a replica.
+  Scale-down always routes through ``drain()`` — zero lost requests, by
+  the PR-11 contract.
+- **Hysteresis** (``cooldown_ticks``): after ANY up/down decision the
+  policy holds still, so bursty traffic that would flap a naive
+  threshold policy produces a BOUNDED number of scale events (the CI
+  test pins this). The grace counter resets on any load.
+- **Cold-spawn replacement under a restart budget**: a replica the
+  router declared dead is replaced (``action="replace"``) outside the
+  up/down cooldown — healing is not scaling — but under
+  ``max_replacements``; when the budget is exhausted the autoscaler
+  GIVES UP LOUD (:class:`AutoscalerGaveUp` with the full event ledger,
+  the PR-10 supervisor rule: a fleet whose replicas keep dying has a
+  bug, and respawning forever would hide it).
+
+Every decision emits a ``kind="scale"`` telemetry event (action,
+reason, replica counts before/after, the evidence) — aggregated by
+``obs.report``'s serving block via
+:func:`~paddle_tpu.obs.percentiles.summarize_scale`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..parallel import multihost
+
+__all__ = ["Autoscaler", "AutoscalerGaveUp"]
+
+_log = logging.getLogger("paddle_tpu.serve.autoscaler")
+
+
+class AutoscalerGaveUp(RuntimeError):
+    """The replacement budget is exhausted: replicas keep dying faster
+    than the policy may heal. Carries the event ledger so the operator
+    sees every decision that led here."""
+
+    def __init__(self, msg: str, events: List[Dict[str, Any]]):
+        super().__init__(msg)
+        self.events = list(events)
+
+
+class Autoscaler:
+    """The policy loop (see module docstring). Construct with policy
+    knobs, pass to ``ServingFleet(autoscaler=...)`` (or call
+    :meth:`bind` yourself); :meth:`step` runs inside every fleet tick.
+
+    Args:
+      min_replicas / max_replicas: the live-capacity envelope. Scale
+        down never goes below ``min_replicas`` (and ``drain()`` itself
+        refuses below 1); scale up and replacement never exceed
+        ``max_replicas``.
+      up_delay_s: predicted-queue-delay breach that triggers scale-up.
+        Needs tick-time evidence (heartbeat-reported EMA or the fleet's
+        ``est_tick_s`` prior); with neither, ``up_pending_per_slot``
+        is the fallback trigger.
+      up_pending_per_slot: backlog-per-decode-lane fallback threshold.
+      idle_grace_ticks: consecutive fully-idle ticks before scale-down.
+      cooldown_ticks: minimum fleet ticks between scale up/down events
+        (the hysteresis that bounds flapping).
+      max_replacements: cold-spawn budget for replacing dead replicas;
+        exhausted → :class:`AutoscalerGaveUp`.
+    """
+
+    def __init__(self, *, min_replicas: int = 1, max_replicas: int = 8,
+                 up_delay_s: Optional[float] = None,
+                 up_pending_per_slot: float = 8.0,
+                 idle_grace_ticks: int = 20, cooldown_ticks: int = 10,
+                 max_replacements: int = 3):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_delay_s = up_delay_s
+        self.up_pending_per_slot = float(up_pending_per_slot)
+        self.idle_grace_ticks = int(idle_grace_ticks)
+        self.cooldown_ticks = int(cooldown_ticks)
+        self.max_replacements = int(max_replacements)
+        self.fleet = None
+        self.desired: Optional[int] = None
+        self.events: List[Dict[str, Any]] = []
+        self.replacements = 0
+        self._idle_ticks = 0
+        self._last_scale_tick: Optional[int] = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def bind(self, fleet) -> "Autoscaler":
+        self.fleet = fleet
+        live = sum(1 for w in fleet.workers if w.state == "live")
+        self.desired = min(self.max_replicas,
+                           max(self.min_replicas, live))
+        return self
+
+    def _emit(self, action: str, reason: str, before: int, after: int,
+              **extra) -> None:
+        ev = {"kind": "scale", "action": action, "reason": reason,
+              "replicas_before": before, "replicas_after": after,
+              "tick": self.fleet.ticks, "desired": self.desired,
+              **extra}
+        self.events.append(ev)
+        if self.fleet.telemetry is not None:
+            self.fleet.telemetry.emit_event(ev)
+        _log.warning("autoscaler %s (%s): %d -> %d replicas",
+                     action, reason, before, after)
+
+    # -- sensing -----------------------------------------------------------
+
+    def _sense(self, live) -> Dict[str, Any]:
+        """Load evidence from the heartbeat FILES (the cross-process
+        sensor), with the in-flight ledger deciding idleness — a parked
+        request with zero backlog still means the fleet is not idle."""
+        beats = multihost.read_heartbeats(self.fleet.root)
+        pending = 0
+        est = None
+        for w in live:
+            b = beats.get(w.replica_id) or {}
+            pending += int(b.get("pending_new_tokens") or 0)
+            if b.get("est_tick_s") is not None:
+                e = float(b["est_tick_s"])
+                est = e if est is None else max(est, e)
+        if est is None:
+            est = self.fleet.est_tick_s
+        max_slots = max((getattr(w.engine, "max_slots", 1)
+                         for w in live), default=1)
+        lanes = max(1, len(live) * max_slots)
+        delay = (pending / lanes) * est if est is not None else None
+        return {"pending_new_tokens": pending,
+                "predicted_delay_s": delay,
+                "pending_per_slot": pending / lanes,
+                "in_flight": len(self.fleet._active)}
+
+    # -- the policy step ---------------------------------------------------
+
+    def _cooled_down(self, tick: int) -> bool:
+        return (self._last_scale_tick is None
+                or tick - self._last_scale_tick >= self.cooldown_ticks)
+
+    def step(self, now: Optional[float] = None) -> None:
+        """One policy decision, run per fleet tick (after the health
+        refresh, before reconcile — a replacement spawned here receives
+        the dead replica's orphans in the same tick)."""
+        fleet = self.fleet
+        assert fleet is not None, "bind() the autoscaler to a fleet first"
+        tick = fleet.ticks
+        live = [w for w in fleet.workers if w.state == "live"]
+        draining = [w for w in fleet.workers if w.state == "draining"]
+        sense = self._sense(live)
+
+        # 1) replacement: heal the envelope before judging load. Healing
+        # is not scaling — it ignores the up/down cooldown but pays from
+        # its own bounded budget, loud when exhausted.
+        if (len(live) + len(draining) < self.desired
+                and len(live) < self.max_replicas):
+            if self.replacements >= self.max_replacements:
+                raise AutoscalerGaveUp(
+                    f"replacement budget exhausted "
+                    f"({self.replacements}/{self.max_replacements} "
+                    f"cold spawns): replicas are dying faster than "
+                    f"policy may heal — fix the fleet, not the budget",
+                    self.events)
+            self.replacements += 1
+            before = len(live)
+            rid = fleet.spawn_replica()
+            self._emit("replace", "replica-dead", before, before + 1,
+                       replica=rid,
+                       replacements=self.replacements, **sense)
+            return
+
+        # 2) idle bookkeeping for the scale-down grace window
+        idle = (sense["pending_new_tokens"] == 0
+                and sense["in_flight"] == 0)
+        self._idle_ticks = self._idle_ticks + 1 if idle else 0
+
+        if not self._cooled_down(tick):
+            return
+
+        # 3) scale up on predicted-delay breach (fallback: raw
+        # backlog-per-lane when no tick-time evidence exists yet). The
+        # capacity envelope counts DRAINING replicas too — their
+        # engines still hold memory/processes until released, and the
+        # replacement branch already counts them.
+        delay = sense["predicted_delay_s"]
+        if delay is not None and self.up_delay_s is not None:
+            breach = delay > self.up_delay_s
+            up_reason = "predicted-delay-breach"
+        else:
+            breach = sense["pending_per_slot"] > self.up_pending_per_slot
+            up_reason = "backlog-threshold"
+        if breach and len(live) + len(draining) < self.max_replicas:
+            before = len(live)
+            self.desired = min(self.max_replicas, self.desired + 1)
+            rid = fleet.spawn_replica()
+            self._last_scale_tick = tick
+            self._emit("up", up_reason, before, before + 1,
+                       replica=rid, **sense)
+            return
+
+        # 4) scale down on sustained idle, through drain() — lossless
+        if (self._idle_ticks >= self.idle_grace_ticks
+                and len(live) > self.min_replicas
+                and self.desired > self.min_replicas):
+            victim = min(live, key=lambda w: (
+                w.scheduler.pending_new_tokens(), -w.replica_id))
+            before = len(live)
+            self.desired -= 1
+            fleet.drain(victim.replica_id)
+            self._last_scale_tick = tick
+            self._idle_ticks = 0
+            self._emit("down", "sustained-idle", before, before - 1,
+                       replica=victim.replica_id, **sense)

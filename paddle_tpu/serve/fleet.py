@@ -48,6 +48,20 @@ FaultSchedule` (``kill_replica_at_tick``, ``stall_replica_at_tick``,
 ``drop_submit_at``, ``duplicate_submit_at``), so the whole fleet path is
 deterministically drilled in CI (``bench.py --fleet-child``) the same
 way ``run_resilient`` is.
+
+**Process isolation (ISSUE 13).** ``ServingFleet(replica_mode=
+"process")`` promotes each replica to a real child process
+(:class:`ProcReplicaWorker`): the engine+scheduler pair lives in
+``serve/replica_proc.py``, submit/complete ride the length-prefixed
+:mod:`~paddle_tpu.serve.transport` frames, and the child beats the same
+PR-10 heartbeat files. The parent's ENTIRE view of a process replica is
+files + transport — a SIGKILL, a hang, or a corrupt reply is contained
+in the child, observed via heartbeat staleness / per-message timeout /
+classified parse errors, and healed by the exact reconcile path the
+in-process drills already pin. The in-process SimClock fleet stays the
+default and is behaviorally unchanged; elastic capacity on top of
+``drain()`` and :meth:`ServingFleet.spawn_replica` is the
+:class:`~paddle_tpu.serve.autoscaler.Autoscaler`'s policy loop.
 """
 
 from __future__ import annotations
@@ -56,15 +70,20 @@ import collections
 import dataclasses
 import itertools
 import logging
+import os
+import signal
 import tempfile
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..parallel import multihost
+from . import transport as transport_lib
+from .engine import AdmitProbe
 from .router import FleetRouter
 from .scheduler import ContinuousBatchingScheduler, Request
 
-__all__ = ["ReplicaWorker", "FleetRequest", "ServingFleet"]
+__all__ = ["ReplicaWorker", "ProcReplicaWorker", "RemoteRequest",
+           "FleetRequest", "ServingFleet", "build_proc_spec"]
 
 _log = logging.getLogger("paddle_tpu.serve.fleet")
 
@@ -109,6 +128,67 @@ class ReplicaWorker:
     def stalled(self, tick: int) -> bool:
         return self._stall_until is not None and tick < self._stall_until
 
+    def sigkill(self) -> None:
+        """The process-level kill point (``sigkill_replica_at_tick``)
+        degrades to the abstract kill for an in-process worker — the
+        same schedule drills both replica modes."""
+        self.kill()
+
+    # -- the worker seam (shared with ProcReplicaWorker) -------------------
+
+    def join(self, now: float) -> None:
+        """Join the fleet: first heartbeat (the process worker's
+        blocking hello handshake lands here)."""
+        self.beat(now)
+
+    def deliver(self, fr: "FleetRequest",
+                now: float) -> Optional[Request]:
+        """Hand one fleet request to this replica's scheduler; returns
+        the replica-side attempt (None = delivery failed, the reconcile
+        sweep re-homes it — in-process delivery cannot fail)."""
+        return self.scheduler.submit(
+            fr.prompt, fr.max_new_tokens, eos_id=fr.eos_id,
+            deadline_s=fr.deadline_s, priority=fr.priority, rid=fr.rid,
+            submit_ts=fr.submit_ts, retries=fr.retries)
+
+    def begin_drain(self, now: float) -> List[int]:
+        """Stop admitting and surrender the QUEUED (never-admitted)
+        requests: returns their rids for the fleet to resubmit (their
+        ``local`` attempts stay referenced for the retried-lineage
+        record). Running slots finish in place."""
+        rids = []
+        for local in list(self.scheduler.queue):
+            self.scheduler.queue.remove(local)
+            self.known.discard(local.rid)
+            rids.append(local.rid)
+        return rids
+
+    def cancel_drain(self) -> None:
+        """Drain cancelled (the raced-capacity yield): nothing to undo
+        in-process — admission gating lives in the router's state
+        check."""
+
+    def idle(self) -> bool:
+        """Nothing queued, running, or prefilling — the drain-release
+        condition."""
+        return not (self.scheduler.running or self.scheduler.prefilling
+                    or self.scheduler.queue)
+
+    def orphan_count(self) -> int:
+        return (len(self.scheduler.queue) + len(self.scheduler.running)
+                + len(self.scheduler.prefilling))
+
+    def on_declared_dead(self) -> None:
+        """Hook run when the router's heartbeat verdict lands. The
+        in-process zombie fence stays in :meth:`tick` (a stalled worker
+        must fence itself on WAKE); process workers fence by kill."""
+
+    def shutdown(self) -> None:
+        """Release-path teardown (a no-op for an in-process object)."""
+
+    def transport_stats(self) -> Optional[Dict[str, int]]:
+        return None
+
     # -- liveness ----------------------------------------------------------
 
     def beat(self, now: float) -> None:
@@ -116,12 +196,16 @@ class ReplicaWorker:
         multihost.write_heartbeat(
             self.root, host_id=self.replica_id, seq=self._hb_seq, now=now,
             extra={"role": "serving-replica",
-                   "pending_new_tokens": self.scheduler.pending_new_tokens(),
-                   "running": len(self.scheduler.running),
-                   "queued": len(self.scheduler.queue),
-                   # the prefix-locality payoff rides the beat: a
-                   # cross-process router could weigh affinity against
-                   # load on the same evidence it health-checks
+                   # the shared load payload (scheduler.load_report) +
+                   # the tick-time EMA: the autoscaler's sensors, and
+                   # the same schema a process replica's child beats —
+                   # a cross-process router balances on the exact
+                   # evidence it health-checks
+                   **self.scheduler.load_report(),
+                   "est_tick_s": self.scheduler.est_tick_s,
+                   "free_blocks": self.engine.cache.free_blocks,
+                   "free_slots": len(self.engine.free_slots()),
+                   # the prefix-locality payoff rides the beat too
                    "prefix_hit_blocks": self.engine.cache.prefix_hit_blocks})
 
     def reset(self) -> None:
@@ -189,6 +273,371 @@ class FleetRequest:
         return list(self.local.tokens) if self.local is not None else []
 
 
+@dataclasses.dataclass
+class RemoteRequest(Request):
+    """Parent-side mirror of a request delivered to a subprocess
+    replica: identity + SLO fields are enough for the retried-lineage
+    record (the fleet stamps ``finish_reason="retried"`` and emits
+    :meth:`record` exactly as in-process); once the child's completion
+    arrives, the CHILD's terminal record is returned verbatim — one
+    schema, authored where the work actually ran."""
+    child_record: Optional[Dict[str, Any]] = None
+
+    def record(self) -> Dict[str, Any]:
+        if (self.child_record is not None
+                and self.finish_reason != "retried"):
+            return dict(self.child_record)
+        return super().record()
+
+
+class _RemoteSchedulerView:
+    """The router/fleet-facing load view of a subprocess replica's
+    scheduler. The parent never holds the child's real queue — only the
+    evidence the child last reported (heartbeat payloads and tick
+    replies), which is exactly what a cross-host router could know."""
+
+    def __init__(self):
+        self.max_slots = 1
+        self.est_tick_s: Optional[float] = None
+        self._pending = 0
+        self.queue: List[int] = []          # rids, as last reported
+        self.running: List[int] = []
+        self.prefilling: List[int] = []
+        self.completed: List[RemoteRequest] = []
+        self.by_rid: Dict[int, RemoteRequest] = {}
+
+    def update(self, load: Dict[str, Any]) -> None:
+        self._pending = int(load.get("pending_new_tokens") or 0)
+        self.queue = list(load.get("queued_rids") or ())
+        self.running = list(load.get("running_rids") or ())
+        self.prefilling = list(load.get("prefilling_rids") or ())
+        if load.get("est_tick_s") is not None:
+            self.est_tick_s = float(load["est_tick_s"])
+
+    def pending_new_tokens(self) -> int:
+        return self._pending
+
+    def predicted_completion_s(self, max_new_tokens: int
+                               ) -> Optional[float]:
+        # the ContinuousBatchingScheduler model, over reported evidence
+        if self.est_tick_s is None:
+            return None
+        ticks = (self._pending / max(1, self.max_slots)
+                 + max_new_tokens)
+        return ticks * self.est_tick_s
+
+
+class _RemoteEngineView:
+    """Engine facade over hello/heartbeat/tick-reply evidence: geometry
+    is static (the hello handshake), occupancy is the last report. The
+    router's ``admit_probe`` runs the real probe's never-clears-first
+    rules against that evidence."""
+
+    def __init__(self):
+        self.cache = self       # the fleet reads w.engine.cache.<field>
+        self.context_width = 0
+        self.max_slots = 1
+        self.block_size = 1
+        self.num_blocks = 2
+        self.free_blocks = 1
+        self.free_slots_reported = 1
+        self.prefix_hit_blocks = 0
+        self.cow_forks = 0
+        self.ticks = 0
+        self._compile_counts: Dict[str, int] = {}
+
+    def set_geometry(self, hello: Dict[str, Any]) -> None:
+        self.context_width = int(hello["context_width"])
+        self.max_slots = int(hello["max_slots"])
+        self.block_size = int(hello["block_size"])
+        self.num_blocks = int(hello["num_blocks"])
+        self.free_blocks = self.num_blocks - 1      # null block reserved
+        self.free_slots_reported = self.max_slots
+
+    def update(self, load: Dict[str, Any]) -> None:
+        if load.get("free_blocks") is not None:
+            self.free_blocks = int(load["free_blocks"])
+        if load.get("free_slots") is not None:
+            self.free_slots_reported = int(load["free_slots"])
+        self.ticks = int(load.get("engine_ticks") or self.ticks)
+        self.prefix_hit_blocks = int(load.get("prefix_hit_blocks")
+                                     or self.prefix_hit_blocks)
+        self.cow_forks = int(load.get("cow_forks") or self.cow_forks)
+        if load.get("compile_counts"):
+            self._compile_counts = dict(load["compile_counts"])
+
+    def blocks_needed(self, length: int) -> int:
+        return max(1, -(-int(length) // self.block_size))
+
+    def compile_counts(self) -> Dict[str, int]:
+        return dict(self._compile_counts)
+
+    def admit_probe(self, total_len: int,
+                    include_slots: bool = True) -> AdmitProbe:
+        need = self.blocks_needed(total_len)
+        if total_len > self.context_width:
+            reason = "width"
+        elif include_slots and self.free_slots_reported == 0:
+            reason = "slots"
+        elif need > self.free_blocks:
+            reason = "blocks"
+        else:
+            reason = None
+        return AdmitProbe(ok=reason is None, reason=reason,
+                          blocks_needed=need,
+                          free_blocks=self.free_blocks,
+                          free_slots=self.free_slots_reported)
+
+
+class ProcReplicaWorker:
+    """One serving replica living in its OWN process (ISSUE 13).
+
+    The parent's entire view of this replica is heartbeat FILES plus the
+    seq-numbered submit/complete transport — the same worker seam
+    :class:`ReplicaWorker` implements in-process, so the router, the
+    reconcile sweep, drain, and the autoscaler are mode-blind:
+
+    - a SIGKILL/OOM/segfault in the child stops the beats; the router
+      observes staleness and the fleet re-homes the requests — the
+      router process never crashes;
+    - a hung child (or a lost reply) surfaces as the per-message
+      timeout; bounded retransmits recover a lost REPLY from the
+      child's seq cache, and exhausted retries quarantine the transport
+      (``transport_down``) while the heartbeat verdict decides;
+    - a garbled reply is a CLASSIFIED :class:`~paddle_tpu.serve.
+      transport.TransportCorrupt`, counted and retried, never an
+      exception through the fleet tick;
+    - declared-dead process replicas are fenced BY KILL — the
+      definitive form of the PR-11 zombie self-fence (a process that
+      no longer exists cannot complete a re-homed request).
+    """
+
+    is_process = True
+
+    def __init__(self, replica_id: int, spec: Dict[str, Any], root: str,
+                 *, faults=None, telemetry=None, timeout_s: float = 2.0,
+                 spawn_timeout_s: float = 300.0, stderr=None):
+        self.replica_id = int(replica_id)
+        self.root = root
+        self.state = "live"
+        self.killed = False
+        self._stall_until: Optional[int] = None
+        self.known: set = set()
+        self._collected = 0
+        self.faults = faults
+        self.telemetry = telemetry
+        self.scheduler = _RemoteSchedulerView()
+        self.engine = _RemoteEngineView()
+        self.transport_down = False
+        self.transport_errors = 0
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        spec = dict(spec, replica_id=self.replica_id, root=root)
+        proc = transport_lib.spawn_replica_process(spec, stderr=stderr)
+        self.transport = transport_lib.ReplicaTransport(
+            proc.stdout, proc.stdin, proc=proc, timeout_s=timeout_s)
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.transport.pid
+
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        if self.telemetry is not None:
+            self.telemetry.emit_event(rec)
+
+    def _transport_error(self, op: str, err) -> None:
+        self.transport_errors += 1
+        kind = getattr(err, "kind", "error")
+        _log.warning("replica %d transport %s on %s: %s",
+                     self.replica_id, kind, op, err)
+        self._emit({"kind": "transport", "event": kind,
+                    "replica": self.replica_id, "op": op})
+        # every retransmit already failed by the time we get here: stop
+        # talking to this replica (no per-tick timeout stalls while a
+        # corpse rots) and let the heartbeat verdict make the call
+        self.transport_down = True
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def join(self, now: float) -> None:
+        """Blocking hello handshake: waits for the child to finish its
+        jax bring-up, records the engine geometry, and confirms the
+        first heartbeat landed (the child beats on hello)."""
+        reply = self.transport.request(
+            "hello", now=now, timeout_s=self._spawn_timeout_s,
+            max_attempts=1)
+        self.engine.set_geometry(reply)
+        self.scheduler.max_slots = self.engine.max_slots
+        load = reply.get("load") or {}
+        self.scheduler.update(load)
+        self.engine.update(load)
+
+    def _terminate(self, sig=signal.SIGKILL) -> None:
+        proc = self.transport.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                os.kill(proc.pid, sig)
+            except (ProcessLookupError, OSError):
+                pass
+        self.transport.close()
+        if proc is not None:
+            try:
+                proc.wait(timeout=5.0)
+            except Exception:               # still dying; reaped later
+                pass
+
+    def kill(self) -> None:
+        """REAL process death: SIGKILL. The beats stop on their own —
+        the fleet learns nothing until the heartbeat goes stale."""
+        self.killed = True
+        self._terminate(signal.SIGKILL)
+
+    sigkill = kill
+
+    def stall(self, until_tick: int) -> None:
+        """Simulated hang from the FLEET's side of the seam: no tick
+        traffic (so no work and no beats) until ``until_tick`` — the
+        evidence trail of a hung child, with the child itself healthy."""
+        self._stall_until = int(until_tick)
+
+    def stalled(self, tick: int) -> bool:
+        return self._stall_until is not None and tick < self._stall_until
+
+    def on_declared_dead(self) -> None:
+        """Fence-by-kill: the process analog of the PR-11 zombie
+        self-fence. A declared-dead replica whose process still runs (a
+        stall, a partition) must never complete a re-homed request —
+        SIGKILL makes that structural."""
+        self._terminate(signal.SIGKILL)
+
+    def shutdown(self) -> None:
+        """Graceful stop (release path / fleet teardown): ask the child
+        to exit, then make sure."""
+        proc = self.transport.proc
+        if (not self.transport.closed and not self.transport_down
+                and proc is not None and proc.poll() is None):
+            try:
+                self.transport.request("stop", max_attempts=1)
+            except transport_lib.TransportError:
+                pass
+        self._terminate(signal.SIGKILL)
+
+    # -- the worker seam ---------------------------------------------------
+
+    def deliver(self, fr: "FleetRequest",
+                now: float) -> Optional[Request]:
+        if self.transport_down:
+            return None                 # don't pay timeouts to a corpse
+        try:
+            reply = self.transport.request(
+                "submit", rid=fr.rid, prompt=list(fr.prompt),
+                max_new_tokens=fr.max_new_tokens, eos_id=fr.eos_id,
+                deadline_s=fr.deadline_s, priority=fr.priority,
+                submit_ts=fr.submit_ts, retries=fr.retries, now=now)
+        except transport_lib.TransportError as e:
+            self._transport_error("submit", e)
+            return None
+        if not reply.get("ok"):
+            return None                 # refused (draining child)
+        req = RemoteRequest(
+            rid=fr.rid, prompt=list(fr.prompt),
+            max_new_tokens=fr.max_new_tokens, eos_id=fr.eos_id,
+            deadline_s=fr.deadline_s, priority=fr.priority,
+            retries=fr.retries, submit_ts=fr.submit_ts)
+        self.scheduler.by_rid[fr.rid] = req
+        return req
+
+    def tick(self, now: float, tick_idx: int) -> None:
+        """One replica tick over the wire: the child steps its
+        scheduler, beats, and ships completions + telemetry + load in
+        the reply. Transport faults are classified and contained."""
+        if (self.killed or self.state in ("released", "dead")
+                or self.transport_down or self.stalled(tick_idx)):
+            return
+        flags = {}
+        if self.faults is not None:
+            if self.faults.should_hang_transport(tick_idx,
+                                                 self.replica_id):
+                flags["inject_drop_reply"] = True
+            if self.faults.should_corrupt_reply(tick_idx,
+                                                self.replica_id):
+                flags["inject_corrupt_reply"] = True
+        try:
+            reply = self.transport.request("tick", now=now,
+                                           tick=tick_idx, **flags)
+        except transport_lib.TransportError as e:
+            self._transport_error("tick", e)
+            return
+        self._absorb(reply)
+
+    def _absorb(self, reply: Dict[str, Any]) -> None:
+        load = reply.get("load") or {}
+        self.scheduler.update(load)
+        self.engine.update(load)
+        for ev in reply.get("events") or ():
+            self._emit(ev)              # the fleet's ONE telemetry stream
+        for item in reply.get("completed") or ():
+            rec = item.get("record") or {}
+            rid = rec.get("rid")
+            req = self.scheduler.by_rid.pop(rid, None)
+            if req is None:             # superseded/unknown: _collect
+                req = RemoteRequest(rid=rid, prompt=[],
+                                    max_new_tokens=1)
+            req.child_record = rec
+            req.tokens = list(item.get("tokens") or ())
+            req.finish_reason = rec.get("finish_reason")
+            req.finish_ts = req.submit_ts   # done marker; truth in rec
+            self.scheduler.completed.append(req)
+
+    def begin_drain(self, now: float) -> List[int]:
+        try:
+            reply = self.transport.request("drain", now=now)
+        except transport_lib.TransportError as e:
+            self._transport_error("drain", e)
+            return []
+        rids = [int(r) for r in reply.get("queued_rids") or ()]
+        for rid in rids:
+            self.known.discard(rid)
+            self.scheduler.by_rid.pop(rid, None)
+        self.scheduler.update(reply.get("load") or {})
+        return rids
+
+    def cancel_drain(self) -> None:
+        """The child refuses submissions while draining; a cancelled
+        drain must tell it to admit again."""
+        if self.transport_down:
+            return
+        try:
+            self.transport.request("resume")
+        except transport_lib.TransportError as e:
+            self._transport_error("resume", e)
+
+    def idle(self) -> bool:
+        return not (self.scheduler.running or self.scheduler.prefilling
+                    or self.scheduler.queue)
+
+    def orphan_count(self) -> int:
+        return (len(self.scheduler.queue) + len(self.scheduler.running)
+                + len(self.scheduler.prefilling))
+
+    def stats_probe(self, now: float) -> Optional[Dict[str, Any]]:
+        """One stats round-trip (the drills' leak/retrace evidence:
+        free blocks and compile counts straight from the child)."""
+        if (self.transport_down or self.transport.closed
+                or self.killed or self.state in ("dead", "released")):
+            return None
+        try:
+            return self.transport.request("stats", now=now)
+        except transport_lib.TransportError as e:
+            self._transport_error("stats", e)
+            return None
+
+    def transport_stats(self) -> Dict[str, int]:
+        return {"errors": self.transport_errors,
+                "retransmits": self.transport.retransmits,
+                "timeouts": self.transport.timeouts,
+                "corrupt_replies": self.transport.corrupt_replies}
+
+
 class ServingFleet:
     """N replica workers + a router + the recovery loop (see module
     docstring).
@@ -209,34 +658,64 @@ class ServingFleet:
         :class:`ContinuousBatchingScheduler`).
       faults: a :class:`~paddle_tpu.train.faults.FaultSchedule` with the
         serving points armed.
+      replica_mode: ``"inprocess"`` (default — behaviorally identical
+        to PR 11) or ``"process"`` — each replica is a real child
+        process behind the submit/complete transport (needs
+        ``proc_spec``; use :meth:`from_model`).
+      proc_spec: the child-process build spec (:func:`build_proc_spec`):
+        model config, engine kwargs, variables npz path.
+      transport_timeout_s / spawn_timeout_s: per-message reply timeout
+        and the hello-handshake budget (a child pays jax bring-up
+        once).
+      autoscaler: an :class:`~paddle_tpu.serve.autoscaler.Autoscaler`
+        to bind; its policy loop runs inside every fleet tick.
     """
 
-    def __init__(self, make_engine: Callable[[int], Any],
+    def __init__(self, make_engine: Optional[Callable[[int], Any]],
                  n_replicas: int, *, telemetry=None, root: Optional[str]
                  = None, clock=None, heartbeat_timeout_s: float = 3.0,
                  order: str = "fcfs", shed: bool = True,
                  affinity: bool = True,
-                 est_tick_s: Optional[float] = None, faults=None):
+                 est_tick_s: Optional[float] = None, faults=None,
+                 replica_mode: str = "inprocess",
+                 proc_spec: Optional[Dict[str, Any]] = None,
+                 transport_timeout_s: float = 2.0,
+                 spawn_timeout_s: float = 300.0,
+                 autoscaler=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        if replica_mode not in ("inprocess", "process"):
+            raise ValueError(f"replica_mode must be "
+                             f"'inprocess'|'process', got {replica_mode!r}")
+        if replica_mode == "process" and proc_spec is None:
+            raise ValueError(
+                "replica_mode='process' needs proc_spec — use "
+                "ServingFleet.from_model(..., replica_mode='process') "
+                "or build_proc_spec()")
+        self.replica_mode = replica_mode
         self.telemetry = telemetry
         self.clock = clock if clock is not None else time.perf_counter
         self.root = root or tempfile.mkdtemp(prefix="paddle_tpu_fleet_")
         self.faults = faults
-        self.workers: List[ReplicaWorker] = []
-        for i in range(n_replicas):
-            eng = make_engine(i)
-            sched = ContinuousBatchingScheduler(
-                eng, telemetry=telemetry, order=order, shed=False,
-                est_tick_s=est_tick_s, clock=self.clock)
-            self.workers.append(ReplicaWorker(i, eng, sched, self.root))
+        self.make_engine = make_engine
+        self.order = order
+        self.est_tick_s = est_tick_s
+        self._proc_spec = dict(proc_spec or {})
+        self._transport_timeout_s = float(transport_timeout_s)
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        self.workers: List[Any] = []
+        for _ in range(n_replicas):       # Popen-spawn (or build) all…
+            self._spawn_worker()
         self.router = FleetRouter(
             self.workers, self.root,
             heartbeat_timeout_s=heartbeat_timeout_s, clock=self.clock,
             affinity=affinity, shed=shed)
         now = self.clock()
-        for w in self.workers:            # join the fleet: first beat
-            w.beat(now)
+        for w in self.workers:            # …then join: children paid
+            w.join(now)                   # their jax bring-up in parallel
+        self.autoscaler = autoscaler
+        if autoscaler is not None:
+            autoscaler.bind(self)
         self.requests: Dict[int, FleetRequest] = {}
         # the non-terminal subset, kept separately so the per-tick
         # reconcile/outstanding sweeps are O(in-flight), not
@@ -250,6 +729,46 @@ class ServingFleet:
         self.shed_count = 0
         self.duplicates_dropped = 0
         self.stale_completions = 0
+
+    # -- replica lifecycle -------------------------------------------------
+
+    def _spawn_worker(self):
+        """Construct (but do not yet join) replica ``len(workers)`` in
+        the active mode. Ids are append-only — a dead/released worker
+        stays as a tombstone — so replica id == list index forever."""
+        i = len(self.workers)
+        if self.replica_mode == "process":
+            w = ProcReplicaWorker(
+                i, self._proc_spec, self.root, faults=self.faults,
+                telemetry=self.telemetry,
+                timeout_s=self._transport_timeout_s,
+                spawn_timeout_s=self._spawn_timeout_s)
+        else:
+            eng = self.make_engine(i)
+            sched = ContinuousBatchingScheduler(
+                eng, telemetry=self.telemetry, order=self.order,
+                shed=False, est_tick_s=self.est_tick_s, clock=self.clock)
+            w = ReplicaWorker(i, eng, sched, self.root)
+        self.workers.append(w)
+        return w
+
+    def spawn_replica(self) -> int:
+        """Add one replica to the live fleet — the autoscaler's
+        scale-up / cold-replacement primitive. Blocks until the
+        newcomer is serving and has beaten once (a process replica pays
+        its jax bring-up here); the router (shared worker list) can
+        place onto it immediately. Returns the new replica id."""
+        w = self._spawn_worker()
+        w.join(self.clock())
+        self._replica_event("spawned", w)
+        return w.replica_id
+
+    def shutdown(self) -> None:
+        """Stop every replica (process replicas get a stop op, then
+        SIGKILL). Drills and tests call this; a production fleet runs
+        until its supervisor does."""
+        for w in self.workers:
+            w.shutdown()
 
     # -- helpers -----------------------------------------------------------
 
@@ -342,10 +861,12 @@ class ServingFleet:
             # of the rid — the reconcile sweep must notice and resubmit
             fr.local = None
             return
-        fr.local = worker.scheduler.submit(
-            fr.prompt, fr.max_new_tokens, eos_id=fr.eos_id,
-            deadline_s=fr.deadline_s, priority=fr.priority, rid=fr.rid,
-            submit_ts=fr.submit_ts, retries=fr.retries)
+        fr.local = worker.deliver(fr, self.clock())
+        if fr.local is None:
+            # a real delivery failure (transport error, draining child):
+            # same evidence shape as the drop_submit fault — the
+            # reconcile sweep re-homes it
+            return
         worker.known.add(fr.rid)
 
     def _shed(self, fr: FleetRequest, dec) -> None:
@@ -395,6 +916,7 @@ class ServingFleet:
                      None)
             if w is not None:
                 w.state = "live"
+                w.cancel_drain()
                 _log.warning("drain of replica %d cancelled: no other "
                              "live capacity for %d parked request(s)",
                              w.replica_id, len(self._unplaced))
@@ -469,10 +991,8 @@ class ServingFleet:
         w.state = "draining"
         now = self.clock()
         self._replica_event("draining", w)
-        for local in list(w.scheduler.queue):
-            w.scheduler.queue.remove(local)
-            w.known.discard(local.rid)
-            fr = self.requests.get(local.rid)
+        for rid in w.begin_drain(now):
+            fr = self.requests.get(rid)
             if fr is not None and fr.record is None:
                 self._resubmit(fr, now, "drain")
         return w.state
@@ -489,24 +1009,32 @@ class ServingFleet:
             k = self.faults.kill_replica_for_tick(t)
             if k is not None:
                 self._worker(k).kill()
+            sk = self.faults.sigkill_replica_for_tick(t)
+            if sk is not None:
+                self._worker(sk).sigkill()
             s = self.faults.stall_replica_for_tick(t)
             if s is not None:
                 rep, n = s
                 self._worker(rep).stall(t + n)
         for w in self.router.refresh_health(now):
-            self._replica_event(
-                "dead", w,
-                orphans=len(w.scheduler.queue) + len(w.scheduler.running)
-                + len(w.scheduler.prefilling))
+            self._replica_event("dead", w, orphans=w.orphan_count())
+            w.on_declared_dead()         # proc replicas fence by kill
+            # retire the ghost's beat (quarantine rename, never delete):
+            # watchdogs scanning the root must not re-report it forever
+            multihost.retire_heartbeat(self.root, w.replica_id)
+        if self.autoscaler is not None:
+            # policy BEFORE reconcile: a cold-spawned replacement is
+            # placeable in the same tick that needs it
+            self.autoscaler.step(now)
         self._reconcile(now)
         for w in self.workers:
             w.tick(now, t)
         self._collect()
         for w in self.workers:
-            if (w.state == "draining" and not w.scheduler.running
-                    and not w.scheduler.prefilling
-                    and not w.scheduler.queue):
+            if w.state == "draining" and w.idle():
                 w.state = "released"
+                w.shutdown()
+                multihost.retire_heartbeat(self.root, w.replica_id)
                 self._replica_event(
                     "released", w,
                     free_blocks=w.engine.cache.free_blocks)
@@ -569,7 +1097,23 @@ class ServingFleet:
         reasons = collections.Counter(
             fr.record["finish_reason"]
             for fr in self.requests.values() if fr.record)
+        per_replica = {}
+        for w in self.workers:
+            row = {"state": w.state, "killed": w.killed,
+                   "engine_ticks": w.engine.ticks,
+                   "free_blocks": w.engine.cache.free_blocks,
+                   "prefix_hit_blocks": w.engine.cache.prefix_hit_blocks,
+                   "compile_counts": w.engine.compile_counts()}
+            ts = w.transport_stats()
+            if ts is not None:
+                row["transport"] = ts
+            per_replica[w.replica_id] = row
+        scale = ({"scale_events": len(self.autoscaler.events),
+                  "desired_replicas": self.autoscaler.desired,
+                  "replacements": self.autoscaler.replacements}
+                 if self.autoscaler is not None else {})
         return {
+            **scale,
             "submitted": len(self.requests),
             "terminal": sum(1 for fr in self.requests.values()
                             if fr.record is not None),
@@ -580,31 +1124,72 @@ class ServingFleet:
             "stale_completions": self.stale_completions,
             "unplaced": len(self._unplaced),
             "ticks": self.ticks,
+            "replica_mode": self.replica_mode,
             "prefix_hit_blocks": sum(
                 w.engine.cache.prefix_hit_blocks for w in self.workers),
             "cow_forks": sum(
                 w.engine.cache.cow_forks for w in self.workers),
-            "replicas": {
-                w.replica_id: {
-                    "state": w.state, "killed": w.killed,
-                    "engine_ticks": w.engine.ticks,
-                    "free_blocks": w.engine.cache.free_blocks,
-                    "prefix_hit_blocks":
-                        w.engine.cache.prefix_hit_blocks,
-                    "compile_counts": w.engine.compile_counts(),
-                } for w in self.workers},
+            "replicas": per_replica,
         }
 
     @classmethod
     def from_model(cls, model, variables, n_replicas: int, *,
                    engine_kwargs: Optional[Dict[str, Any]] = None,
+                   replica_mode: str = "inprocess",
+                   model_spec: Optional[Dict[str, Any]] = None,
                    **kw) -> "ServingFleet":
         """Convenience constructor: N identical engines over one
-        checkpoint (the common homogeneous fleet)."""
+        checkpoint (the common homogeneous fleet). With
+        ``replica_mode="process"`` the model CONFIG plus the variables
+        (saved once as an npz under the fleet root) ship to each child
+        process, which rebuilds its own engine — the parent never
+        shares python objects with a replica. ``model_spec`` overrides
+        the introspected TransformerLM constructor kwargs (custom
+        models)."""
         from .engine import DecodeEngine
         ek = dict(engine_kwargs or {})
+        if replica_mode == "process":
+            root = kw.pop("root", None) or tempfile.mkdtemp(
+                prefix="paddle_tpu_fleet_")
+            spec = build_proc_spec(
+                model, variables, root, engine_kwargs=ek,
+                model_spec=model_spec, order=kw.get("order", "fcfs"),
+                est_tick_s=kw.get("est_tick_s"))
+            return cls(None, n_replicas, replica_mode="process",
+                       proc_spec=spec, root=root, **kw)
 
         def mk(_i):
             return DecodeEngine(model, variables, **ek)
 
         return cls(mk, n_replicas, **kw)
+
+
+def _introspect_lm(model) -> Dict[str, Any]:
+    """Recover the :class:`~paddle_tpu.models.TransformerLM` constructor
+    config a child process needs (dense homogeneous blocks — the
+    serving contract)."""
+    blk = model.blocks[0]
+    return {"vocab": model.emb.vocab, "dim": model.emb.dim,
+            "num_layers": len(model.blocks),
+            "num_heads": blk.attn.num_heads,
+            "ffn_hidden": blk.ffn1.features,
+            "max_len": model.max_len}
+
+
+def build_proc_spec(model, variables, root: str, *,
+                    engine_kwargs: Optional[Dict[str, Any]] = None,
+                    model_spec: Optional[Dict[str, Any]] = None,
+                    order: str = "fcfs",
+                    est_tick_s: Optional[float] = None
+                    ) -> Dict[str, Any]:
+    """The child-process build spec: model constructor kwargs, engine
+    kwargs, scheduler policy, and the variables npz (written once under
+    ``root``; every replica loads the same file — a training checkpoint
+    serves unmodified, just across a process boundary)."""
+    from .replica_proc import save_variables_npz
+    npz = os.path.join(root, "variables.npz")
+    save_variables_npz(npz, variables)
+    return {"model": dict(model_spec or _introspect_lm(model)),
+            "engine": dict(engine_kwargs or {}),
+            "variables_npz": npz, "order": order,
+            "est_tick_s": est_tick_s, "root": root}

@@ -1,0 +1,341 @@
+"""Serving-replica child process entrypoint (ISSUE 13).
+
+``python -m paddle_tpu.serve.replica_proc --spec '<json>'`` builds its
+OWN :class:`~paddle_tpu.serve.engine.DecodeEngine` +
+:class:`~paddle_tpu.serve.scheduler.ContinuousBatchingScheduler` pair
+from the spec (model config + a variables ``.npz`` the parent saved —
+training checkpoints serve unmodified, just like the in-process path),
+then serves the :mod:`~paddle_tpu.serve.transport` frame protocol over
+stdin/stdout until EOF or a ``stop`` op.
+
+The contract that makes a SIGKILL here a non-event for the router:
+
+- The child writes its OWN PR-10 heartbeat file each handled tick (the
+  ``now`` carried on the tick message — the fleet's clock is the one
+  time base, so SimClock drills stay deterministic). Kill the process
+  and the beats simply stop; the router observes staleness and the
+  fleet re-homes the requests. Nothing is announced.
+- Every request is handled at-least-once-safely: a ``seq`` already
+  processed replays the cached reply bytes (a retransmit after a lost
+  or corrupted reply never re-executes a tick), and a ``submit`` whose
+  rid is already known acks as a duplicate (the PR-11 idempotency
+  boundary, now enforced on BOTH sides of the pipe).
+- Telemetry emitted child-side (request records, deadline evictions) is
+  buffered and shipped on the next tick reply; the PARENT re-emits it
+  into the fleet's single stream — one telemetry stream, one terminal
+  record per rid, exactly as in-process.
+- Injected faults arrive as flags ON the message (``inject_drop_reply``
+  / ``inject_corrupt_reply``): the child does the work, then loses or
+  garbles the reply — so the drill exercises the real
+  timeout→retransmit→cached-reply path, not a mock of it.
+
+The first thing ``main`` does — before importing jax or building the
+model — is dup the real stdout away and point fd 1 at stderr, so no
+library print can ever tear a frame.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+__all__ = ["save_variables_npz", "load_variables_npz", "serve_loop",
+           "EventBuffer", "SettableClock", "main"]
+
+# separator for flattened variable-tree paths in the .npz; module names
+# are identifier-like (no "::" can appear in a key)
+_SEP = "::"
+
+
+def save_variables_npz(path: str, variables: Dict[str, Any]) -> str:
+    """Flatten a nested variables dict into one ``.npz`` (atomic
+    tmp+rename — a crashed writer never leaves a torn file a spawning
+    child could half-load)."""
+    import numpy as np
+
+    flat: Dict[str, Any] = {}
+
+    def walk(d, prefix):
+        for k, v in d.items():
+            key = f"{prefix}{_SEP}{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                walk(v, key)
+            else:
+                flat[key] = np.asarray(v)
+
+    walk(variables, "")
+    # np.savez appends ".npz" to names without it: keep the suffix so
+    # the tmp name we rename is the name savez actually wrote
+    tmp = f"{path}.tmp.{os.getpid()}.npz"
+    np.savez(tmp, **flat)
+    os.replace(tmp, path)
+    return path
+
+
+def load_variables_npz(path: str) -> Dict[str, Any]:
+    """Rebuild the nested variables dict :func:`save_variables_npz`
+    flattened."""
+    import numpy as np
+
+    out: Dict[str, Any] = {}
+    with np.load(path) as z:
+        for key in z.files:
+            parts = key.split(_SEP)
+            d = out
+            for p in parts[:-1]:
+                d = d.setdefault(p, {})
+            d[parts[-1]] = z[key]
+    return out
+
+
+class EventBuffer:
+    """Telemetry shim for the child's scheduler: captures emitted
+    records so the tick handler can ship them to the parent (which owns
+    the fleet's single telemetry stream)."""
+
+    def __init__(self):
+        self.records: List[Dict[str, Any]] = []
+
+    def emit_event(self, rec: Dict[str, Any]) -> None:
+        self.records.append(rec)
+
+    def drain(self) -> List[Dict[str, Any]]:
+        out, self.records = self.records, []
+        return out
+
+
+class SettableClock:
+    """The child's clock is SET from each message's ``now`` — the fleet
+    clock is the single time base for deadlines, TTFT and heartbeats,
+    which is what keeps SimClock drills deterministic across the
+    process boundary."""
+
+    def __init__(self, t0: float = 0.0):
+        self.t = float(t0)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def set(self, now: Optional[float]) -> None:
+        if now is not None:
+            self.t = float(now)
+
+
+def _build(spec: Dict[str, Any]):
+    """Heavy construction (jax import lives here): model from spec,
+    variables from the parent's npz (or a seeded init — bit-identical
+    to a parent that used the same seed), engine + scheduler."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import TransformerLM
+    from .engine import DecodeEngine
+    from .scheduler import ContinuousBatchingScheduler
+
+    model = TransformerLM(**spec["model"])
+    if spec.get("variables_npz"):
+        loaded = load_variables_npz(spec["variables_npz"])
+        vs = jax.tree_util.tree_map(jnp.asarray, loaded)
+    else:
+        vs = model.init(jax.random.PRNGKey(int(spec.get("seed", 0))),
+                        jnp.zeros((1, model.max_len), jnp.int32))
+    engine = DecodeEngine(model, vs, **(spec.get("engine") or {}))
+    buf = EventBuffer()
+    clock = SettableClock()
+    sched = ContinuousBatchingScheduler(
+        engine, telemetry=buf, order=spec.get("order", "fcfs"),
+        shed=False, est_tick_s=spec.get("est_tick_s"), clock=clock)
+    return engine, sched, buf, clock
+
+
+def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
+               root: str, replica_id: int,
+               reply_cache_size: int = 16) -> int:
+    """The child's message loop (transport-layer concerns only — the
+    handler logic is inline because it IS the replica). Returns the exit
+    code; EOF on stdin is a clean shutdown (the parent died or closed
+    us)."""
+    from ..parallel import multihost
+    from . import transport as tp
+
+    reader = tp.FrameReader(read_file)
+    reply_cache: "collections.OrderedDict[int, bytes]" = \
+        collections.OrderedDict()
+    known = set()                      # delivered rids (idempotency)
+    collected = 0                      # sched.completed cursor
+    hb_seq = 0
+    draining = False
+
+    def load_report() -> Dict[str, Any]:
+        rep = sched.load_report()
+        rep.update({
+            "free_blocks": engine.cache.free_blocks,
+            "free_slots": len(engine.free_slots()),
+            "engine_ticks": engine.ticks,
+            "prefix_hit_blocks": engine.cache.prefix_hit_blocks,
+            "cow_forks": engine.cache.cow_forks,
+            "est_tick_s": sched.est_tick_s,
+            "compile_counts": engine.compile_counts(),
+            "running_rids": [r.rid for r in sched.running.values()],
+            "queued_rids": [r.rid for r in sched.queue],
+            "prefilling_rids": [r.rid for r in sched.prefilling.values()],
+        })
+        return rep
+
+    def beat(now: Optional[float]) -> None:
+        nonlocal hb_seq
+        hb_seq += 1
+        multihost.write_heartbeat(
+            root, host_id=replica_id, seq=hb_seq, now=now,
+            extra={"role": "serving-replica", "pid": os.getpid(),
+                   **{k: v for k, v in load_report().items()
+                      if not k.endswith("_rids")
+                      and k != "compile_counts"}})
+
+    def handle(msg: Dict[str, Any]) -> Dict[str, Any]:
+        nonlocal collected, draining
+        op = msg.get("op")
+        clock.set(msg.get("now"))
+        if op == "hello":
+            beat(msg.get("now"))
+            return {"ok": True, "pid": os.getpid(),
+                    "context_width": engine.context_width,
+                    "max_slots": engine.max_slots,
+                    "block_size": engine.cache.block_size,
+                    "num_blocks": engine.cache.num_blocks,
+                    "load": load_report()}
+        if op == "submit":
+            rid = int(msg["rid"])
+            if rid in known:
+                return {"ok": True, "rid": rid, "duplicate": True}
+            if draining:
+                # the drain contract: admit nothing new; the fleet's
+                # reconcile re-homes the request
+                return {"ok": False, "rid": rid, "reason": "draining"}
+            sched.submit(msg["prompt"], int(msg["max_new_tokens"]),
+                         eos_id=msg.get("eos_id"),
+                         deadline_s=msg.get("deadline_s"),
+                         priority=int(msg.get("priority") or 0),
+                         rid=rid, submit_ts=msg.get("submit_ts"),
+                         retries=int(msg.get("retries") or 0))
+            known.add(rid)
+            return {"ok": True, "rid": rid, "duplicate": False}
+        if op == "tick":
+            sched.step()
+            beat(msg.get("now"))
+            completed = []
+            comp = sched.completed
+            while collected < len(comp):
+                req = comp[collected]
+                collected += 1
+                known.discard(req.rid)
+                completed.append({"record": req.record(),
+                                  "tokens": list(req.tokens)})
+            return {"ok": True, "tick": msg.get("tick"),
+                    "completed": completed, "events": buf.drain(),
+                    "load": load_report()}
+        if op == "drain":
+            draining = True
+            rids = []
+            for req in list(sched.queue):
+                sched.queue.remove(req)
+                known.discard(req.rid)
+                rids.append(req.rid)
+            return {"ok": True, "queued_rids": rids,
+                    "load": load_report()}
+        if op == "resume":
+            # drain cancelled (the raced-capacity yield, PR 11): this
+            # replica is live again and must admit
+            draining = False
+            return {"ok": True, "load": load_report()}
+        if op == "stats":
+            return {"ok": True, "load": load_report(),
+                    "compile_counts": engine.compile_counts(),
+                    "free_blocks": engine.cache.free_blocks,
+                    "num_blocks": engine.cache.num_blocks,
+                    "ticks": engine.ticks,
+                    "tokens_generated": engine.tokens_generated}
+        if op == "stop":
+            return {"ok": True, "stopping": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    while True:
+        try:
+            msg = reader.read_frame()
+        except tp.TransportClosed:
+            return 0                    # parent went away: clean exit
+        seq = msg.get("seq", 0)
+        if seq in reply_cache:
+            # at-least-once retransmit: replay the cached bytes, never
+            # re-execute the work
+            try:
+                write_file.write(reply_cache[seq])
+                write_file.flush()
+            except (BrokenPipeError, OSError):
+                return 0
+            continue
+        try:
+            reply = handle(msg)
+        except Exception as e:          # a handler bug must not kill the
+            # replica — classify it; the parent re-homes on not-ok
+            reply = {"ok": False,
+                     "error": f"{type(e).__name__}: {e}"}
+        reply["seq"] = seq
+        data = tp.encode_frame(reply)
+        reply_cache[seq] = data
+        while len(reply_cache) > reply_cache_size:
+            reply_cache.popitem(last=False)
+        try:
+            if msg.get("inject_drop_reply"):
+                pass                    # work done; the reply is "lost"
+            elif msg.get("inject_corrupt_reply"):
+                # a framed garble: valid length prefix, unparseable body
+                garbage = b"\xff\xfe<corrupt-reply>"
+                write_file.write(
+                    tp._HEADER.pack(len(garbage)) + garbage)
+                write_file.flush()
+            else:
+                write_file.write(data)
+                write_file.flush()
+        except (BrokenPipeError, OSError):
+            return 0
+        if reply.get("stopping"):
+            return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serve.replica_proc",
+        description="One process-isolated serving replica speaking the "
+                    "length-prefixed frame protocol on stdin/stdout.")
+    p.add_argument("--spec", required=True,
+                   help="JSON spec (or @path to a JSON file): model "
+                        "config, engine kwargs, variables npz, root, "
+                        "replica_id")
+    args = p.parse_args(argv)
+
+    # claim the transport BEFORE anything can print: dup the real
+    # stdout for frames, then point fd 1 at stderr so stray prints
+    # (library warnings, user code) can never tear a frame
+    out = os.fdopen(os.dup(1), "wb")
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+
+    raw = args.spec
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    spec = json.loads(raw)
+    engine, sched, buf, clock = _build(spec)
+    return serve_loop(
+        sys.stdin.buffer, out, engine=engine, sched=sched, buf=buf,
+        clock=clock, root=spec["root"],
+        replica_id=int(spec["replica_id"]))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

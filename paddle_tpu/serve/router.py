@@ -68,7 +68,10 @@ class FleetRouter:
                  heartbeat_timeout_s: float = 3.0,
                  clock=time.perf_counter, affinity: bool = True,
                  shed: bool = True, max_sessions: int = 4096):
-        self.workers = list(workers)
+        # held BY REFERENCE, not copied: the autoscaler (ISSUE 13)
+        # appends newly spawned replicas to the fleet's worker list and
+        # the router must see them become placeable immediately
+        self.workers = workers
         self.root = root
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.clock = clock

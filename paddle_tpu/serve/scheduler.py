@@ -202,6 +202,17 @@ class ContinuousBatchingScheduler:
         return (run + sum(r.max_new_tokens for r in self.queue)
                 + sum(r.max_new_tokens for r in self.prefilling.values()))
 
+    def load_report(self) -> Dict[str, Any]:
+        """The load payload a replica publishes (heartbeat extras and
+        the process-replica tick reply both carry it — ISSUE 13): the
+        router balances and the autoscaler senses on exactly this
+        evidence, whichever side of a process boundary the scheduler
+        lives on."""
+        return {"pending_new_tokens": self.pending_new_tokens(),
+                "running": len(self.running),
+                "queued": len(self.queue),
+                "prefilling": len(self.prefilling)}
+
     def predicted_completion_s(self, max_new_tokens: int
                                ) -> Optional[float]:
         """Predicted submit-to-finish seconds for a new request under the

@@ -1,0 +1,284 @@
+"""Submit/complete IPC transport for process-isolated serving replicas
+(ISSUE 13).
+
+PR 11 drew the replica seam — the router balances on heartbeat-carried
+load, delivery is rid-keyed and idempotent, death is observed from file
+staleness — but every replica was still an in-process object, so a real
+segfault or OOM kill in one engine took the whole fleet down. This
+module is the wire that lets a replica live in its own process: a
+length-prefixed JSON frame protocol over the child's stdin/stdout pipes,
+with the failure modes a real RPC layer has, each CLASSIFIED instead of
+crashing the router:
+
+- **Framing**: ``u32 big-endian length || UTF-8 JSON payload``. A frame
+  whose length field is absurd or whose body fails to parse raises
+  :class:`TransportCorrupt` — a garbled reply is an error VERDICT the
+  caller can act on (retransmit), never a router exception.
+- **Per-message timeout**: every :meth:`ReplicaTransport.request` waits
+  a bounded time for the matching reply (``select`` on the pipe fd). A
+  hung or dead child surfaces as :class:`TransportTimeout` /
+  :class:`TransportClosed`; the DEATH verdict still belongs to the
+  heartbeat file going stale (the PR-11 doctrine — observed, never
+  announced), the transport just stops waiting.
+- **At-least-once delivery, seq-numbered**: requests carry a
+  monotonically increasing ``seq``; on timeout or corruption the sender
+  retransmits the SAME seq (injection flags stripped — the fault was
+  the delivery, not the work). The child dedupes by seq and replays its
+  cached reply, so a lost/garbled REPLY never re-executes the work, and
+  a lost REQUEST simply runs on the retransmit. Above this sits the
+  fleet's rid-keyed idempotency (PR 11), so even a duplicate that slips
+  a cold cache is dropped at the replica boundary.
+
+Fault injection rides the messages themselves: the fleet (which owns the
+:class:`~paddle_tpu.train.faults.FaultSchedule`) sets ``inject_drop_reply``
+/ ``inject_corrupt_reply`` flags on a tick request, and the CHILD enacts
+them — processing the work, then losing or garbling the reply — so the
+drill exercises the real timeout/corrupt/retransmit/dedupe path end to
+end, not a parent-side simulation of it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import select
+import struct
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["TransportError", "TransportTimeout", "TransportCorrupt",
+           "TransportClosed", "encode_frame", "write_frame", "FrameReader",
+           "ReplicaTransport", "spawn_replica_process", "MAX_FRAME_BYTES"]
+
+_log = logging.getLogger("paddle_tpu.serve.transport")
+
+# a frame longer than this is garbage, not a message (the biggest real
+# frame is a tick reply carrying a few hundred request records)
+MAX_FRAME_BYTES = 1 << 24
+
+_HEADER = struct.Struct(">I")
+
+
+class TransportError(RuntimeError):
+    """Base class for classified transport failures. ``kind`` is the
+    machine-readable verdict carried into telemetry."""
+    kind = "error"
+
+
+class TransportTimeout(TransportError):
+    """No (matching) reply within the per-message timeout — a hung child
+    or a lost reply. Retransmit or let the heartbeat verdict decide."""
+    kind = "timeout"
+
+
+class TransportCorrupt(TransportError):
+    """A frame arrived but is not a message: absurd length prefix or a
+    body that fails to parse. Classified, never raised through the
+    fleet tick as a crash."""
+    kind = "corrupt"
+
+
+class TransportClosed(TransportError):
+    """The pipe is gone (EOF / EPIPE) — the peer process exited or was
+    killed. Terminal for this transport; the heartbeat file goes stale
+    on its own schedule."""
+    kind = "closed"
+
+
+def _json_default(o):
+    """Prompts and lengths often arrive as numpy scalars; their
+    ``item()`` is the plain-python value JSON wants."""
+    if hasattr(o, "item"):
+        return o.item()
+    raise TypeError(f"not JSON-serializable: {type(o).__name__}")
+
+
+def encode_frame(obj: Dict[str, Any]) -> bytes:
+    """One message as wire bytes: length prefix + compact JSON."""
+    body = json.dumps(obj, separators=(",", ":"),
+                      default=_json_default).encode("utf-8")
+    if len(body) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame too large: {len(body)} bytes")
+    return _HEADER.pack(len(body)) + body
+
+
+def write_frame(fobj, obj: Dict[str, Any]) -> None:
+    """Write one frame and flush. Pipe failures raise
+    :class:`TransportClosed`."""
+    try:
+        fobj.write(encode_frame(obj))
+        fobj.flush()
+    except (BrokenPipeError, OSError, ValueError) as e:
+        raise TransportClosed(f"write failed: {e}") from e
+
+
+class FrameReader:
+    """Incremental frame reader over a pipe/socket file object, with an
+    optional per-read timeout (``select`` on the fd — a blocking
+    ``read(n)`` would hang exactly when the peer does)."""
+
+    def __init__(self, fobj):
+        self._f = fobj
+        self._fd = fobj.fileno()
+        self._buf = bytearray()
+
+    def read_frame(self, timeout_s: Optional[float] = None
+                   ) -> Dict[str, Any]:
+        """Read one frame. Raises :class:`TransportTimeout` when no
+        complete frame arrives in ``timeout_s`` (partial bytes stay
+        buffered for the next call), :class:`TransportCorrupt` on a
+        garbage length or unparseable body, :class:`TransportClosed` on
+        EOF."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        header = self._read_exact(_HEADER.size, deadline)
+        (n,) = _HEADER.unpack(header)
+        if n > MAX_FRAME_BYTES:
+            # the stream is desynchronized beyond repair once the length
+            # field is garbage; classify rather than read 4GB
+            raise TransportCorrupt(f"frame length {n} exceeds "
+                                   f"{MAX_FRAME_BYTES}")
+        body = self._read_exact(n, deadline)
+        try:
+            return json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as e:
+            raise TransportCorrupt(f"unparseable frame body: {e}") from e
+
+    def _read_exact(self, n: int, deadline: Optional[float]) -> bytes:
+        while len(self._buf) < n:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TransportTimeout(
+                        f"no frame within timeout ({len(self._buf)}/{n} "
+                        f"bytes buffered)")
+                ready, _, _ = select.select([self._fd], [], [], remaining)
+                if not ready:
+                    raise TransportTimeout(
+                        f"no frame within timeout ({len(self._buf)}/{n} "
+                        f"bytes buffered)")
+            try:
+                chunk = os.read(self._fd, 65536)
+            except OSError as e:
+                raise TransportClosed(f"read failed: {e}") from e
+            if not chunk:
+                raise TransportClosed("EOF")
+            self._buf += chunk
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+class ReplicaTransport:
+    """The parent half of the submit/complete channel to one replica
+    process: seq-numbered requests, per-message timeout, bounded
+    retransmission, and counters for every classified failure (they ride
+    into ``ServingFleet.stats()``)."""
+
+    def __init__(self, read_file, write_file, *, proc=None,
+                 timeout_s: float = 2.0, max_attempts: int = 3):
+        self._reader = FrameReader(read_file)
+        self._w = write_file
+        self.proc = proc
+        self.timeout_s = float(timeout_s)
+        self.max_attempts = int(max_attempts)
+        self._seq = itertools.count(1)
+        self.retransmits = 0
+        self.timeouts = 0
+        self.corrupt_replies = 0
+        self.closed = False
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def request(self, op: str, *, timeout_s: Optional[float] = None,
+                max_attempts: Optional[int] = None,
+                **payload) -> Dict[str, Any]:
+        """Send ``{op, seq, **payload}`` and return the matching reply.
+        Timeouts and corrupt replies retransmit the same seq up to
+        ``max_attempts`` total tries (the child's seq cache makes the
+        retry safe); the last classified error raises if every attempt
+        fails. A closed pipe raises immediately — retransmitting into a
+        dead process is noise."""
+        if self.closed:
+            raise TransportClosed("transport already closed")
+        seq = next(self._seq)
+        msg = {"op": op, "seq": seq, **payload}
+        attempts = self.max_attempts if max_attempts is None \
+            else int(max_attempts)
+        wait = self.timeout_s if timeout_s is None else float(timeout_s)
+        last_err: Optional[TransportError] = None
+        for attempt in range(max(1, attempts)):
+            if attempt:
+                self.retransmits += 1
+                # the injected fault was the DELIVERY, not the work:
+                # the retransmit asks for the cached reply, clean
+                msg = {k: v for k, v in msg.items()
+                       if not k.startswith("inject_")}
+                _log.warning("retransmitting %s seq=%d (attempt %d: %s)",
+                             op, seq, attempt + 1, last_err)
+            try:
+                write_frame(self._w, msg)
+                return self._recv_matching(seq, wait)
+            except TransportTimeout as e:
+                self.timeouts += 1
+                last_err = e
+            except TransportCorrupt as e:
+                self.corrupt_replies += 1
+                last_err = e
+            except TransportClosed:
+                self.closed = True
+                raise
+        assert last_err is not None
+        raise last_err
+
+    def _recv_matching(self, seq: int, timeout_s: float) -> Dict[str, Any]:
+        """Read frames until one carries ``seq`` (stale replies from an
+        earlier timed-out exchange are drained and dropped), bounded by
+        one shared deadline."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TransportTimeout(f"no reply for seq={seq}")
+            reply = self._reader.read_frame(timeout_s=remaining)
+            if reply.get("seq") == seq:
+                return reply
+            _log.warning("dropping stale reply seq=%s (awaiting %d)",
+                         reply.get("seq"), seq)
+
+    def close(self) -> None:
+        """Close BOTH pipe ends — workers are append-only tombstones in
+        the fleet, so a leaked read fd per dead/released replica would
+        accumulate for the process lifetime of an elastic fleet."""
+        self.closed = True
+        for f in (self._w, self._reader._f):
+            try:
+                f.close()
+            except OSError:
+                pass
+
+
+def spawn_replica_process(spec: Dict[str, Any], *, stderr=None,
+                          env: Optional[Dict[str, str]] = None
+                          ) -> subprocess.Popen:
+    """Launch ``python -m paddle_tpu.serve.replica_proc`` with ``spec``
+    on argv, wired for framing: stdin/stdout are the transport (the
+    child re-points its fd 1 at stderr before any library can print to
+    it). Returns the Popen; wrap its pipes in a
+    :class:`ReplicaTransport`."""
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    child_env = dict(os.environ if env is None else env)
+    child_env["PYTHONPATH"] = repo_root + os.pathsep + \
+        child_env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "paddle_tpu.serve.replica_proc",
+           "--spec", json.dumps(spec)]
+    return subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE, stderr=stderr,
+                            env=child_env)

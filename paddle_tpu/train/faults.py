@@ -60,6 +60,22 @@ by fleet tick index or fleet request id — never wall clock):
 - ``duplicate_submit_at`` — fleet request id delivered twice (an RPC
   retry racing its original); the rid-keyed idempotency boundary must
   drop the duplicate.
+
+Process-level points (ISSUE 13; a replica is a real child process —
+``serve.fleet`` fires these against the transport seam):
+
+- ``sigkill_replica_at_tick`` — ``(tick, replica)``: SIGKILL the
+  replica's process at that fleet tick. Unlike ``kill_replica_at_tick``
+  (which models death abstractly for in-process workers too), this is
+  the REAL kill for subprocess replicas; the beats stop, the router
+  observes staleness, the autoscaler cold-spawns a replacement.
+- ``transport_hang_at`` — ``(tick, replica)``: that replica's reply to
+  the tick message never arrives (the child does the work, the reply is
+  lost) — the parent's per-message timeout fires and the at-least-once
+  retransmit recovers the cached reply.
+- ``corrupt_reply_at`` — ``(tick, replica)``: the reply frame arrives
+  garbled (valid length prefix, unparseable body) — classified as a
+  transport error, never a router crash; the retransmit recovers.
 """
 
 from __future__ import annotations
@@ -148,7 +164,11 @@ class FaultSchedule:
                  stall_replica_at_tick:
                  Optional[Tuple[int, int, int]] = None,
                  drop_submit_at: Optional[int] = None,
-                 duplicate_submit_at: Optional[int] = None):
+                 duplicate_submit_at: Optional[int] = None,
+                 sigkill_replica_at_tick:
+                 Optional[Tuple[int, int]] = None,
+                 transport_hang_at: Optional[Tuple[int, int]] = None,
+                 corrupt_reply_at: Optional[Tuple[int, int]] = None):
         self.seed = int(seed)
         self.crash_at_step = crash_at_step
         self.preempt_at_step = preempt_at_step
@@ -160,6 +180,9 @@ class FaultSchedule:
         self.stall_replica_at_tick = stall_replica_at_tick
         self.drop_submit_at = drop_submit_at
         self.duplicate_submit_at = duplicate_submit_at
+        self.sigkill_replica_at_tick = sigkill_replica_at_tick
+        self.transport_hang_at = transport_hang_at
+        self.corrupt_reply_at = corrupt_reply_at
         self._lock = threading.Lock()
         self._save_count = 0
         # (point, key) tuples, in firing order — the sweep's assertions
@@ -189,6 +212,9 @@ class FaultSchedule:
                 "stall_replica_at_tick": self.stall_replica_at_tick,
                 "drop_submit_at": self.drop_submit_at,
                 "duplicate_submit_at": self.duplicate_submit_at,
+                "sigkill_replica_at_tick": self.sigkill_replica_at_tick,
+                "transport_hang_at": self.transport_hang_at,
+                "corrupt_reply_at": self.corrupt_reply_at,
                 "fired": list(self.fired)}
 
     # -- trainer step points -------------------------------------------------
@@ -257,6 +283,36 @@ class FaultSchedule:
             return (int(self.stall_replica_at_tick[1]),
                     int(self.stall_replica_at_tick[2]))
         return None
+
+    def sigkill_replica_for_tick(self, tick: int) -> Optional[int]:
+        """The replica id whose PROCESS gets SIGKILL at fleet tick
+        ``tick`` (one-shot), or None. For in-process workers this
+        degrades to the abstract kill — the point exists so the same
+        schedule drills both replica modes."""
+        if self.sigkill_replica_at_tick is not None \
+                and tick == self.sigkill_replica_at_tick[0] \
+                and self._fire_once("sigkill_replica_at_tick", tick):
+            return int(self.sigkill_replica_at_tick[1])
+        return None
+
+    def should_hang_transport(self, tick: int, replica: int) -> bool:
+        """True (once) when ``replica``'s reply to the tick message at
+        fleet tick ``tick`` should be lost (the per-message-timeout
+        drill)."""
+        return (self.transport_hang_at is not None
+                and (tick, replica) == (self.transport_hang_at[0],
+                                        self.transport_hang_at[1])
+                and self._fire_once("transport_hang_at",
+                                    (tick, replica)))
+
+    def should_corrupt_reply(self, tick: int, replica: int) -> bool:
+        """True (once) when ``replica``'s reply at fleet tick ``tick``
+        should arrive garbled (the classified-corruption drill)."""
+        return (self.corrupt_reply_at is not None
+                and (tick, replica) == (self.corrupt_reply_at[0],
+                                        self.corrupt_reply_at[1])
+                and self._fire_once("corrupt_reply_at",
+                                    (tick, replica)))
 
     def should_drop_submit(self, rid: int) -> bool:
         """True (once) when fleet request ``rid``'s replica delivery

@@ -160,6 +160,32 @@ def test_bench_smoke_cpu_green_and_equal():
     assert fl["requests"]["ttft_ms_p99"] is not None
     assert fl["sjf_beats_fcfs_goodput"] is True
     assert fl["goodput_sjf_pct"] > fl["goodput_fcfs_pct"]
+    # ISSUE 13: the process-isolation leg — replicas as REAL child
+    # processes behind the submit/complete transport. A SIGKILL'd
+    # subprocess replica mid-decode is contained (router alive, death
+    # observed via heartbeat staleness): all requests terminal with
+    # exactly one terminal record per rid and oracle-identical tokens,
+    # live survivors leak- and retrace-free by their own stats probes,
+    # an injected transport hang recovers through the per-message
+    # timeout + at-least-once retransmit, a garbled reply is classified
+    # (not a crash), and the autoscaler cold-spawns a replacement
+    # within its restart budget
+    pr = fl["process"]
+    assert pr["ok"] is True, pr
+    assert pr["all_terminal"] is True and pr["lineage_ok"] is True
+    assert pr["oracle_tokens_ok"] is True
+    assert pr["no_leak_on_survivors"] is True
+    assert pr["zero_retraces_on_survivors"] is True
+    assert pr["transport_hang_recovered"] is True
+    assert pr["corrupt_reply_classified"] is True
+    assert pr["replacement_spawned"] is True
+    assert pr["replacements_within_budget"] == 1
+    assert pr["retried_requests"] >= 1
+    assert pr["stats"]["stale_completions"] == 0
+    assert pr["stats"]["replica_mode"] == "process"
+    assert {"sigkill_replica_at_tick", "transport_hang_at",
+            "corrupt_reply_at"} <= set(pr["faults_fired"])
+    assert any(e["action"] == "replace" for e in pr["scale_events"])
 
 
 def _write_bench(tmp_path, name, metrics):

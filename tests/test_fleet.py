@@ -607,6 +607,155 @@ def test_fleet_stalled_replica_fences_on_wake(model_and_vars, nprng):
 
 
 # ---------------------------------------------------------------------------
+# autoscaler: hysteresis, replacement budget, heartbeat retirement
+# (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_hysteresis_bounds_scale_events(model_and_vars,
+                                                   nprng):
+    """The acceptance drill for flapping: three bursts with idle gaps —
+    a naive threshold policy would scale up at every burst head and
+    down in every gap (>= 6 events). With cooldown + idle grace the
+    event count is bounded, consecutive up/down decisions are spaced >=
+    cooldown ticks apart, every scale-down routes through drain()
+    (released, never dead), and zero requests are lost."""
+    from paddle_tpu.serve import Autoscaler
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    scaler = Autoscaler(min_replicas=1, max_replicas=3, up_delay_s=1.5,
+                        idle_grace_ticks=6, cooldown_ticks=8)
+    fleet = _fleet(model, vs, 1, telemetry=Telemetry(sinks=[mem]),
+                   autoscaler=scaler)
+    frs = []
+    for _burst in range(3):
+        for _ in range(10):
+            frs.append(fleet.submit(list(nprng.randint(1, V, 4)), 8))
+        for _ in range(30):                # burst + idle gap
+            fleet.tick()
+            fleet.clock.advance(DT)
+    for _ in range(100):
+        if not fleet.outstanding():
+            break
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert all(fr.finish_reason == "length" for fr in frs)
+    _assert_lineage(mem, frs)
+    events = mem.by_kind("scale")
+    # one stream, same ledger (emit stamps ts on the sink copy)
+    assert [{k: v for k, v in e.items() if k != "ts"}
+            for e in events] == scaler.events
+    assert 2 <= len(events) <= 6, [  # naive threshold would flap >= 6
+        (e["tick"], e["action"]) for e in events]
+    assert {e["action"] for e in events} == {"up", "down"}
+    updown = [e for e in events if e["action"] in ("up", "down")]
+    gaps = [b["tick"] - a["tick"] for a, b in zip(updown, updown[1:])]
+    assert all(g >= scaler.cooldown_ticks for g in gaps), gaps
+    for e in events:                        # the telemetry schema
+        assert e["reason"] in ("predicted-delay-breach",
+                               "sustained-idle")
+        assert e["replicas_after"] == e["replicas_before"] + (
+            1 if e["action"] == "up" else -1)
+    # scale-down went through drain(): released, with zero leaks
+    released = [w for w in fleet.workers if w.state == "released"]
+    assert released, [w.state for w in fleet.workers]
+    _assert_survivor_invariants(fleet)
+    # capacity returned to min on sustained idle
+    assert sum(1 for w in fleet.workers if w.state == "live") == 1
+
+
+def test_autoscaler_replaces_dead_replica_then_gives_up_loud(
+        model_and_vars, nprng):
+    from paddle_tpu.serve import Autoscaler, AutoscalerGaveUp
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    faults = FaultSchedule(kill_replica_at_tick=(2, 0))
+    scaler = Autoscaler(min_replicas=2, max_replicas=3,
+                        idle_grace_ticks=1000, cooldown_ticks=5,
+                        max_replacements=1)
+    fleet = _fleet(model, vs, 2, telemetry=Telemetry(sinks=[mem]),
+                   faults=faults, autoscaler=scaler)
+    frs = [fleet.submit(list(nprng.randint(1, V, 4)), 6)
+           for _ in range(6)]
+    for _ in range(300):
+        if not fleet.outstanding():
+            break
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert all(fr.finish_reason == "length" for fr in frs)
+    # the dead replica was cold-replaced: a third worker exists, live
+    assert len(fleet.workers) == 3
+    assert fleet.workers[2].state == "live"
+    replaces = [e for e in scaler.events if e["action"] == "replace"]
+    assert len(replaces) == 1 and scaler.replacements == 1
+    assert replaces[0]["reason"] == "replica-dead"
+    assert [r["kind"] for r in mem.by_kind("scale")] == ["scale"] * len(
+        scaler.events)
+    _assert_lineage(mem, frs)
+    # budget exhausted -> give-up-loud with the ledger attached
+    fleet.workers[2].kill()
+    with pytest.raises(AutoscalerGaveUp) as e:
+        for _ in range(40):
+            fleet.tick()
+            fleet.clock.advance(DT)
+    assert e.value.events and e.value.events[0]["action"] == "replace"
+
+
+def test_heartbeat_retired_on_release_and_death(model_and_vars, nprng):
+    """ISSUE 13 satellite: released/dead replicas must not leave a live
+    heartbeat file behind — the file is RETIRED (renamed, never
+    deleted) so detect_dead_hosts stops re-reporting ghosts forever."""
+    import os
+    from paddle_tpu.parallel import multihost
+    model, vs = model_and_vars
+    faults = FaultSchedule(kill_replica_at_tick=(2, 1))
+    fleet = _fleet(model, vs, 3, faults=faults)
+    frs = [fleet.submit(list(nprng.randint(1, V, 4)), 4)
+           for _ in range(4)]
+    fleet.tick(); fleet.clock.advance(DT)
+    fleet.drain(0)
+    for _ in range(300):
+        if not fleet.outstanding():
+            break
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert fleet.workers[0].state == "released"
+    assert fleet.workers[1].state == "dead"
+    assert all(fr.finish_reason == "length" for fr in frs)
+    for rid in (0, 1):
+        path = multihost.heartbeat_path(fleet.root, rid)
+        assert not os.path.exists(path), f"ghost beat for replica {rid}"
+        assert os.path.exists(path + ".retired")      # never deleted
+    # the watchdog view: a full-root probe no longer reports the ghosts
+    stale = multihost.detect_dead_hosts(fleet.root, HB,
+                                        now=fleet.clock() + 100.0)
+    assert 0 not in stale and 1 not in stale
+    # retiring twice numbers the siblings instead of overwriting
+    multihost.write_heartbeat(fleet.root, host_id=0, now=fleet.clock())
+    assert multihost.retire_heartbeat(fleet.root, 0).endswith(
+        ".retired.1")
+
+
+def test_fault_schedule_describe_includes_process_points():
+    faults = FaultSchedule(sigkill_replica_at_tick=(6, 0),
+                           transport_hang_at=(3, 1),
+                           corrupt_reply_at=(4, 2))
+    d = faults.describe()
+    assert d["sigkill_replica_at_tick"] == (6, 0)
+    assert d["transport_hang_at"] == (3, 1)
+    assert d["corrupt_reply_at"] == (4, 2)
+    # one-shot: each point fires exactly once
+    assert faults.sigkill_replica_for_tick(6) == 0
+    assert faults.sigkill_replica_for_tick(6) is None
+    assert faults.should_hang_transport(3, 1) is True
+    assert faults.should_hang_transport(3, 1) is False
+    assert faults.should_corrupt_reply(4, 2) is True
+    assert faults.should_corrupt_reply(4, 2) is False
+    assert [p for p, _ in faults.fired] == [
+        "sigkill_replica_at_tick", "transport_hang_at",
+        "corrupt_reply_at"]
+
+
+# ---------------------------------------------------------------------------
 # percentiles + goodput aggregation (ISSUE 11 satellite)
 # ---------------------------------------------------------------------------
 
@@ -675,3 +824,44 @@ def test_report_summarize_includes_serving_block(tmp_path):
     text = report_lib.format_summary(s)
     assert "serving requests" in text and "goodput under deadline" in text
     assert report_lib.main([str(path)]) == 0
+
+
+def test_summarize_scale_and_report_block(tmp_path):
+    """ISSUE 13 satellite: kind="scale" events aggregate next to the
+    request percentiles — up/down/replace counts, reasons, final
+    capacity — and render in the report CLI."""
+    import json
+    from paddle_tpu.obs import summarize_scale
+    from paddle_tpu.obs import report as report_lib
+
+    def ev(action, reason, before, after, tick):
+        return {"kind": "scale", "action": action, "reason": reason,
+                "replicas_before": before, "replicas_after": after,
+                "tick": tick}
+
+    records = [
+        ev("up", "predicted-delay-breach", 1, 2, 3),
+        ev("replace", "replica-dead", 1, 2, 9),
+        ev("down", "sustained-idle", 2, 1, 30),
+        {"kind": "request", "rid": 0, "finish_reason": "length",
+         "ttft_ms": 5.0, "tpot_ms": 2.0, "wall_ms": 20.0,
+         "new_tokens": 3},
+    ]
+    s = summarize_scale(records)
+    assert s == {"events": 3, "up": 1, "down": 1, "replace": 1,
+                 "reasons": {"predicted-delay-breach": 1,
+                             "replica-dead": 1, "sustained-idle": 1},
+                 "final_replicas": 1, "max_replicas_seen": 2}
+    assert summarize_scale([{"kind": "request"}]) is None
+    path = tmp_path / "scale.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    out = report_lib.summarize(report_lib.load_records(str(path)))
+    assert out["serving"]["scale"]["events"] == 3
+    text = report_lib.format_summary(out)
+    assert "autoscaler" in text and "scale events (up/down/repl)" in text
+    # scale events WITHOUT request records still summarize + render
+    path2 = tmp_path / "scale_only.jsonl"
+    path2.write_text("\n".join(json.dumps(r) for r in records[:3]) + "\n")
+    out2 = report_lib.summarize(report_lib.load_records(str(path2)))
+    assert out2["serving"]["scale"]["replace"] == 1
+    assert "autoscaler" in report_lib.format_summary(out2)
