@@ -573,6 +573,9 @@ PLANS = {
     # serving decode throughput (own child protocol:
     # run_serving_bench_child; n/k unused)
     "transformer_decode": dict(n=0, k=1, budget=2400),
+    # same tick over an int8-quantized KV pool (ISSUE 14): the
+    # memory-bound decode's bytes-vs-throughput differential
+    "transformer_decode_int8": dict(n=0, k=1, budget=2400),
     # speculative-vs-plain decode differential (own child protocol:
     # run_serving_spec_bench_child; n/k unused)
     "transformer_decode_spec": dict(n=0, k=1, budget=2400),
@@ -1379,12 +1382,110 @@ def run_serving_child():
                 and chunk_leg["compile_counts"]
                 == {"prefill": 1, "tick": 1})
 
+    # --- ISSUE 14 leg (d): int8 KV quantization — at EQUAL pool bytes
+    # the int8 pool serves >= 1.8x the resident sequences, a saturated
+    # workload still completes every request, and greedy tokens agree
+    # >= 99% with the f32 pool on the gate set (bounded drift)
+    res_len, res_reserve = 5, 12            # 3 blocks per sequence
+    from paddle_tpu.serve import PagedKVCache
+
+    def pool_blocks(kv_dtype, budget_bytes):
+        probe = PagedKVCache(num_layers=2, num_heads=4, head_dim=8,
+                             num_blocks=2, block_size=4, max_slots=1,
+                             max_blocks_per_seq=8, kv_dtype=kv_dtype)
+        return budget_bytes // probe.bytes_per_block, \
+            probe.kv_bytes_per_token
+
+    budget = pool_blocks(None, 0)[1] * 4 * (6 * 3)   # 6 f32 sequences
+
+    def count_resident(kv_dtype):
+        nb, bpt = pool_blocks(kv_dtype, budget)
+        eng = DecodeEngine(model, vs, max_slots=16, block_size=4,
+                           num_blocks=nb + 1, kv_dtype=kv_dtype)
+        resident = 0
+        while (eng.free_slots()
+               and eng.can_admit(res_reserve)):
+            slot = eng.free_slots()[0]
+            eng.admit(slot, list(rng.randint(0, V, res_len)),
+                      reserve_len=res_reserve)
+            resident += 1
+        return resident, nb, bpt
+
+    res_f32, nb_f32, bpt_f32 = count_resident(None)
+    res_i8, nb_i8, bpt_i8 = count_resident("int8")
+
+    def run_quant(kv_dtype):
+        eng = DecodeEngine(model, vs, max_slots=4, block_size=4,
+                           kv_dtype=kv_dtype)
+        sched = ContinuousBatchingScheduler(eng)
+        reqs = [sched.submit(p, m) for p, m in zip(prompts, maxnew)]
+        sched.run()
+        return [r.tokens for r in reqs], eng
+
+    toks_f32, _ = run_quant(None)
+    toks_i8, eng_i8 = run_quant("int8")
+    agree = sum(a == b for x, y in zip(toks_f32, toks_i8)
+                for a, b in zip(x, y))
+    total = sum(len(x) for x in toks_f32)
+    quant_leg = {
+        "pool_budget_bytes": int(budget),
+        "resident_f32": res_f32, "resident_int8": res_i8,
+        "capacity_ratio": round(res_i8 / res_f32, 3) if res_f32 else None,
+        "kv_bytes_per_token_f32": int(bpt_f32),
+        "kv_bytes_per_token_int8": int(bpt_i8),
+        "completed": sum(1 for t in toks_i8 if t),
+        "token_agreement": round(agree / total, 4) if total else None,
+        "compile_counts": eng_i8.compile_counts(),
+    }
+    quant_ok = (quant_leg["capacity_ratio"] is not None
+                and quant_leg["capacity_ratio"] >= 1.8
+                and quant_leg["completed"] == 8
+                and quant_leg["token_agreement"] >= 0.99
+                and quant_leg["compile_counts"]
+                == {"prefill": 1, "tick": 1})
+
+    # --- ISSUE 14 leg (e): radix retention — a SECOND wave of
+    # same-prefix sessions (no live sharer) hits retained blocks and
+    # allocates fewer fresh blocks than a retention-off engine; the
+    # pool stays leak-free with retained counted reclaimable
+    ret_pre = list(rng.randint(0, V, 8))
+    ret_tails = [list(rng.randint(0, V, 3)) for _ in range(4)]
+
+    def run_retention(retain):
+        eng = DecodeEngine(model, vs, max_slots=2, block_size=4,
+                           retain_prefix=retain)
+        allocs = []
+        for i in range(2):               # two sequential waves
+            sched = ContinuousBatchingScheduler(eng)
+            for t in ret_tails[2 * i:2 * i + 2]:
+                sched.submit(ret_pre + list(t), 4)
+            sched.run()
+            allocs.append(eng.cache.allocator.total_allocs)
+        return eng, allocs[1] - allocs[0]      # wave-2 fresh allocs
+
+    eng_ret, wave2_on = run_retention(True)
+    eng_off2, wave2_off = run_retention(False)
+    ret_leg = {
+        "retained_hits": eng_ret.cache.retained_hits,
+        "wave2_fresh_allocs_retained": wave2_on,
+        "wave2_fresh_allocs_unretained": wave2_off,
+        "retained_blocks_now": eng_ret.cache.retained_blocks,
+        "leak_free": eng_ret.cache.free_blocks
+        == eng_ret.cache.num_blocks - 1,
+        "compile_counts": eng_ret.compile_counts(),
+    }
+    ret_ok = (ret_leg["retained_hits"] >= 1
+              and ret_leg["wave2_fresh_allocs_retained"]
+              < ret_leg["wave2_fresh_allocs_unretained"]
+              and ret_leg["leak_free"]
+              and ret_leg["compile_counts"] == {"prefill": 1, "tick": 1})
+
     ok = (cont["completed"] == 8 and stat["completed"] == 8
           and no_retrace and records_ok
           and cont["tokens_per_sec"] > stat["tokens_per_sec"]
           and cont["ticks"] < stat["ticks"]
           and decode_block.get("bound") == "memory"
-          and share_ok and spec_ok and chunk_ok)
+          and share_ok and spec_ok and chunk_ok and quant_ok and ret_ok)
     print(json.dumps({
         "child": "serving", "ok": bool(ok),
         "requests": 8, "max_slots": 4, "block_size": 4,
@@ -1399,6 +1500,8 @@ def run_serving_child():
         "prefix_sharing": {**share_leg, "ok": bool(share_ok)},
         "speculative": {**spec_leg, "ok": bool(spec_ok)},
         "chunked_prefill": {**chunk_leg, "ok": bool(chunk_ok)},
+        "quantization": {**quant_leg, "ok": bool(quant_ok)},
+        "retention": {**ret_leg, "ok": bool(ret_ok)},
         "device": jax.devices()[0].device_kind,
     }))
     return 0 if ok else 1
@@ -1766,13 +1869,16 @@ def run_fleet_child():
 def run_serving_bench_child(max_slots=8, block_size=16, seq_len=1024,
                             dim=512, layers=6, heads=8, vocab=32000,
                             prompt_len=128, warmup_ticks=8,
-                            timed_ticks=64):
+                            timed_ticks=64, kv_dtype=None):
     """The ``transformer_decode`` device metric: fill every slot with a
     long-running request, warm the tick, then time ``timed_ticks``
     compiled decode steps — steady-state serving throughput with the
     paged KV gather on the hot path (the decode-shaped attention auto-
-    selects Pallas on TPU, the XLA gather path elsewhere). Prints one
-    JSON line for the parent."""
+    selects Pallas on TPU, the XLA gather path elsewhere).
+    ``kv_dtype="int8"`` is the ``transformer_decode_int8`` variant
+    (ISSUE 14): the same tick over a quantized pool, so the metric pair
+    answers "what does halving-to-quartering KV HBM traffic buy the
+    memory-bound tick". Prints one JSON line for the parent."""
     from paddle_tpu.models import TransformerLM
     from paddle_tpu.serve import DecodeEngine
 
@@ -1782,7 +1888,7 @@ def run_serving_bench_child(max_slots=8, block_size=16, seq_len=1024,
     vs = model.init(jax.random.PRNGKey(0),
                     jnp.zeros((1, seq_len), jnp.int32))
     eng = DecodeEngine(model, vs, max_slots=max_slots,
-                       block_size=block_size)
+                       block_size=block_size, kv_dtype=kv_dtype)
     rng = np.random.RandomState(0)
     target = prompt_len + warmup_ticks + timed_ticks + 2
     assert target <= eng.context_width
@@ -1797,25 +1903,31 @@ def run_serving_bench_child(max_slots=8, block_size=16, seq_len=1024,
     wall = time.perf_counter() - t0
     tokens = timed_ticks * max_slots
     print(json.dumps({
-        "child": "transformer_decode",
+        "child": ("transformer_decode" if kv_dtype is None
+                  else "transformer_decode_int8"),
         "decode_tokens_per_sec": round(tokens / wall, 2),
         "ms_per_tick": round(wall / timed_ticks * 1e3, 3),
         "max_slots": max_slots, "block_size": block_size,
         "context_width": eng.context_width, "prompt_len": prompt_len,
         "timed_ticks": timed_ticks, "dim": dim, "layers": layers,
         "vocab": vocab, "attention": eng.attention,
+        "kv_dtype": eng.cache.quant_dtype,
+        "kv_bytes_per_token": eng.cache.kv_bytes_per_token,
         "compile_counts": eng.compile_counts(),
         "device": jax.devices()[0].device_kind,
     }))
 
 
-def bench_serving(budget=None):
+def bench_serving(budget=None, kv_dtype=None):
     """Fresh-subprocess wrapper for run_serving_bench_child (one child =
-    one tunnel session, like every other metric)."""
-    budget = budget or PLANS["transformer_decode"]["budget"]
-    r = _spawn_child("transformer_decode", 0, 1, budget)
+    one tunnel session, like every other metric). ``kv_dtype="int8"``
+    runs the quantized-pool variant."""
+    metric = ("transformer_decode" if kv_dtype is None
+              else "transformer_decode_int8")
+    budget = budget or PLANS[metric]["budget"]
+    r = _spawn_child(metric, 0, 1, budget)
     return {
-        "metric": "transformer_decode_tokens_per_sec",
+        "metric": f"{metric}_tokens_per_sec",
         "unit": "tokens/sec",
         "value": r["decode_tokens_per_sec"],
         "ms_per_tick": r["ms_per_tick"],
@@ -1823,6 +1935,8 @@ def bench_serving(budget=None):
         "context_width": r["context_width"],
         "prompt_len": r["prompt_len"], "dim": r["dim"],
         "layers": r["layers"], "attention": r["attention"],
+        "kv_dtype": r["kv_dtype"],
+        "kv_bytes_per_token": r["kv_bytes_per_token"],
         "device": r["device"],
         "baseline": None, "vs_baseline": None,
     }
@@ -2248,7 +2362,8 @@ def bench_scaling(per_device_batch=32, iters=2, steps_per_call=4):
 # committed artifacts are SCALING_r05.json (proxy + analytic projection).
 DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_fused",
                 "transformer_dp_overlap", "transformer_pipelined",
-                "transformer_decode", "transformer_decode_spec",
+                "transformer_decode", "transformer_decode_int8",
+                "transformer_decode_spec",
                 "transformer_big", "lstm", "lstm_h256", "lstm_h1280"]
 
 
@@ -2339,6 +2454,8 @@ def main():
             run_pipelined_child()
         elif metric == "transformer_decode":
             run_serving_bench_child()
+        elif metric == "transformer_decode_int8":
+            run_serving_bench_child(kv_dtype="int8")
         elif metric == "transformer_decode_spec":
             run_serving_spec_bench_child()
         else:
@@ -2350,10 +2467,12 @@ def main():
         print(json.dumps(bench_scaling()))
         return
     if metric in ("transformer_pipelined", "transformer_decode",
-                  "transformer_decode_spec"):
+                  "transformer_decode_int8", "transformer_decode_spec"):
         try:
             out = (bench_pipelined() if metric == "transformer_pipelined"
                    else bench_serving() if metric == "transformer_decode"
+                   else bench_serving(kv_dtype="int8")
+                   if metric == "transformer_decode_int8"
                    else bench_serving_spec())
         except (RuntimeError, subprocess.TimeoutExpired, ValueError,
                 IndexError, KeyError) as e:
@@ -2366,7 +2485,7 @@ def main():
     if metric is not None and metric not in PREPS:
         print(json.dumps(
             {"error": f"unknown metric {metric!r}; choose from "
-                      f"{sorted(PREPS) + ['scaling', 'transformer_pipelined', 'transformer_decode', 'transformer_decode_spec']}"
+                      f"{sorted(PREPS) + ['scaling', 'transformer_pipelined', 'transformer_decode', 'transformer_decode_int8', 'transformer_decode_spec']}"
              }))
         sys.exit(2)
     if metric in PREPS:

@@ -236,8 +236,10 @@ class MultiHeadAttention(Module):
 
         Callable outside forward (the ``scope()`` helper-method pattern):
         the serving engine reaches it via
-        ``model.apply(..., method="decode_step")``."""
-        from ..serve.kv_cache import gather_pages, scatter_token
+        ``model.apply(..., method="decode_step")``. Quantized pools (the
+        ``(int8, scales)`` tuples, ISSUE 14) flow through transparently:
+        the scatter quantizes, the kernel/gather dequantizes."""
+        from ..serve.kv_cache import gather_pages, scatter_token_pages
         with self.scope():
             pol = current_policy()
             d_model = q_in.shape[-1]
@@ -256,10 +258,10 @@ class MultiHeadAttention(Module):
                 k = proj("wk", q_in, h * hd).reshape(S, 1, h, hd)
                 v = proj("wv", q_in, h * hd).reshape(S, 1, h, hd)
             with jax.named_scope("kv_scatter"):
-                pages_k = scatter_token(pages_k, k[:, 0], tables,
-                                        positions, active)
-                pages_v = scatter_token(pages_v, v[:, 0], tables,
-                                        positions, active)
+                pages_k = scatter_token_pages(pages_k, k[:, 0], tables,
+                                              positions, active)
+                pages_v = scatter_token_pages(pages_v, v[:, 0], tables,
+                                              positions, active)
             # the new token sees itself: effective length = position + 1
             eff_len = jnp.where(active, positions + 1, 0)
             if impl == "paged":
@@ -325,19 +327,22 @@ class MultiHeadAttention(Module):
         re-attending a shared prefix must not write co-owned pages).
         Returns ``(out [S, Q, out_d], pages_k, pages_v)``.
 
-        Only ``impl="xla"`` exists: each row is computed by the EXACT
-        q_len=1 broadcast-to-W op sequence (an unrolled loop over the
-        static ``Q``), so every position's output is bit-equal (f32) to
-        what a sequence of single-token :meth:`decode` ticks would have
+        ``impl="xla"``: each row is computed by the EXACT q_len=1
+        broadcast-to-W op sequence (an unrolled loop over the static
+        ``Q``), so every position's output is bit-equal (f32) to what a
+        sequence of single-token :meth:`decode` ticks would have
         produced — the lossless-speculation and chunked-prefill
-        bit-equality guarantees are structural, not tolerances. The
-        paged Pallas kernel is q_len=1-shaped; a multi-query kernel is
-        the ROADMAP follow-up."""
-        from ..serve.kv_cache import gather_pages, scatter_span
-        if impl != "xla":
+        bit-equality guarantees are structural, not tolerances.
+        ``impl="paged"``: the multi-query paged Pallas kernel
+        (:func:`~paddle_tpu.nn.pallas_attention.paged_span_attention`,
+        ISSUE 14) — streams only the slot's own pages instead of the
+        O(W)-per-row gather; tolerance-accurate vs the oracle, bit-equal
+        to the q_len=1 kernel at Q=1. Quantized pools flow through both
+        (scatter quantizes, kernel/gather dequantizes)."""
+        from ..serve.kv_cache import gather_pages, scatter_span_pages
+        if impl not in ("xla", "paged"):
             raise ValueError(
-                f"decode_span supports impl='xla' only (got {impl!r}); "
-                "the paged Pallas kernel is q_len=1-shaped")
+                f"decode_span supports impl='xla'|'paged', got {impl!r}")
         with self.scope():
             pol = current_policy()
             d_model = q_in.shape[-1]
@@ -357,24 +362,33 @@ class MultiHeadAttention(Module):
                 v = proj("wv", q_in, h * hd).reshape(S, Q, h, hd)
             n_eff = jnp.where(active, n, 0)
             with jax.named_scope("kv_scatter"):
-                pages_k = scatter_span(pages_k, k, tables, start, n_eff,
-                                       write_from)
-                pages_v = scatter_span(pages_v, v, tables, start, n_eff,
-                                       write_from)
-            with jax.named_scope("sdpa_xla"):
-                kg = gather_pages(pages_k, tables)      # [S, W, h, hd]
-                vg = gather_pages(pages_v, tables)
-                ctxs = []
-                for j in range(Q):
-                    # row j sees context start+j+1 (itself included);
-                    # later span rows sit beyond the mask, and masked
-                    # logits are the constant -1e9 regardless of page
-                    # content — identical to the sequential tick's view
-                    eff_len = jnp.where(active & (j < n_eff),
-                                        start + j + 1, 0)
-                    ctxs.append(self._sdpa_row(q[:, j:j + 1], kg, vg,
-                                               eff_len, pol, hd))
-                ctx = jnp.concatenate(ctxs, axis=1)     # [S, Q, h, hd]
+                pages_k = scatter_span_pages(pages_k, k, tables, start,
+                                             n_eff, write_from)
+                pages_v = scatter_span_pages(pages_v, v, tables, start,
+                                             n_eff, write_from)
+            if impl == "paged":
+                from .pallas_attention import paged_span_attention
+                with jax.named_scope("paged_span_attention"):
+                    ctx = paged_span_attention(q, pages_k, pages_v,
+                                               tables, start, n_eff)
+                    ctx = ctx.astype(pol.compute_dtype)
+            else:
+                with jax.named_scope("sdpa_xla"):
+                    kg = gather_pages(pages_k, tables)  # [S, W, h, hd]
+                    vg = gather_pages(pages_v, tables)
+                    ctxs = []
+                    for j in range(Q):
+                        # row j sees context start+j+1 (itself
+                        # included); later span rows sit beyond the
+                        # mask, and masked logits are the constant -1e9
+                        # regardless of page content — identical to the
+                        # sequential tick's view
+                        eff_len = jnp.where(active & (j < n_eff),
+                                            start + j + 1, 0)
+                        ctxs.append(self._sdpa_row(q[:, j:j + 1], kg,
+                                                   vg, eff_len, pol,
+                                                   hd))
+                    ctx = jnp.concatenate(ctxs, axis=1)  # [S, Q, h, hd]
             ctx = ctx.reshape(S, Q, h * hd)
             with jax.named_scope("out_proj"):
                 out = proj("wo", ctx, out_d)

@@ -50,7 +50,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention", "reference_attention",
-           "paged_decode_attention", "paged_reference_attention"]
+           "paged_decode_attention", "paged_reference_attention",
+           "paged_span_attention", "paged_span_reference_attention"]
 
 _NEG = -1e30
 
@@ -451,21 +452,36 @@ def _resolve_defaults(q, scale, interpret):
 # decode-shaped attention: q_len = 1 over a paged KV cache (serving path)
 # ---------------------------------------------------------------------------
 
+def _unpack_pages(pages):
+    """``(values, scales)`` for a quantized pool (``serve.kv_cache``'s
+    int8 tuple convention), ``(values, None)`` for a plain one."""
+    if isinstance(pages, tuple):
+        return pages[0], pages[1]
+    return pages, None
+
+
+def _gathered(pages, tables):
+    """Dequantized position-order gather for the reference oracles —
+    the serving pool's own gather, so the oracles can never drift from
+    the XLA serving path's dequant convention."""
+    from ..serve.kv_cache import gather_pages
+    return gather_pages(pages, tables)
+
+
 def paged_reference_attention(q, pages_k, pages_v, tables, lengths,
                               scale: Optional[float] = None):
     """Numeric oracle for :func:`paged_decode_attention` — gather the
-    block-table pages into position order and run masked softmax
-    attention for the single query token. ``q`` ``[S, H, D]``; pages
-    ``[N, bs, H, D]``; ``tables`` ``[S, MB]``; ``lengths`` ``[S]``
-    (0 = inactive slot -> zero output)."""
+    block-table pages into position order (dequantized for int8 pools)
+    and run masked softmax attention for the single query token. ``q``
+    ``[S, H, D]``; pages ``[N, bs, H, D]`` or the quantized
+    ``(int8, scales)`` tuple; ``tables`` ``[S, MB]``; ``lengths``
+    ``[S]`` (0 = inactive slot -> zero output)."""
     S, H, D = q.shape
-    bs = pages_k.shape[1]
-    MB = tables.shape[1]
-    W = MB * bs
     if scale is None:
         scale = D ** -0.5
-    k = pages_k[tables].reshape(S, W, H, D)
-    v = pages_v[tables].reshape(S, W, H, D)
+    k = _gathered(pages_k, tables)
+    v = _gathered(pages_v, tables)
+    W = k.shape[1]
     s = jnp.einsum("shd,skhd->shk", q, k) * scale
     mask = jnp.arange(W)[None] < lengths[:, None]
     s = jnp.where(mask[:, None], s, -jnp.inf)
@@ -474,13 +490,45 @@ def paged_reference_attention(q, pages_k, pages_v, tables, lengths,
     return jnp.einsum("shk,skhd->shd", p, v)
 
 
-def _paged_decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_s, l_s, acc_s, *, scale, bs):
+def paged_span_reference_attention(q, pages_k, pages_v, tables, start, n,
+                                   scale: Optional[float] = None):
+    """Numeric oracle for :func:`paged_span_attention` — per-row masked
+    softmax over the gathered (dequantized) context. ``q``
+    ``[S, Q, H, D]`` (row ``j`` of slot ``s`` sits at position
+    ``start[s] + j``); rows ``>= n[s]`` are padding whose output is
+    unspecified (compare live rows only); ``n == 0`` marks an inactive
+    slot (zero output on every row)."""
+    S, Q, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    k = _gathered(pages_k, tables)            # [S, W, H, D]
+    v = _gathered(pages_v, tables)
+    W = k.shape[1]
+    s = jnp.einsum("sqhd,skhd->sqhk", q, k) * scale
+    k_idx = jnp.arange(W)[None, None, :]
+    # causal within the span: row j sees positions <= start + j
+    vis = (k_idx <= (start[:, None] + jnp.arange(Q)[None, :])[..., None]) \
+        & (n[:, None, None] > 0)
+    s = jnp.where(vis[:, :, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)       # inactive slots
+    return jnp.einsum("sqhk,skhd->sqhd", p, v)
+
+
+def _paged_decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                         scale, bs, quant):
     """One (slot, head) row's online softmax over its block table. Grid
     ``(S, H, MB)``: the innermost axis streams the slot's KV blocks
     (sequential on TPU — the m/l/acc scratch carries across it), with the
     pool block resolved by the PREFETCHED block table in the index map,
-    so the DMA fetches exactly the pages the sequence owns."""
+    so the DMA fetches exactly the pages the sequence owns. With
+    ``quant`` the K/V blocks arrive int8 with per-row scale pages and
+    are dequantized IN VMEM (never in HBM — the whole point of the int8
+    pool is HBM bytes)."""
+    if quant:
+        sk_ref, sv_ref, o_ref, m_s, l_s, acc_s = rest
+    else:
+        o_ref, m_s, l_s, acc_s = rest
     s_idx = pl.program_id(0)
     j = pl.program_id(2)
     nkb = pl.num_programs(2)
@@ -498,7 +546,12 @@ def _paged_decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j * bs < length)
     def _():
         # native-dtype matmul operands + f32 accumulate (see _attn_kernel)
-        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+        if quant:
+            kb = k_ref[:].astype(jnp.float32) * sk_ref[:]
+            vb = v_ref[:].astype(jnp.float32) * sv_ref[:]
+        else:
+            kb, vb = k_ref[:], v_ref[:]
+        s = jax.lax.dot_general(q_ref[:], kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         k_idx = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
         s = jnp.where(k_idx < length, s, _NEG)
@@ -508,7 +561,7 @@ def _paged_decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         corr = jnp.exp(m - m_new)
         l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_s[:] = m_new
 
@@ -533,12 +586,17 @@ def paged_decode_attention(q, pages_k, pages_v, tables, lengths,
     what makes the pool's ragged sharing free.
 
     Args: ``q`` ``[S, H, D]`` (slot-major, one token per slot);
-    ``pages_k``/``pages_v`` ``[N, bs, H, D]`` (one layer's pool);
+    ``pages_k``/``pages_v`` ``[N, bs, H, D]`` (one layer's pool), or the
+    quantized ``(int8 values, scales [N, bs, H])`` tuple — scale pages
+    stream beside the value blocks and dequantization happens in VMEM;
     ``tables`` ``[S, MB]`` int32; ``lengths`` ``[S]`` int32 — the number
     of valid tokens INCLUDING the one just scattered; 0 marks an
     inactive slot (zero output). ``interpret`` defaults to True off-TPU
     (same contract as :func:`flash_attention`)."""
     S, H, D = q.shape
+    pages_k, scale_k = _unpack_pages(pages_k)
+    pages_v, scale_v = _unpack_pages(pages_v)
+    quant = scale_k is not None
     N, bs, Hk, Dk = pages_k.shape
     assert (H, D) == (Hk, Dk), f"q heads {(H, D)} != pages {(Hk, Dk)}"
     MB = tables.shape[1]
@@ -551,14 +609,21 @@ def paged_decode_attention(q, pages_k, pages_v, tables, lengths,
     def kv_map(s, h, j, tbl, lens):
         return (tbl[s, j], 0, h, 0)
 
+    in_specs = [
+        pl.BlockSpec((None, None, 1, D), q_map),
+        pl.BlockSpec((None, bs, None, D), kv_map),
+        pl.BlockSpec((None, bs, None, D), kv_map),
+    ]
+    operands = [q4, pages_k, pages_v]
+    if quant:
+        # trailing unit dim keeps the scale block 2-D ([bs, 1])
+        in_specs += [pl.BlockSpec((None, bs, None, 1), kv_map),
+                     pl.BlockSpec((None, bs, None, 1), kv_map)]
+        operands += [scale_k[..., None], scale_v[..., None]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, H, MB),
-        in_specs=[
-            pl.BlockSpec((None, None, 1, D), q_map),
-            pl.BlockSpec((None, bs, None, D), kv_map),
-            pl.BlockSpec((None, bs, None, D), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, None, 1, D), q_map),
         scratch_shapes=[
             pltpu.VMEM((1, 1), jnp.float32),
@@ -567,13 +632,142 @@ def paged_decode_attention(q, pages_k, pages_v, tables, lengths,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, scale=scale, bs=bs),
+        functools.partial(_paged_decode_kernel, scale=scale, bs=bs,
+                          quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, H, 1, D), q.dtype),
         interpret=interpret,
-    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
-      q4, pages_k, pages_v)
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), *operands)
     return out.reshape(S, H, D)
+
+
+def _paged_span_kernel(tbl_ref, start_ref, n_ref, q_ref, k_ref, v_ref,
+                       *rest, scale, bs, quant):
+    """One (slot, head) SPAN's online softmax over its block table — the
+    q_len = 1+k generalization of :func:`_paged_decode_kernel` (ISSUE
+    14). Grid ``(S, H, MB)`` with the span's ``Q`` rows resident in one
+    VMEM block and per-row online-softmax state ``[Q, 1]``/``[Q, D]``;
+    causality WITHIN the span is a per-element mask (row ``j`` sees
+    positions ``<= start + j``), so the speculative verify tick and
+    chunked prefill stream exactly the pages the slot owns instead of
+    materializing an O(W)-per-row XLA gather."""
+    if quant:
+        sk_ref, sv_ref, o_ref, m_s, l_s, acc_s = rest
+    else:
+        o_ref, m_s, l_s, acc_s = rest
+    Q, d = q_ref.shape
+    s_idx = pl.program_id(0)
+    j = pl.program_id(2)
+    nkb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_s[:] = jnp.full(m_s.shape, _NEG, jnp.float32)
+        l_s[:] = jnp.zeros(l_s.shape, jnp.float32)
+        acc_s[:] = jnp.zeros(acc_s.shape, jnp.float32)
+
+    start = start_ref[s_idx]
+    n = n_ref[s_idx]
+
+    # blocks past the span's last live position are skipped entirely,
+    # and an inactive slot — n == 0 — skips every block regardless of
+    # a stale start and writes zeros (the oracle's convention); block 0
+    # always runs for a live slot, so every live row's softmax state
+    # lifts off the _NEG floor there (row j's own position
+    # start+j >= 0 is always visible)
+    @pl.when((n > 0) & (j * bs < start + n))
+    def _():
+        if quant:
+            kb = k_ref[:].astype(jnp.float32) * sk_ref[:]
+            vb = v_ref[:].astype(jnp.float32) * sv_ref[:]
+        else:
+            kb, vb = k_ref[:], v_ref[:]
+        s = jax.lax.dot_general(q_ref[:], kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_idx = j * bs + jax.lax.broadcasted_iota(jnp.int32, (Q, bs), 1)
+        q_idx = jax.lax.broadcasted_iota(jnp.int32, (Q, bs), 0)
+        s = jnp.where(k_idx <= start + q_idx, s, _NEG)
+        m = m_s[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = m_new
+
+    @pl.when(j == nkb - 1)
+    def _():
+        l = jnp.maximum(l_s[:], 1e-30)
+        o_ref[:] = (acc_s[:] / l).astype(o_ref.dtype)
+
+
+def paged_span_attention(q, pages_k, pages_v, tables, start, n,
+                         scale: Optional[float] = None,
+                         interpret: Optional[bool] = None):
+    """Multi-query (q_len = 1+k) flash attention over a paged KV cache —
+    the span-tick hot op (ISSUE 14). Each slot's span of ``Q``
+    consecutive new-token queries attends its block-table pages with one
+    streamed online softmax per row, causal within the span; the
+    speculative verify tick and chunked prefill ride this instead of the
+    gather-everything XLA path on TPU.
+
+    Args: ``q`` ``[S, Q, H, D]`` (row ``j`` of slot ``s`` sits at
+    position ``start[s] + j``); ``pages_k``/``pages_v`` one layer's pool
+    (plain or the quantized ``(int8, scales)`` tuple — dequantized in
+    VMEM); ``tables`` ``[S, MB]``; ``start``/``n`` ``[S]`` int32 — rows
+    ``>= n[s]`` are padding (finite garbage output the host ignores),
+    ``n == 0`` marks an inactive slot (zero output). At ``Q = 1`` the
+    kernel runs the exact op sequence of
+    :func:`paged_decode_attention` (bit-equal — the greedy-path
+    contract). ``interpret`` defaults to True off-TPU."""
+    S, Q, H, D = q.shape
+    pages_k, scale_k = _unpack_pages(pages_k)
+    pages_v, scale_v = _unpack_pages(pages_v)
+    quant = scale_k is not None
+    N, bs, Hk, Dk = pages_k.shape
+    assert (H, D) == (Hk, Dk), f"q heads {(H, D)} != pages {(Hk, Dk)}"
+    MB = tables.shape[1]
+    scale, interpret = _resolve_defaults(q, scale, interpret)
+    qt = jnp.swapaxes(q, 1, 2)               # [S, H, Q, D]
+
+    def q_map(s, h, j, tbl, st, nn):
+        return (s, h, 0, 0)
+
+    def kv_map(s, h, j, tbl, st, nn):
+        return (tbl[s, j], 0, h, 0)
+
+    in_specs = [
+        pl.BlockSpec((None, None, Q, D), q_map),
+        pl.BlockSpec((None, bs, None, D), kv_map),
+        pl.BlockSpec((None, bs, None, D), kv_map),
+    ]
+    operands = [qt, pages_k, pages_v]
+    if quant:
+        in_specs += [pl.BlockSpec((None, bs, None, 1), kv_map),
+                     pl.BlockSpec((None, bs, None, 1), kv_map)]
+        operands += [scale_k[..., None], scale_v[..., None]]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, H, MB),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, None, Q, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((Q, 1), jnp.float32),
+            pltpu.VMEM((Q, 1), jnp.float32),
+            pltpu.VMEM((Q, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_span_kernel, scale=scale, bs=bs,
+                          quant=quant),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, Q, D), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), start.astype(jnp.int32),
+      n.astype(jnp.int32), *operands)
+    return jnp.swapaxes(out, 1, 2)           # [S, Q, H, D]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))

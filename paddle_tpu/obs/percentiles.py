@@ -90,6 +90,30 @@ def summarize_requests(records: List[Dict[str, Any]]
     accepted = sum(r.get("draft_accepted") or 0 for r in terminal)
     out["draft_accept_rate"] = (round(accepted / proposed, 4)
                                 if proposed else None)
+    # retention + KV-capacity accounting (ISSUE 14): retained-LRU hits
+    # ride the decode_tick stream as per-tick deltas; the hit RATE is
+    # retained hits over all adopted prefix blocks (what fraction of
+    # sharing came from blocks no live sequence held). kv_bytes_per_token
+    # and quant_dtype are gauges — the last tick's value wins.
+    ticks = [r for r in records if r.get("kind") == "decode_tick"]
+    if ticks:
+        rh = sum(r.get("retained_hits") or 0 for r in ticks)
+        out["retained_hits"] = rh
+        # rate over the SAME stream's adoption deltas (tick records
+        # carry prefix_hit_blocks deltas too) — a terminal-record
+        # denominator would mix attempt populations and could exceed 1
+        tick_hits = sum(r.get("prefix_hit_blocks") or 0 for r in ticks)
+        out["retention_hit_rate"] = (round(rh / tick_hits, 4)
+                                     if tick_hits else None)
+        out["retained_blocks"] = next(
+            (r.get("retained_blocks") for r in reversed(ticks)
+             if r.get("retained_blocks") is not None), None)
+        out["kv_bytes_per_token"] = next(
+            (r.get("kv_bytes_per_token") for r in reversed(ticks)
+             if r.get("kv_bytes_per_token") is not None), None)
+        out["quant_dtype"] = next(
+            (r.get("quant_dtype") for r in reversed(ticks)
+             if r.get("quant_dtype") is not None), None)
     dl = [r for r in terminal if r.get("deadline_s") is not None]
     met = [r for r in dl
            if r.get("finish_reason") in GOODPUT_REASONS

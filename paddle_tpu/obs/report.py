@@ -232,6 +232,18 @@ def format_summary(s: Dict[str, Any]) -> str:
         if sv.get("prefill_chunks"):
             lines.append(f"  {'prefill chunks':<28}"
                          f"{sv['prefill_chunks']}")
+        # retention + KV-capacity rows (ISSUE 14): rendered when the
+        # retained LRU actually served hits / the stream carries the
+        # pool's byte accounting
+        if sv.get("retained_hits"):
+            lines.append(f"  {'retained prefix hits':<28}"
+                         f"{sv['retained_hits']} blocks "
+                         f"(rate {sv.get('retention_hit_rate')}, "
+                         f"{sv.get('retained_blocks')} retained now)")
+        if sv.get("kv_bytes_per_token") is not None:
+            lines.append(f"  {'KV bytes/token':<28}"
+                         f"{sv['kv_bytes_per_token']} "
+                         f"({sv.get('quant_dtype')})")
     # autoscaler decisions (ISSUE 13) — rendered whenever scale events
     # exist, even for a stream with no request records
     sc = (sv or {}).get("scale")

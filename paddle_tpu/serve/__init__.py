@@ -47,7 +47,10 @@ doctrine):
 
 from .kv_cache import (BlockAllocator, PagedKVCache, PrefixCache,
                        PrefixMatch, gather_pages, scatter_prefill,
-                       scatter_token, scatter_span)
+                       scatter_token, scatter_span,
+                       scatter_prefill_pages, scatter_token_pages,
+                       scatter_span_pages, quantize_rows,
+                       dequantize_rows)
 from .engine import AdmitProbe, DecodeEngine, SamplingConfig
 from .scheduler import ContinuousBatchingScheduler, Request
 from .router import FleetRouter, RouteDecision
@@ -63,6 +66,8 @@ __all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "PrefixMatch",
            "DecodeEngine", "AdmitProbe", "SamplingConfig",
            "ContinuousBatchingScheduler", "Request", "gather_pages",
            "scatter_prefill", "scatter_token", "scatter_span",
+           "scatter_prefill_pages", "scatter_token_pages",
+           "scatter_span_pages", "quantize_rows", "dequantize_rows",
            "FleetRouter", "RouteDecision", "ServingFleet",
            "ReplicaWorker", "ProcReplicaWorker", "FleetRequest",
            "build_proc_spec",

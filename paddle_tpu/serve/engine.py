@@ -42,10 +42,27 @@ Sampling is greedy (argmax) by default — deterministic, which is what
 lets the serve tests pin engine output against the training forward
 bit-for-bit. ``sampling=SamplingConfig(...)`` switches the tick to
 seeded stochastic sampling (temperature / top-k / top-p with per-slot,
-per-tick PRNG keys); it composes with sharing and chunked prefill but
-not with speculation (the verify rule is greedy-exact — lossless
-stochastic verification is the Leviathan rejection-sampling follow-up,
-PAPERS.md [S3]).
+per-tick PRNG keys); it composes with sharing, chunked prefill AND
+speculation — stochastic verification uses the Leviathan
+rejection-sampling rule (PAPERS.md [S3], ISSUE 14): a drafted token
+``d`` with filtered target probability ``p(d)`` is accepted with
+probability ``p(d)`` (the draft distribution is a point mass, so the
+accept ratio ``min(1, p/q)`` reduces to ``p(d)``); on rejection the
+token resamples from the residual ``norm(max(p - q, 0))`` — ``p`` with
+``d`` excluded — which preserves the target distribution EXACTLY by
+the standard [S3] argument. Acceptance randomness rides the same
+per-slot ``fold_in`` key tree as plain sampling, so a fixed seed
+replays the identical token stream.
+
+**Int8 KV quantization** (``kv_dtype="int8"``, ISSUE 14): the pools
+store int8 values plus per-row-per-head scale pages; scatters quantize,
+the attention kernels dequantize in VMEM (the XLA path in the gather).
+Roughly 3-4x the resident sequences per HBM byte at a measured logit
+drift bound — the serving bench gate pins >= 99% greedy token
+agreement vs the f32 pool on its gate set. **Radix retention** rides
+the prefix cache (see ``kv_cache``): evicted registered blocks park in
+a retained LRU and later same-prefix admissions hit them without any
+concurrently-resident sharer.
 """
 
 from __future__ import annotations
@@ -58,7 +75,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .kv_cache import PagedKVCache, scatter_prefill
+from .kv_cache import PagedKVCache, scatter_prefill_pages
 
 __all__ = ["DecodeEngine", "AdmitProbe", "SamplingConfig"]
 
@@ -70,12 +87,18 @@ class AdmitProbe:
     eviction (queue briefly), ``"blocks"`` is KV-pool saturation that can
     persist for a straggler's whole lifetime (prefer another replica, or
     shed), ``"width"`` can never clear (reject). ``ok`` mirrors the old
-    boolean ``can_admit`` answer."""
+    boolean ``can_admit`` answer. ``free_blocks`` counts RECLAIMABLE
+    capacity (genuinely free + retained-LRU blocks — ISSUE 14: a probe
+    on raw free alone undercounts and sheds spuriously under
+    retention); ``raw_free_blocks`` keeps the eager-free number and
+    ``retained_blocks`` the difference's provenance."""
     ok: bool
     reason: Optional[str]          # None | "width" | "slots" | "blocks"
     blocks_needed: int
     free_blocks: int
     free_slots: int
+    raw_free_blocks: int = 0
+    retained_blocks: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,24 +125,33 @@ class SamplingConfig:
             raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
 
 
-def _sample_tokens(cfg: SamplingConfig, logits, keys):
-    """Traced sampler: ``logits [S, V]``, ``keys [S, 2]`` -> ``[S]``
-    int32. Top-k keeps the k highest logits; top-p keeps the smallest
+def _filter_logits(cfg: SamplingConfig, logits):
+    """Temperature -> top-k -> top-p filtering over the LAST axis (any
+    leading shape): the filtered logits define the target distribution
+    ``p`` both plain sampling and the [S3] accept/resample rule draw
+    from. Top-k keeps the k highest logits; top-p keeps the smallest
     descending-probability set whose mass reaches p (the head token
     always survives both)."""
     x = logits.astype(jnp.float32) / cfg.temperature
     if cfg.top_k is not None:
-        kth = jnp.sort(x, axis=-1)[:, -cfg.top_k][:, None]
+        kth = jnp.sort(x, axis=-1)[..., -cfg.top_k][..., None]
         x = jnp.where(x >= kth, x, -jnp.inf)
     if cfg.top_p is not None:
-        sorted_x = jnp.sort(x, axis=-1)[:, ::-1]
+        sorted_x = jnp.sort(x, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_x, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # keep entries whose PRECEDING cumulative mass is < p (the
         # first token always survives); find the cutoff logit value
         keep = (cum - probs) < cfg.top_p
         cutoff = jnp.min(jnp.where(keep, sorted_x, jnp.inf), axis=-1)
-        x = jnp.where(x >= cutoff[:, None], x, -jnp.inf)
+        x = jnp.where(x >= cutoff[..., None], x, -jnp.inf)
+    return x
+
+
+def _sample_tokens(cfg: SamplingConfig, logits, keys):
+    """Traced sampler: ``logits [S, V]``, ``keys [S, 2]`` -> ``[S]``
+    int32 draws from the filtered target distribution."""
+    x = _filter_logits(cfg, logits)
     return jax.vmap(jax.random.categorical)(keys, x).astype(jnp.int32)
 
 
@@ -158,14 +190,22 @@ class DecodeEngine:
         ``model.max_len // block_size``, and must keep the capacity
         within ``model.max_len`` — positions are embedded).
       attention: ``"auto" | "paged" | "xla"`` — see
-        :func:`_resolve_attention`. Speculation forces the span path,
-        which is XLA-only today.
+        :func:`_resolve_attention`. The span path (speculation /
+        chunked prefill) follows the same choice: the multi-query paged
+        kernel on TPU, the bit-exact XLA gather path elsewhere
+        (ISSUE 14).
       share_prefix: copy-on-write physical block sharing between
         resident sequences with a common prompt prefix (default ON —
         the PagedAttention production win, ISSUE 12).
+      retain_prefix: RadixAttention-style retention (ISSUE 14, needs
+        ``share_prefix``): evicted registered blocks park in a
+        retained LRU (lazily reclaimed under pool pressure) so
+        SEQUENTIAL same-prefix requests hit too, not just
+        concurrently-resident ones.
       speculative: number of n-gram self-drafted tokens verified per
-        tick (0 = off). Greedy-lossless by construction; incompatible
-        with ``sampling``.
+        tick (0 = off). Greedy verification is lossless by
+        construction; with ``sampling`` the [S3] rejection-sampling
+        rule keeps the output distribution exact.
       prefill_chunk: prefill chunk width C (None = legacy one-shot
         full-width prefill). Long prompts prefill in ``ceil(P/C)``
         calls the scheduler interleaves between decode ticks.
@@ -173,20 +213,26 @@ class DecodeEngine:
         (None = greedy).
       telemetry: optional :class:`paddle_tpu.obs.Telemetry`; the engine
         emits one ``kind="decode_tick"`` record per tick (dispatch wall,
-        active slots, tokens/sec, sharing/speculation counters) and the
+        active slots, tokens/sec, sharing/speculation/retention
+        counters, ``kv_bytes_per_token``/``quant_dtype``) and the
         scheduler adds per-request records through the same object.
       dtype: KV pool dtype. f32 default matches the projections' f32
         accumulation under both the f32 and bf16-compute policies.
+      kv_dtype: ``None``/``"f32"`` (pools at ``dtype``) or ``"int8"`` —
+        quantized pools with per-row-per-head scale pages (ISSUE 14):
+        ~4x fewer HBM bytes per resident token, dequantized in-kernel.
     """
 
     def __init__(self, model, variables, *, max_slots: int = 4,
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  max_blocks_per_seq: Optional[int] = None,
                  attention: str = "auto", share_prefix: bool = True,
+                 retain_prefix: bool = True,
                  speculative: int = 0,
                  prefill_chunk: Optional[int] = None,
                  sampling: Optional[SamplingConfig] = None,
-                 telemetry=None, dtype=jnp.float32):
+                 telemetry=None, dtype=jnp.float32,
+                 kv_dtype: Optional[str] = None):
         self.model = model
         self.variables = variables
         self.telemetry = telemetry
@@ -194,12 +240,6 @@ class DecodeEngine:
         if speculative < 0:
             raise ValueError(f"speculative must be >= 0, "
                              f"got {speculative}")
-        if speculative and sampling is not None:
-            raise ValueError(
-                "speculative decoding verifies greedily (lossless by "
-                "construction) and cannot compose with sampling= — "
-                "lossless stochastic verification is the [S3] "
-                "rejection-sampling follow-up (ROADMAP)")
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, "
                              f"got {prefill_chunk}")
@@ -223,7 +263,8 @@ class DecodeEngine:
         self.cache = PagedKVCache(
             num_layers, num_heads, head_dim, num_blocks, block_size,
             max_slots=max_slots, max_blocks_per_seq=max_blocks_per_seq,
-            dtype=dtype, share_prefix=share_prefix)
+            dtype=dtype, share_prefix=share_prefix, kv_dtype=kv_dtype,
+            retain_prefix=retain_prefix)
         self.max_slots = max_slots
         # host-authoritative slot state beside the cache's tables/lengths
         self.active = np.zeros((max_slots,), bool)
@@ -268,12 +309,10 @@ class DecodeEngine:
                 # ids [1, W] padded; length/start [1]; table [1, MB]
                 logits, (ks, vs) = model.apply(variables, ids,
                                                method="prefill")
-                scat = jax.vmap(scatter_prefill,
+                scat = jax.vmap(scatter_prefill_pages,
                                 in_axes=(0, 0, None, None, None))
-                pages_k = scat(pages_k, ks.astype(pages_k.dtype), table,
-                               length, start)
-                pages_v = scat(pages_v, vs.astype(pages_v.dtype), table,
-                               length, start)
+                pages_k = scat(pages_k, ks, table, length, start)
+                pages_v = scat(pages_v, vs, table, length, start)
                 last = jnp.take_along_axis(
                     logits, (length - 1)[:, None, None], axis=1)[0, 0]
                 return pages_k, pages_v, first_token(last, key)
@@ -287,7 +326,7 @@ class DecodeEngine:
                 # (shared-prefix rows are co-owned — never rewritten)
                 logits, (pages_k, pages_v, _) = model.apply(
                     variables, ids, (pages_k, pages_v, table), start, n,
-                    jnp.ones((1,), bool), attn_impl="xla",
+                    jnp.ones((1,), bool), attn_impl=attn_impl,
                     write_from=write_from, method="decode_span")
                 last = jnp.take_along_axis(
                     logits, (n - 1)[:, None, None], axis=1)[0, 0]
@@ -304,17 +343,55 @@ class DecodeEngine:
                 else:
                     nxt = _sample_tokens(cfg, logits, keys)
                 return pages_k, pages_v, nxt[:, None]
-        else:
+        elif cfg is None:
             def tick_fn(variables, pages_k, pages_v, tables, lengths,
                         tokens, n, active):
                 # tokens [S, 1+k]: pending + drafts; ONE span dispatch
                 # verifies every draft (greedy argmax per row)
                 logits, (pages_k, pages_v, _) = model.apply(
                     variables, tokens, (pages_k, pages_v, tables),
-                    lengths, n, active, attn_impl="xla",
+                    lengths, n, active, attn_impl=attn_impl,
                     method="decode_span")
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return pages_k, pages_v, nxt        # [S, 1+k]
+        else:
+            def tick_fn(variables, pages_k, pages_v, tables, lengths,
+                        tokens, n, active, keys):
+                # stochastic speculation, the [S3] rejection rule: for
+                # draft row j the proposal distribution is a point mass
+                # at tokens[:, j+1], so accept with prob p_j(draft) and
+                # resample rejections from p_j with the draft excluded
+                # (= norm(max(p - q, 0))) — distribution-preserving by
+                # construction. All three verdict arrays are computed in
+                # ONE dispatch; the host walks the accept prefix.
+                logits, (pages_k, pages_v, _) = model.apply(
+                    variables, tokens, (pages_k, pages_v, tables),
+                    lengths, n, active, attn_impl=attn_impl,
+                    method="decode_span")
+                x = _filter_logits(cfg, logits)     # [S, 1+k, V]
+                p = jax.nn.softmax(x, axis=-1)
+                Q = x.shape[1]
+                # per-row keys: fold the row index into the slot key,
+                # then a role constant (0 = accept-u, 1 = resample,
+                # 2 = bonus sample) — seeded-deterministic replay
+                rows = jnp.arange(Q)
+                rk = jax.vmap(lambda key: jax.vmap(
+                    lambda r: jax.random.fold_in(key, r))(rows))(keys)
+                role = lambda c: jax.vmap(jax.vmap(
+                    lambda kk: jax.random.fold_in(kk, c)))(rk)
+                u = jax.vmap(jax.vmap(jax.random.uniform))(role(0))
+                drafts = tokens[:, 1:]              # [S, k]
+                p_draft = jnp.take_along_axis(
+                    p[:, :-1], drafts[..., None], axis=-1)[..., 0]
+                accept = u[:, :-1] < p_draft        # [S, k]
+                res_x = jnp.where(
+                    jax.nn.one_hot(drafts, x.shape[-1], dtype=bool),
+                    -jnp.inf, x[:, :-1])
+                resample = jax.vmap(jax.vmap(jax.random.categorical))(
+                    role(1)[:, :-1], res_x).astype(jnp.int32)
+                bonus = jax.vmap(jax.vmap(jax.random.categorical))(
+                    role(2), x).astype(jnp.int32)   # [S, 1+k]
+                return pages_k, pages_v, accept, resample, bonus
 
         # donate the KV pools: the tick's carry flips between two
         # allocations instead of growing HBM per token
@@ -322,9 +399,12 @@ class DecodeEngine:
         self._tick_fn = jax.jit(tick_fn, donate_argnums=(1, 2))
         # COW block copy: [L, bs, H, hd] pages move pool-internally, one
         # tiny donated program (not an engine entry point — not counted
-        # in compile_counts, traced once for the process lifetime)
+        # in compile_counts, traced once for the process lifetime).
+        # tree_map covers the quantized (values, scales) tuple pools —
+        # a fork copies the scale page with its value page.
         self._cow_fn = jax.jit(
-            lambda pages, src, dst: pages.at[:, dst].set(pages[:, src]),
+            lambda pages, src, dst: jax.tree_util.tree_map(
+                lambda p: p.at[:, dst].set(p[:, src]), pages),
             donate_argnums=(0,))
         self._zero_keys = jnp.zeros((max_slots, 2), jnp.uint32)
         seed = sampling.seed if sampling is not None else 0
@@ -365,21 +445,28 @@ class DecodeEngine:
         can't cover the worst-case reservation). Deliberately ignores
         prefix-cache hits: the probe is the conservative no-sharing
         bound, so an admitted request can never strand mid-decode even
-        if every co-owner forks."""
+        if every co-owner forks. The blocks check runs against
+        RECLAIMABLE capacity — free plus retained-LRU blocks (ISSUE 14:
+        retained blocks are one lazy reclaim away from free; probing
+        raw ``num_free`` alone would report ``"blocks"`` backpressure,
+        and shed, against capacity the pool actually has)."""
         blocks_needed = self.cache.blocks_needed(total_len)
         free_slots = len(self.free_slots())
+        reclaimable = self.cache.free_blocks      # free + retained
         if total_len > self._W:
             reason = "width"
         elif include_slots and free_slots == 0:
             reason = "slots"
-        elif blocks_needed > self.cache.free_blocks:
+        elif blocks_needed > reclaimable:
             reason = "blocks"
         else:
             reason = None
         return AdmitProbe(ok=reason is None, reason=reason,
                           blocks_needed=blocks_needed,
-                          free_blocks=self.cache.free_blocks,
-                          free_slots=free_slots)
+                          free_blocks=reclaimable,
+                          free_slots=free_slots,
+                          raw_free_blocks=self.cache.allocator.num_free,
+                          retained_blocks=self.cache.retained_blocks)
 
     def can_admit(self, total_len: int) -> bool:
         """Whether the pool can host a sequence that may grow to
@@ -630,6 +717,7 @@ class DecodeEngine:
         n = self._pre_tick_guard()
         tables, lengths = self.cache.device_tables()
         drafted_tick, accepted_tick = 0, 0
+        stochastic = self.speculative > 0 and self.sampling is not None
         if self.speculative == 0:
             if self.sampling is None:
                 keys = self._zero_keys      # greedy: unused operand
@@ -646,10 +734,18 @@ class DecodeEngine:
                 toks[slot, 0] = self.tokens[slot]
                 toks[slot, 1:] = drafts
                 drafted_tick += int(n[slot]) - 1
-            self.cache.k, self.cache.v, nxt = self._tick_fn(
-                self.variables, self.cache.k, self.cache.v, tables,
-                lengths, jnp.asarray(toks), jnp.asarray(n),
-                jnp.asarray(self.active))
+            if stochastic:
+                self.cache.k, self.cache.v, acc_d, res_d, bon_d = \
+                    self._tick_fn(
+                        self.variables, self.cache.k, self.cache.v,
+                        tables, lengths, jnp.asarray(toks),
+                        jnp.asarray(n), jnp.asarray(self.active),
+                        self._tick_keys(self.ticks))
+            else:
+                self.cache.k, self.cache.v, nxt = self._tick_fn(
+                    self.variables, self.cache.k, self.cache.v, tables,
+                    lengths, jnp.asarray(toks), jnp.asarray(n),
+                    jnp.asarray(self.active))
         # the dispatch is async: host bookkeeping that doesn't need the
         # sampled tokens runs UNDER the in-flight device call (the PR-3
         # overlap move at tick scale) — the plain tick advances every
@@ -659,13 +755,33 @@ class DecodeEngine:
         n_active = int(self.active.sum())
         if self.speculative == 0:
             self.cache.lengths[self.active] += 1
-        nxt = np.asarray(nxt)                    # [S, 1] or [S, 1+k]
+        if stochastic:
+            acc_d, res_d, bon_d = (np.asarray(acc_d), np.asarray(res_d),
+                                   np.asarray(bon_d))
+        else:
+            nxt = np.asarray(nxt)                # [S, 1] or [S, 1+k]
         self.last_accepted = {}
         front = np.zeros((self.max_slots,), np.int32)
         tokens_tick = 0
         for slot in np.flatnonzero(self.active):
             if self.speculative == 0:
                 accepted = [int(nxt[slot, 0])]
+            elif stochastic:
+                # [S3] walk: accept drafts while the per-row coin lands
+                # under p(draft); the stopping row's token is the
+                # residual resample, or the bonus sample from the last
+                # live row when every draft survived
+                live = int(n[slot])
+                take = 0
+                while take < live - 1 and bool(acc_d[slot, take]):
+                    take += 1
+                accepted = [int(toks[slot, j + 1]) for j in range(take)]
+                if take < live - 1:
+                    accepted.append(int(res_d[slot, take]))
+                else:
+                    accepted.append(int(bon_d[slot, live - 1]))
+                accepted_tick += take
+                self.cache.lengths[slot] += len(accepted)
             else:
                 # accept the longest draft prefix the model reproduced,
                 # plus the model's own token after it — identical to
@@ -700,7 +816,8 @@ class DecodeEngine:
             # same way — sum over records — with no cumulative mix-ins
             snap = {"prefix_hit_blocks": self.cache.prefix_hit_blocks,
                     "cow_forks": self.cache.cow_forks,
-                    "prefill_chunks": self.prefill_chunks}
+                    "prefill_chunks": self.prefill_chunks,
+                    "retained_hits": self.cache.retained_hits}
             delta = {key: val - self._tick_counters.get(key, 0)
                      for key, val in snap.items()}
             self._tick_counters = snap
@@ -713,6 +830,11 @@ class DecodeEngine:
                 "free_blocks": self.cache.free_blocks,
                 "draft_accept_rate": round(accepted_tick / drafted_tick,
                                            4) if drafted_tick else None,
+                # gauges, not per-tick deltas: the retained-LRU size and
+                # the pool's capacity accounting (ISSUE 14)
+                "retained_blocks": self.cache.retained_blocks,
+                "kv_bytes_per_token": self.cache.kv_bytes_per_token,
+                "quant_dtype": self.cache.quant_dtype,
                 **delta,
             })
         return self.tokens.copy()
@@ -737,12 +859,15 @@ class DecodeEngine:
                 lengths, jnp.asarray(self.tokens),
                 jnp.asarray(self.active), keys)
         else:
-            lowered = self._tick_fn.lower(
-                self.variables, self.cache.k, self.cache.v, tables,
-                lengths,
-                jnp.zeros((self.max_slots, self._K1), jnp.int32),
-                jnp.ones((self.max_slots,), jnp.int32),
-                jnp.asarray(self.active))
+            span_args = (self.variables, self.cache.k, self.cache.v,
+                         tables, lengths,
+                         jnp.zeros((self.max_slots, self._K1), jnp.int32),
+                         jnp.ones((self.max_slots,), jnp.int32),
+                         jnp.asarray(self.active))
+            if self.sampling is not None:   # stochastic verify: + keys
+                span_args += (jnp.zeros((self.max_slots, 2),
+                                        jnp.uint32),)
+            lowered = self._tick_fn.lower(*span_args)
         compiled = lowered.compile()
         analysis = hloprof.parse_module(compiled.as_text())
         report = attr_lib.build_report(

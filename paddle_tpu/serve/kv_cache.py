@@ -41,6 +41,30 @@ returns to the free list exactly once, when its LAST owner lets go, and
 its cache entries are invalidated at that same moment — sharing is
 between concurrently-resident sequences, so churn can never serve stale
 pool bytes.
+
+**Int8 KV quantization** (ISSUE 14, the capacity lever): with
+``kv_dtype="int8"`` each pool stores symmetric int8 values plus a
+per-block scale page ``[L, num_blocks, block_size, H]`` (one f32 scale
+per token row per head — scales live at block granularity beside the
+pools, per-row within the block so incremental scatters NEVER requantize
+resident tokens). Quantization happens on scatter
+(:func:`quantize_rows` inside the ``*_pages`` wrappers) and
+dequantization inside the consumer — the Pallas kernels rescale blocks
+in VMEM, the XLA path in :func:`gather_pages` — so HBM holds ~1 byte
+per KV element instead of 4 and resident capacity roughly triples at
+equal pool bytes (``kv_bytes_per_token`` is the exact accounting).
+
+**Radix retention** (ISSUE 14, RadixAttention [S4]): with sharing on, a
+prefix-cache-registered block whose LAST owner decrefs moves to a
+**retained LRU** instead of the free list — its cache entries stay
+valid, so a follow-up request with the same prompt prefix hits even
+when no live sequence shares it. Retained blocks are RECLAIMABLE
+capacity: ``alloc`` recycles them lazily (oldest first) only when the
+free list runs dry, invalidating their entries at that moment, and
+``free_blocks``/``admit_probe`` count ``free + retained`` so
+backpressure and the autoscaler's load signal never shed against
+capacity that one reclaim away exists. Adopting a retained block
+increfs it straight out of the LRU (a *retained hit*).
 """
 
 from __future__ import annotations
@@ -54,7 +78,9 @@ import jax.numpy as jnp
 
 __all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "PrefixMatch",
            "gather_pages", "scatter_prefill", "scatter_token",
-           "scatter_span", "NULL_BLOCK"]
+           "scatter_span", "scatter_prefill_pages", "scatter_token_pages",
+           "scatter_span_pages", "quantize_rows", "dequantize_rows",
+           "NULL_BLOCK"]
 
 # block 0 never holds live data: it is the scatter target for padding rows
 # and the gather source for unallocated table entries (always masked)
@@ -65,13 +91,40 @@ NULL_BLOCK = 0
 # device side: jnp-pure gather/scatter (called from compiled programs)
 # ---------------------------------------------------------------------------
 
+def quantize_rows(kv):
+    """Symmetric per-row-per-head int8 quantization of KV projections:
+    ``kv [..., hd]`` f32 -> ``(int8 [..., hd], scale [...])`` with
+    ``scale = amax/127`` over the head_dim (the finest granularity that
+    needs no requantization when later tokens land in the same block —
+    the scale-granularity decision, DESIGN_DECISIONS PR-14)."""
+    amax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(kv / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequantize_rows(q, scale):
+    """Inverse of :func:`quantize_rows`: ``int8 [..., hd] * scale [...]``
+    -> f32 values."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
 def gather_pages(pages, table):
     """Gather one layer's paged K (or V) into position order.
 
     ``pages`` ``[N, bs, H, hd]``, ``table`` ``[S, MB]`` int32 ->
     ``[S, MB*bs, H, hd]``: row ``s``'s tokens ``0..len-1`` in order, with
     unspecified (null-block / stale) content beyond the sequence length —
-    the attention mask owns that boundary."""
+    the attention mask owns that boundary. A quantized pool — the tuple
+    ``(int8 values, scales [N, bs, H])`` — gathers DEQUANTIZED f32
+    values, so every consumer downstream of the gather is
+    dtype-oblivious."""
+    if isinstance(pages, tuple):
+        vals, scales = pages
+        S, MB = table.shape
+        _, bs, H, hd = vals.shape
+        deq = dequantize_rows(vals[table], scales[table])
+        return deq.reshape(S, MB * bs, H, hd)
     S, MB = table.shape
     _, bs, H, hd = pages.shape
     return pages[table].reshape(S, MB * bs, H, hd)
@@ -143,6 +196,42 @@ def scatter_span(pages, kv, table, start, n, write_from=None):
     return pages.at[blk, off].set(kv)
 
 
+# quant-aware scatter wrappers: a plain pool scatters values as-is; a
+# quantized pool (the (values, scales) tuple) quantizes on scatter —
+# int8 rows into the value pages, per-row-per-head scales into the
+# scale pages with the SAME block/offset routing (the scatter functions
+# above are shape-agnostic past the [blocks, block_size] prefix).
+
+def scatter_prefill_pages(pages, kv, table, length, start=0):
+    if isinstance(pages, tuple):
+        vals, scales = pages
+        q, s = quantize_rows(kv)
+        return (scatter_prefill(vals, q, table, length, start),
+                scatter_prefill(scales, s, table, length, start))
+    return scatter_prefill(pages, kv.astype(pages.dtype), table, length,
+                           start)
+
+
+def scatter_token_pages(pages, kv, table, position, active):
+    if isinstance(pages, tuple):
+        vals, scales = pages
+        q, s = quantize_rows(kv)
+        return (scatter_token(vals, q, table, position, active),
+                scatter_token(scales, s, table, position, active))
+    return scatter_token(pages, kv.astype(pages.dtype), table, position,
+                         active)
+
+
+def scatter_span_pages(pages, kv, table, start, n, write_from=None):
+    if isinstance(pages, tuple):
+        vals, scales = pages
+        q, s = quantize_rows(kv)
+        return (scatter_span(vals, q, table, start, n, write_from),
+                scatter_span(scales, s, table, start, n, write_from))
+    return scatter_span(pages, kv.astype(pages.dtype), table, start, n,
+                        write_from)
+
+
 # ---------------------------------------------------------------------------
 # host side: allocation / free (between-tick bookkeeping, never traced)
 # ---------------------------------------------------------------------------
@@ -158,13 +247,33 @@ class BlockAllocator:
     donor's block instead of allocating, and ``decref`` returns a block
     to the free list exactly once — when its LAST owner drops it. The
     legacy ``free`` is a decref loop, so single-owner code paths keep
-    their exact historical behavior."""
+    their exact historical behavior.
+
+    **Retention** (ISSUE 14): ``decref(block, retain=True)`` parks a
+    last-owner block in the retained LRU instead of freeing it — its KV
+    pages stay addressable through the prefix cache for future
+    admissions. Retained blocks are reclaimable capacity, not leaks:
+    ``alloc`` recycles them lazily (oldest retained first, after the
+    genuinely-free list) through ``reclaim_hook`` so the owning cache
+    invalidates their entries at exactly the recycle moment, and every
+    retained block still lands on the free list exactly once per
+    retention episode. ``incref`` of a retained block REVIVES it out of
+    the LRU at refcount 1 (the retained-hit path)."""
 
     def __init__(self, num_blocks: int):
         assert num_blocks >= 2, "need at least one non-null block"
         self.num_blocks = num_blocks
         self._free = collections.deque(range(1, num_blocks))
         self._rc: Dict[int, int] = {}
+        # retained LRU: block -> True, insertion-ordered (oldest first);
+        # rc == 0 for every member, but the block is NOT on the free
+        # list — the prefix cache still maps its content
+        self._retained: "collections.OrderedDict[int, bool]" = \
+            collections.OrderedDict()
+        # invoked with a block id the moment a retained block is
+        # recycled onto the free list (the cache invalidation weld)
+        self.reclaim_hook = None
+        self.retained_reclaims = 0
         # cumulative alloc counter: the "fresh blocks" denominator the
         # sharing tests/bench diff against (adoptions don't bump it)
         self.total_allocs = 0
@@ -173,32 +282,63 @@ class BlockAllocator:
     def num_free(self) -> int:
         return len(self._free)
 
+    @property
+    def num_retained(self) -> int:
+        return len(self._retained)
+
+    @property
+    def reclaimable(self) -> int:
+        """The pool's REAL spare capacity: free plus lazily-reclaimable
+        retained blocks — what admission/backpressure must count."""
+        return len(self._free) + len(self._retained)
+
+    def is_retained(self, block: int) -> bool:
+        return block in self._retained
+
     def ref_count(self, block: int) -> int:
-        """Current owner count (0 for free blocks)."""
+        """Current owner count (0 for free and retained blocks)."""
         return self._rc.get(block, 0)
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """Pop ``n`` block ids at refcount 1, or None (and no change)
-        if unavailable."""
-        if n > len(self._free):
+        if unavailable. The free list serves first (FIFO — churn stays
+        deterministic); under pressure, retained blocks are reclaimed
+        oldest-first, each invalidated via ``reclaim_hook`` as it is
+        recycled."""
+        if n > self.reclaimable:
             return None
+        while len(self._free) < n:
+            blk, _ = self._retained.popitem(last=False)   # LRU victim
+            if self.reclaim_hook is not None:
+                self.reclaim_hook(blk)
+            self._free.append(blk)
+            self.retained_reclaims += 1
         got = [self._free.popleft() for _ in range(n)]
         for b in got:
             self._rc[b] = 1
         self.total_allocs += len(got)
         return got
 
-    def incref(self, block: int) -> None:
+    def incref(self, block: int) -> bool:
         """Adopt an allocated block (a prefix-cache hit: one more owner
-        of the same physical pages)."""
+        of the same physical pages). A RETAINED block revives out of the
+        LRU at refcount 1; returns True exactly for that case (the
+        retained-hit signal the telemetry counts)."""
         assert block != NULL_BLOCK, "cannot adopt the null block"
+        if block in self._retained:
+            del self._retained[block]
+            self._rc[block] = 1
+            return True
         assert self._rc.get(block, 0) > 0, \
             f"incref of unallocated block {block}"
         self._rc[block] += 1
+        return False
 
-    def decref(self, block: int) -> bool:
+    def decref(self, block: int, retain: bool = False) -> bool:
         """Drop one ownership; returns True when this was the LAST owner
-        and the block went back on the free list."""
+        and the block left the refcounted set — onto the free list, or
+        into the retained LRU with ``retain=True`` (prefix-registered
+        blocks whose KV should outlive the sequence)."""
         assert block != NULL_BLOCK, "cannot free the null block"
         rc = self._rc.get(block, 0)
         assert rc > 0, f"decref of free block {block} (double free)"
@@ -206,7 +346,10 @@ class BlockAllocator:
             self._rc[block] = rc - 1
             return False
         del self._rc[block]
-        self._free.append(block)
+        if retain:
+            self._retained[block] = True
+        else:
+            self._free.append(block)
         return True
 
     def free(self, blocks: List[int]) -> None:
@@ -312,9 +455,16 @@ class PrefixCache:
                 added += 1
         return added
 
+    def covers(self, block: int) -> bool:
+        """Whether any entry resolves to ``block`` — the retention
+        eligibility test (only registered blocks are worth retaining:
+        an unregistered block is unreachable through the cache)."""
+        return block in self._by_block
+
     def invalidate_block(self, block: int) -> None:
         """Drop every entry resolving to ``block`` (its last owner just
-        freed it — the pool may recycle the pages any time now)."""
+        freed it, or the allocator reclaimed it from the retained LRU —
+        the pool may recycle the pages any time now)."""
         for kind, key in self._by_block.pop(block, ()):
             table = self._full if kind == "full" else self._partial
             if table.get(key) == block:
@@ -335,7 +485,8 @@ class PagedKVCache:
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  num_blocks: int, block_size: int, max_slots: int,
                  max_blocks_per_seq: int, dtype=jnp.float32,
-                 share_prefix: bool = False):
+                 share_prefix: bool = False, kv_dtype: Optional[str] = None,
+                 retain_prefix: bool = True):
         self.num_layers = num_layers
         self.num_heads = num_heads
         self.head_dim = head_dim
@@ -344,9 +495,23 @@ class PagedKVCache:
         self.max_slots = max_slots
         self.max_blocks_per_seq = max_blocks_per_seq
         self.dtype = dtype
+        if kv_dtype not in (None, "f32", "float32", "int8"):
+            raise ValueError(f"kv_dtype must be None|'f32'|'int8', "
+                             f"got {kv_dtype!r}")
+        self.quantized = kv_dtype == "int8"
         shape = (num_layers, num_blocks, block_size, num_heads, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        if self.quantized:
+            # int8 value pages + per-block scale pages (one f32 per
+            # token row per head) — quantize-on-scatter writes both
+            # through the same block/offset routing
+            sshape = shape[:-1]
+            self.k = (jnp.zeros(shape, jnp.int8),
+                      jnp.zeros(sshape, jnp.float32))
+            self.v = (jnp.zeros(shape, jnp.int8),
+                      jnp.zeros(sshape, jnp.float32))
+        else:
+            self.k = jnp.zeros(shape, dtype)
+            self.v = jnp.zeros(shape, dtype)
         self.allocator = BlockAllocator(num_blocks)
         self.tables = np.zeros((max_slots, max_blocks_per_seq), np.int32)
         self.lengths = np.zeros((max_slots,), np.int32)
@@ -358,9 +523,17 @@ class PagedKVCache:
         self.share_prefix = share_prefix
         self.prefix_cache = PrefixCache(block_size) if share_prefix \
             else None
+        # radix retention (ISSUE 14): registered blocks outlive their
+        # last owner in the allocator's retained LRU; the reclaim hook
+        # welds cache invalidation to the lazy recycle moment
+        self.retain_prefix = bool(retain_prefix and share_prefix)
+        if self.prefix_cache is not None:
+            self.allocator.reclaim_hook = \
+                self.prefix_cache.invalidate_block
         # cumulative sharing counters (telemetry feeds off these)
         self.prefix_hit_blocks = 0
         self.cow_forks = 0
+        self.retained_hits = 0
 
     # -- derived -----------------------------------------------------------
 
@@ -373,7 +546,39 @@ class PagedKVCache:
 
     @property
     def free_blocks(self) -> int:
-        return self.allocator.num_free
+        """Spare pool capacity INCLUDING lazily-reclaimable retained
+        blocks — the number admission, backpressure, and the fleet's
+        load signal must use (a retained block is one reclaim away from
+        free; counting only the raw free list would shed spuriously)."""
+        return self.allocator.reclaimable
+
+    @property
+    def retained_blocks(self) -> int:
+        """Blocks currently parked in the retained LRU (rc 0, prefix
+        cache still maps their content)."""
+        return self.allocator.num_retained
+
+    @property
+    def quant_dtype(self) -> str:
+        return "int8" if self.quantized else jnp.dtype(self.dtype).name
+
+    @property
+    def kv_bytes_per_token(self) -> int:
+        """HBM bytes one resident token costs across all layers, K and
+        V: the capacity accounting behind the int8 ~3-4x win (values at
+        1 byte + one f32 scale per head vs 4 bytes per element)."""
+        if self.quantized:
+            per_head = self.head_dim * 1 + 4          # int8 + f32 scale
+        else:
+            per_head = self.head_dim * jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * self.num_heads * per_head
+
+    @property
+    def bytes_per_block(self) -> int:
+        """HBM bytes one pool block costs (both pools, scales
+        included) — the equal-pool-bytes denominator the quantization
+        bench leg sizes with."""
+        return self.kv_bytes_per_token * self.block_size
 
     def blocks_needed(self, length: int) -> int:
         return -(-length // self.block_size)          # ceil
@@ -405,12 +610,16 @@ class PagedKVCache:
 
     def free_slot(self, slot: int) -> None:
         """Decref ``slot``'s blocks (a shared block survives while other
-        sequences still reference it; the LAST owner's decref frees it
-        and invalidates its prefix-cache entries) and clear the table
-        row. The pool data itself is NOT zeroed — stale block contents
-        are finite and always masked by length, so reuse is a table
-        update, not a memory wipe (the paged design's whole point)."""
-        for b in self._owned[slot]:
+        sequences still reference it; the LAST owner's decref frees it —
+        or RETAINS it when its content is prefix-registered — ) and
+        clear the table row. Decrefs run in REVERSE table order so a
+        retained prefix chain's tail blocks are older in the LRU than
+        its roots: reclaim-under-pressure eats tails first and a
+        surviving partial chain still matches from the root. The pool
+        data itself is NOT zeroed — stale block contents are finite and
+        always masked by length, so reuse is a table update, not a
+        memory wipe (the paged design's whole point)."""
+        for b in reversed(self._owned[slot]):
             self._decref(b)
         if self._fork_reserve[slot] is not None:
             self._decref(self._fork_reserve[slot])
@@ -421,10 +630,12 @@ class PagedKVCache:
         self.lengths[slot] = 0
 
     def _decref(self, block: int) -> bool:
-        freed = self.allocator.decref(block)
-        if freed and self.prefix_cache is not None:
+        retain = (self.retain_prefix and self.prefix_cache is not None
+                  and self.prefix_cache.covers(block))
+        dropped = self.allocator.decref(block, retain=retain)
+        if dropped and not retain and self.prefix_cache is not None:
             self.prefix_cache.invalidate_block(block)
-        return freed
+        return dropped
 
     # -- copy-on-write prefix sharing --------------------------------------
     #
@@ -454,7 +665,8 @@ class PagedKVCache:
         never strand on an exhausted pool."""
         assert not self._owned[slot], "adopt_prefix on a non-empty slot"
         for i, b in enumerate(match.blocks):
-            self.allocator.incref(b)
+            if self.allocator.incref(b):      # revived out of the LRU
+                self.retained_hits += 1
             self.tables[slot, i] = b
         self._owned[slot] = list(match.blocks)
         self._adopted[slot] = set(range(len(match.blocks)))

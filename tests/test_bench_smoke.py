@@ -122,6 +122,28 @@ def test_bench_smoke_cpu_green_and_equal():
     assert (ck["interleaved_tokens_chunked"]
             > ck["interleaved_tokens_monolithic"])
     assert ck["compile_counts"] == {"prefill": 1, "tick": 1}
+    # ISSUE 14: the quantization leg — at EQUAL pool bytes the int8
+    # pool admits >= 1.8x the resident sequences, a saturated workload
+    # completes every request, and greedy tokens agree >= 99% with the
+    # f32 pool (the bounded-drift acceptance criterion)
+    qz = srv["quantization"]
+    assert qz["ok"] is True, qz
+    assert qz["capacity_ratio"] >= 1.8
+    assert qz["resident_int8"] >= qz["resident_f32"]
+    assert qz["completed"] == 8
+    assert qz["token_agreement"] >= 0.99
+    assert qz["kv_bytes_per_token_int8"] < qz["kv_bytes_per_token_f32"]
+    assert qz["compile_counts"] == {"prefill": 1, "tick": 1}
+    # ISSUE 14: the retention leg — a second wave of same-prefix
+    # sessions (no live sharer) hits the retained LRU, allocates fewer
+    # fresh blocks than a retention-off engine, and leaks nothing
+    rt = srv["retention"]
+    assert rt["ok"] is True, rt
+    assert rt["retained_hits"] >= 1
+    assert (rt["wave2_fresh_allocs_retained"]
+            < rt["wave2_fresh_allocs_unretained"])
+    assert rt["leak_free"] is True
+    assert rt["compile_counts"] == {"prefill": 1, "tick": 1}
     # ISSUE 10: the fault-tolerance gate ran — the supervisor resumed an
     # injected crash, a corrupted latest pass was quarantined (renamed
     # .corrupt, never deleted) with fallback to the previous readable
@@ -292,6 +314,25 @@ def test_bench_serving_child_builds(capsys):
     assert out["decode_tokens_per_sec"] > 0
     assert out["compile_counts"] == {"prefill": 1, "tick": 1}
     assert out["context_width"] == 64
+
+
+def test_bench_serving_int8_child_builds(capsys):
+    """ISSUE 14: the transformer_decode_int8 metric child runs at a tiny
+    config — the same steady-state tick over a quantized pool, programs
+    pinned, KV bytes/token strictly below the f32 accounting."""
+    sys.path.insert(0, REPO)
+    import bench
+    bench.run_serving_bench_child(
+        max_slots=2, block_size=4, seq_len=64, dim=32, layers=2, heads=4,
+        vocab=64, prompt_len=8, warmup_ticks=2, timed_ticks=6,
+        kv_dtype="int8")
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["child"] == "transformer_decode_int8"
+    assert out["decode_tokens_per_sec"] > 0
+    assert out["compile_counts"] == {"prefill": 1, "tick": 1}
+    assert out["kv_dtype"] == "int8"
+    # int8 values + one f32 scale per head vs 4 bytes per element
+    assert out["kv_bytes_per_token"] < 2 * 2 * 4 * 8 * 4
 
 
 def test_bench_serving_spec_child_builds(capsys):

@@ -389,8 +389,12 @@ def test_deadline_evictions_emit_records_and_return_blocks(model_and_vars,
         clock.t += 1.0
     assert running.finish_reason == "timeout"
     assert starved.finish_reason == "timeout" and starved.slot is None
-    # the evicted slot's block ids are ON the free list, not just counted
-    assert set(owned) <= set(eng.cache.allocator._free)
+    # the evicted slot's block ids are reclaimable — ON the free list or
+    # parked in the retained LRU (ISSUE 14: a registered prefix block
+    # outlives its owner there), never leaked in the refcount table
+    reclaimable = (set(eng.cache.allocator._free)
+                   | set(eng.cache.allocator._retained))
+    assert set(owned) <= reclaimable
     assert eng.cache.free_blocks == eng.cache.num_blocks - 1
     evicts = {r["rid"]: r for r in mem.by_kind("evict")}
     assert evicts[running.rid]["where"] == "running"
@@ -527,9 +531,12 @@ def test_shared_prefix_fewer_allocs_and_leak_free(model_and_vars, nprng):
     assert (eng_on.cache.allocator.total_allocs
             < eng_off.cache.allocator.total_allocs)
     assert eng_on.cache.prefix_hit_blocks >= 2   # followers adopted
-    # zero leaks: every block exactly once on the free list
-    free = list(eng_on.cache.allocator._free)
-    assert len(free) == len(set(free)) == eng_on.cache.num_blocks - 1
+    # zero leaks: every block exactly once across the free list and the
+    # retained LRU (ISSUE 14: registered blocks outlive their owners
+    # there — reclaimable, not leaked)
+    pool = (list(eng_on.cache.allocator._free)
+            + list(eng_on.cache.allocator._retained))
+    assert len(pool) == len(set(pool)) == eng_on.cache.num_blocks - 1
     assert eng_on.compile_counts() == {"prefill": 1, "tick": 1}
     # request records carry the sharing attribution
     follower = [r for r in reqs_on if (r.prefix_hit_blocks or 0) > 0]
@@ -558,8 +565,9 @@ def test_cow_fork_on_duplicate_prompts(model_and_vars, nprng):
     solo = s2.submit(list(prompt), 5)
     s2.run()
     assert solo.tokens == r1.tokens
-    free = list(eng.cache.allocator._free)
-    assert len(free) == len(set(free)) == eng.cache.num_blocks - 1
+    pool = (list(eng.cache.allocator._free)
+            + list(eng.cache.allocator._retained))
+    assert len(pool) == len(set(pool)) == eng.cache.num_blocks - 1
 
 
 def test_sharing_eviction_churn_bit_identity(model_and_vars, nprng):
@@ -658,12 +666,46 @@ def test_speculative_capacity_clamp(model_and_vars, nprng):
     assert eng.cache.free_blocks == eng.cache.num_blocks - 1
 
 
-def test_speculative_rejects_sampling(model_and_vars):
+def test_speculative_composes_with_sampling_rejection_rule(model_and_vars,
+                                                           nprng):
+    """ISSUE 14: the speculation×sampling guard is LIFTED — stochastic
+    verification uses the [S3] rejection-sampling rule (accept draft d
+    with prob p(d), resample rejections from the residual), which is
+    (a) seeded-deterministic: a fixed seed replays the identical token
+    stream, (b) distribution-preserving by construction — pinned here
+    by the temperature→0 limit, where the rule degenerates to greedy
+    acceptance and must match the greedy speculative engine EXACTLY."""
     from paddle_tpu.serve import SamplingConfig
     model, vs = model_and_vars
-    with pytest.raises(ValueError, match="speculative"):
-        DecodeEngine(model, vs, speculative=2,
-                     sampling=SamplingConfig(temperature=0.8))
+    prompts = [list(nprng.randint(0, V, 5)) for _ in range(3)]
+
+    def run_sampled(seed, temp=1.0):
+        eng = DecodeEngine(model, vs, max_slots=3, block_size=BS,
+                           speculative=3,
+                           sampling=SamplingConfig(temperature=temp,
+                                                   seed=seed))
+        sched = ContinuousBatchingScheduler(eng)
+        reqs = [sched.submit(list(p), 8) for p in prompts]
+        sched.run()
+        assert eng.compile_counts() == {"prefill": 1, "tick": 1}
+        return [r.tokens for r in reqs], eng
+
+    a, eng_a = run_sampled(7)
+    b, _ = run_sampled(7)
+    c, _ = run_sampled(8)
+    assert a == b                       # seeded-deterministic replay
+    assert a != c                       # a different seed diverges
+    assert all(len(t) == 8 for t in a)  # every request completed
+    # temperature -> 0: p collapses onto the argmax, the accept coin
+    # always lands under p(draft)==1 for agreeing drafts, and the
+    # stream must equal the greedy speculative engine's token for token
+    tiny, _ = run_sampled(7, temp=1e-4)
+    eng_g = DecodeEngine(model, vs, max_slots=3, block_size=BS,
+                         speculative=3)
+    sg = ContinuousBatchingScheduler(eng_g)
+    greedy = [sg.submit(list(p), 8) for p in prompts]
+    sg.run()
+    assert tiny == [r.tokens for r in greedy]
 
 
 # ---------------------------------------------------------------------------
@@ -739,8 +781,9 @@ def test_chunked_prefill_composes_with_sharing(model_and_vars, nprng):
     # the second duplicate exact-matches the first: one COW fork each
     # at the first divergent decode write
     assert eng_a.cache.cow_forks >= 1
-    free = list(eng_a.cache.allocator._free)
-    assert len(free) == len(set(free)) == eng_a.cache.num_blocks - 1
+    pool = (list(eng_a.cache.allocator._free)
+            + list(eng_a.cache.allocator._retained))
+    assert len(pool) == len(set(pool)) == eng_a.cache.num_blocks - 1
 
 
 def test_decode_span_logits_bit_equal_full_forward(model_and_vars, nprng):
@@ -947,3 +990,353 @@ def test_inference_unhashable_kwarg_warns_once_naming_it(
     warns = [r for r in caplog.records if "unhashable" in r.getMessage()]
     assert len(warns) == 1
     assert "segments" in warns[0].getMessage()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14: int8 KV quantization
+# ---------------------------------------------------------------------------
+
+def test_quantize_rows_roundtrip_bound(nprng):
+    """Symmetric per-row-per-head int8: reconstruction error is bounded
+    by half a quantization step (amax/254) per element."""
+    kv = jnp.asarray(nprng.randn(3, 5, 4, 16).astype(np.float32))
+    q, s = kvc.quantize_rows(kv)
+    assert q.dtype == jnp.int8 and s.shape == (3, 5, 4)
+    deq = kvc.dequantize_rows(q, s)
+    amax = np.max(np.abs(np.asarray(kv)), axis=-1, keepdims=True)
+    err = np.abs(np.asarray(deq) - np.asarray(kv))
+    assert np.all(err <= amax / 254.0 + 1e-7)
+
+
+def test_quantized_pool_scatter_gather_dequantizes(nprng):
+    """The (values, scales) tuple pool: scatter quantizes, gather
+    returns dequantized f32 close to the original rows."""
+    H, hd = 2, 8
+    pages = (jnp.zeros((8, BS, H, hd), jnp.int8),
+             jnp.zeros((8, BS, H), jnp.float32))
+    table = jnp.asarray([[3, 1, 5, 0, 0, 0]], jnp.int32)
+    kv = jnp.asarray(nprng.randn(1, MB * BS, H, hd).astype(np.float32))
+    pages = kvc.scatter_prefill_pages(pages, kv, table,
+                                      jnp.asarray([9], jnp.int32))
+    got = kvc.gather_pages(pages, table)
+    assert got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got[0, :9]),
+                               np.asarray(kv[0, :9]), atol=0.03)
+
+
+def test_quantized_engine_drift_bound_and_token_agreement(model_and_vars,
+                                                          nprng):
+    """The ISSUE 14 acceptance contract on the gate set: an int8 KV pool
+    generates with >= 99% greedy token agreement vs the f32 pool, and
+    the decode-step logits drift stays within a small absolute bound —
+    quantization is a capacity lever, not a quality cliff."""
+    model, vs = model_and_vars
+    prompts = [list(nprng.randint(0, V, nprng.randint(2, 8)))
+               for _ in range(8)]
+    maxnew = [3, 9, 5, 12, 7, 4, 10, 6]
+
+    def run(kv_dtype):
+        eng = DecodeEngine(model, vs, max_slots=4, block_size=BS,
+                           kv_dtype=kv_dtype)
+        sched = ContinuousBatchingScheduler(eng)
+        reqs = [sched.submit(list(p), m)
+                for p, m in zip(prompts, maxnew)]
+        sched.run()
+        assert eng.compile_counts() == {"prefill": 1, "tick": 1}
+        return [r.tokens for r in reqs], eng
+
+    toks_f, eng_f = run(None)
+    toks_q, eng_q = run("int8")
+    agree = sum(a == b for x, y in zip(toks_f, toks_q)
+                for a, b in zip(x, y))
+    total = sum(len(x) for x in toks_f)
+    assert agree / total >= 0.99
+    # capacity accounting: int8 + one f32 scale per head vs 4 bytes/elem
+    assert eng_q.cache.kv_bytes_per_token < eng_f.cache.kv_bytes_per_token
+    assert eng_q.cache.quant_dtype == "int8"
+    # logit drift on a live decode step, both caches warm with the same
+    # prompt: small absolute bound at this model's logit scale
+    ef = DecodeEngine(model, vs, max_slots=1, block_size=BS)
+    eq = DecodeEngine(model, vs, max_slots=1, block_size=BS,
+                      kv_dtype="int8")
+    p0 = prompts[1]
+    for e in (ef, eq):
+        e.admit(0, list(p0), reserve_len=len(p0) + 4)
+
+    def step_logits(e):
+        tables, lengths = e.cache.device_tables()
+        logits, _ = model.apply(
+            e.variables, jnp.asarray(e.tokens),
+            (e.cache.k, e.cache.v, tables), lengths,
+            jnp.asarray(e.active), attn_impl="xla", method="decode_step")
+        return np.asarray(logits[0])
+
+    lf, lq = step_logits(ef), step_logits(eq)
+    assert np.max(np.abs(lf - lq)) < 0.05 * max(1.0, np.ptp(lf))
+
+
+def test_quantized_paged_kernel_matches_reference(nprng):
+    """paged_decode_attention with an int8 (values, scales) pool matches
+    the dequantizing oracle — dequant-in-kernel is numerically the same
+    as dequant-then-attend."""
+    from paddle_tpu.nn.pallas_attention import (paged_decode_attention,
+                                                paged_reference_attention)
+    S, H, D, N = 4, 2, 16, 32
+    q = jnp.asarray(nprng.randn(S, H, D).astype(np.float32))
+    raw_k = jnp.asarray(nprng.randn(N, BS, H, D).astype(np.float32))
+    raw_v = jnp.asarray(nprng.randn(N, BS, H, D).astype(np.float32))
+    pk = kvc.quantize_rows(raw_k)
+    pv = kvc.quantize_rows(raw_v)
+    tables = jnp.asarray(nprng.randint(0, N, (S, MB)), jnp.int32)
+    lengths = jnp.asarray([5, 0, MB * BS, 12], jnp.int32)
+    out = paged_decode_attention(q, pk, pv, tables, lengths)
+    ref = paged_reference_attention(q, pk, pv, tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+    assert not np.any(np.asarray(out[1]))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14: multi-query paged span kernel
+# ---------------------------------------------------------------------------
+
+def test_paged_span_kernel_matches_oracle_and_q1_bit_exact(nprng):
+    """The span kernel vs its oracle across ragged starts (mid-block,
+    block-boundary, tail), span widths Q = 1+k for k in {0, 3}, partial
+    spans (n < Q) and an inactive slot — and at Q=1 the kernel runs the
+    EXACT op sequence of the q_len=1 decode kernel (bit-equal: the
+    greedy-path contract)."""
+    from paddle_tpu.nn.pallas_attention import (
+        paged_decode_attention, paged_span_attention,
+        paged_span_reference_attention)
+    S, H, D, N = 4, 2, 16, 32
+    pk = jnp.asarray(nprng.randn(N, BS, H, D).astype(np.float32))
+    pv = jnp.asarray(nprng.randn(N, BS, H, D).astype(np.float32))
+    tables = jnp.asarray(nprng.randint(0, N, (S, MB)), jnp.int32)
+    for k in (0, 3):
+        Q = 1 + k
+        q = jnp.asarray(nprng.randn(S, Q, H, D).astype(np.float32))
+        # mid-block, inactive WITH a stale start (must still be zeros),
+        # block boundary, clamped tail
+        start = jnp.asarray([3, 7, 8, MB * BS - Q], jnp.int32)
+        n = jnp.asarray([Q, 0, max(1, Q - 1), Q], jnp.int32)
+        out = paged_span_attention(q, pk, pv, tables, start, n)
+        ref = paged_span_reference_attention(q, pk, pv, tables, start, n)
+        for s in range(S):
+            live = int(n[s])
+            if live == 0:
+                assert not np.any(np.asarray(out[s]))
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(out[s, :live]), np.asarray(ref[s, :live]),
+                    rtol=2e-6, atol=2e-6)
+        if Q == 1:
+            lengths = jnp.where(n > 0, start + 1, 0)
+            single = paged_decode_attention(q[:, 0], pk, pv, tables,
+                                            lengths)
+            np.testing.assert_array_equal(np.asarray(out[:, 0]),
+                                          np.asarray(single))
+
+
+def test_paged_span_kernel_quantized(nprng):
+    """The span kernel's in-VMEM dequant path vs the dequantizing
+    oracle (int8 pools)."""
+    from paddle_tpu.nn.pallas_attention import (
+        paged_span_attention, paged_span_reference_attention)
+    S, Q, H, D, N = 3, 4, 2, 16, 32
+    q = jnp.asarray(nprng.randn(S, Q, H, D).astype(np.float32))
+    pk = kvc.quantize_rows(
+        jnp.asarray(nprng.randn(N, BS, H, D).astype(np.float32)))
+    pv = kvc.quantize_rows(
+        jnp.asarray(nprng.randn(N, BS, H, D).astype(np.float32)))
+    tables = jnp.asarray(nprng.randint(0, N, (S, MB)), jnp.int32)
+    start = jnp.asarray([2, 0, 9], jnp.int32)
+    n = jnp.asarray([Q, 0, Q], jnp.int32)
+    out = paged_span_attention(q, pk, pv, tables, start, n)
+    ref = paged_span_reference_attention(q, pk, pv, tables, start, n)
+    for s in range(S):
+        live = int(n[s])
+        if live:
+            np.testing.assert_allclose(
+                np.asarray(out[s, :live]), np.asarray(ref[s, :live]),
+                rtol=2e-6, atol=2e-6)
+
+
+def test_model_decode_span_paged_impl_matches_xla(model_and_vars, nprng):
+    """End to end through the model: the span tick on the paged kernel
+    path produces tokens identical to the XLA gather path on this CPU
+    gate set (the kernel is tolerance-accurate; argmax agreement over
+    the gate set is the behavioral check)."""
+    model, vs = model_and_vars
+    prompts = [list(nprng.randint(0, V, nprng.randint(2, 8)))
+               for _ in range(4)]
+
+    def run(attention):
+        eng = DecodeEngine(model, vs, max_slots=4, block_size=BS,
+                           speculative=3, attention=attention)
+        sched = ContinuousBatchingScheduler(eng)
+        reqs = [sched.submit(list(p), 8) for p in prompts]
+        sched.run()
+        assert eng.compile_counts() == {"prefill": 1, "tick": 1}
+        return [r.tokens for r in reqs]
+
+    assert run("paged") == run("xla")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14: radix retention
+# ---------------------------------------------------------------------------
+
+def test_retention_sequential_prefix_hits(model_and_vars, nprng):
+    """The RadixAttention win: a SECOND wave of same-prefix requests —
+    no live sharer left — adopts retained blocks (fewer fresh allocs
+    than a retention-off engine), generates identically, and the pool
+    stays leak-free with retained counted as reclaimable."""
+    model, vs = model_and_vars
+    pre = list(nprng.randint(0, V, 2 * BS))
+    tails = [list(nprng.randint(0, V, 3)) for _ in range(4)]
+
+    def wave(eng, i):
+        sched = ContinuousBatchingScheduler(eng)
+        reqs = [sched.submit(pre + list(t), 4) for t in tails[2*i:2*i+2]]
+        sched.run()
+        return [r.tokens for r in reqs]
+
+    eng_r = DecodeEngine(model, vs, max_slots=2, block_size=BS)
+    eng_n = DecodeEngine(model, vs, max_slots=2, block_size=BS,
+                         retain_prefix=False)
+    toks_r = wave(eng_r, 0)
+    assert eng_r.cache.retained_blocks > 0        # wave 1 parked blocks
+    toks_n = wave(eng_n, 0)
+    a_r, a_n = (eng_r.cache.allocator.total_allocs,
+                eng_n.cache.allocator.total_allocs)
+    toks_r2 = wave(eng_r, 1)
+    toks_n2 = wave(eng_n, 1)
+    assert toks_r == toks_n and toks_r2 == toks_n2   # identical output
+    assert eng_r.cache.retained_hits >= 2        # wave 2 hit the LRU
+    # wave 2 allocated FEWER fresh blocks than the retention-off engine
+    assert (eng_r.cache.allocator.total_allocs - a_r
+            < eng_n.cache.allocator.total_allocs - a_n)
+    # leak-free: free + retained covers the whole pool exactly once
+    pool = (list(eng_r.cache.allocator._free)
+            + list(eng_r.cache.allocator._retained))
+    assert len(pool) == len(set(pool)) == eng_r.cache.num_blocks - 1
+    assert eng_r.cache.free_blocks == eng_r.cache.num_blocks - 1
+
+
+def test_retention_reclaim_under_pressure_leak_free(model_and_vars,
+                                                    nprng):
+    """The retention leak regression (ISSUE 14): under pool pressure
+    retained blocks are lazily reclaimed (oldest first, prefix-cache
+    entries invalidated at that moment) — churn through MANY distinct
+    prompts on a small pool, then verify every block is on the free
+    list or retained LRU exactly once and reclaims actually fired."""
+    model, vs = model_and_vars
+    # pool sized for ~2 resident sequences: wave churn forces reclaim
+    eng = DecodeEngine(model, vs, max_slots=2, block_size=BS,
+                       num_blocks=2 * 3 + 1)
+    for i in range(4):
+        sched = ContinuousBatchingScheduler(eng)
+        for j in range(3):
+            sched.submit(list(nprng.randint(0, V, 4 + i + j)), 5)
+        sched.run()
+    assert eng.cache.allocator.retained_reclaims > 0
+    pool = (list(eng.cache.allocator._free)
+            + list(eng.cache.allocator._retained))
+    assert len(pool) == len(set(pool)) == eng.cache.num_blocks - 1
+    assert eng.cache.free_blocks == eng.cache.num_blocks - 1
+    # the prefix cache holds no entry for any reclaimed (now-free) block
+    for b in eng.cache.allocator._free:
+        assert not eng.cache.prefix_cache.covers(b) or \
+            b in eng.cache.allocator._retained
+    assert eng.compile_counts() == {"prefill": 1, "tick": 1}
+
+
+def test_retention_cow_fork_interaction(model_and_vars, nprng):
+    """Retention x CoW (ISSUE 14 satellite): re-admitting an exact
+    prompt whose blocks sit in the retained LRU increfs them OUT of the
+    LRU (retained hit, rc back to 1), the partial boundary block is
+    handled by the standard promote-or-fork discipline, and generation
+    is identical to the first run."""
+    model, vs = model_and_vars
+    prompt = list(nprng.randint(0, V, 6))        # partial boundary
+    eng = DecodeEngine(model, vs, max_slots=2, block_size=BS)
+    s1 = ContinuousBatchingScheduler(eng)
+    r1 = s1.submit(list(prompt), 5)
+    s1.run()
+    retained = list(eng.cache.allocator._retained)
+    assert retained, "first run retained nothing"
+    hits0 = eng.cache.retained_hits
+    s2 = ContinuousBatchingScheduler(eng)
+    r2 = s2.submit(list(prompt), 5)
+    s2.run()
+    assert r2.tokens == r1.tokens
+    assert eng.cache.retained_hits > hits0
+    # the adopted blocks left the LRU at adoption (incref-revive), and
+    # after the second eviction they are retained or free again — once
+    pool = (list(eng.cache.allocator._free)
+            + list(eng.cache.allocator._retained))
+    assert len(pool) == len(set(pool)) == eng.cache.num_blocks - 1
+
+
+def test_admit_probe_counts_retained_as_reclaimable(model_and_vars,
+                                                    nprng):
+    """ISSUE 14 satellite: admit_probe threads the reclaimable count —
+    a pool whose RAW free list is too small but whose retained LRU
+    covers the need admits (no spurious "blocks" shed); the probe
+    carries both numbers."""
+    model, vs = model_and_vars
+    eng = DecodeEngine(model, vs, max_slots=2, block_size=BS,
+                       num_blocks=2 * 3 + 1)
+    sched = ContinuousBatchingScheduler(eng)
+    sched.submit(list(nprng.randint(0, V, 2 * BS)), 4)
+    sched.run()                        # evicted -> full blocks retained
+    assert eng.cache.retained_blocks > 0
+    raw_free = eng.cache.allocator.num_free
+    need_len = (raw_free + 1) * BS     # needs more than raw free
+    assert eng.cache.blocks_needed(need_len) <= eng.cache.free_blocks
+    probe = eng.admit_probe(need_len, include_slots=False)
+    assert probe.ok and probe.reason is None
+    assert probe.raw_free_blocks == raw_free
+    assert probe.retained_blocks == eng.cache.retained_blocks
+    assert probe.free_blocks == raw_free + probe.retained_blocks
+    # and the pool genuinely serves it: admission reclaims lazily
+    s2 = ContinuousBatchingScheduler(eng)
+    req = s2.submit(list(nprng.randint(0, V, need_len - 2)), 2)
+    s2.run()
+    assert req.finish_reason == "length"
+
+
+def test_decode_tick_records_carry_retention_and_quant_fields(
+        model_and_vars, nprng):
+    """ISSUE 14 telemetry: decode_tick records carry kv_bytes_per_token,
+    retained_blocks, retained_hits (per-tick delta) and quant_dtype;
+    summarize_requests aggregates them into retention-hit-rate and
+    KV-bytes rows; obs.report renders them."""
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    from paddle_tpu.obs.percentiles import summarize_requests
+    from paddle_tpu.obs.report import format_summary, summarize
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    eng = DecodeEngine(model, vs, max_slots=2, block_size=BS,
+                       kv_dtype="int8", telemetry=Telemetry(sinks=[mem]))
+    pre = list(nprng.randint(0, V, BS))
+    for tail in ([1, 2], [3, 4]):      # sequential same-prefix sessions
+        sched = ContinuousBatchingScheduler(eng)
+        sched.submit(pre + tail, 3)
+        sched.run()
+    recs = mem.by_kind("decode_tick")
+    assert recs
+    for r in recs:
+        assert r["kv_bytes_per_token"] == eng.cache.kv_bytes_per_token
+        assert r["quant_dtype"] == "int8"
+        assert "retained_blocks" in r and "retained_hits" in r
+    assert sum(r["retained_hits"] for r in recs) >= 1
+    summary = summarize_requests(mem.records)
+    assert summary["retained_hits"] >= 1
+    assert summary["kv_bytes_per_token"] == eng.cache.kv_bytes_per_token
+    assert summary["quant_dtype"] == "int8"
+    assert summary["retention_hit_rate"] is not None
+    text = format_summary(summarize(mem.records))
+    assert "retained prefix hits" in text
+    assert "KV bytes/token" in text
