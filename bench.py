@@ -579,6 +579,9 @@ PLANS = {
     # speculative-vs-plain decode differential (own child protocol:
     # run_serving_spec_bench_child; n/k unused)
     "transformer_decode_spec": dict(n=0, k=1, budget=2400),
+    # tensor-parallel sharded tick over a 2-device mesh (ISSUE 15; own
+    # child protocol: run_serving_tp_bench_child; n/k unused)
+    "transformer_decode_tp": dict(n=0, k=1, budget=2400),
 }
 
 
@@ -657,14 +660,26 @@ def run_timed_child(name, timed_steps, steps_per_call, warmup_calls=2,
                                if not callable(v)}}))
 
 
-def _spawn_child(name, timed_steps, steps_per_call, budget):
+def _force_cpu_devices(env, n):
+    """A copy of ``env`` pinned to the virtual ``n``-device CPU platform
+    (must land before the child's jax initializes); scrubs any existing
+    device-count flag first so forcing is idempotent."""
+    env = dict(env, JAX_PLATFORMS="cpu")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def _spawn_child(name, timed_steps, steps_per_call, budget, env=None):
     repo = os.path.dirname(os.path.abspath(__file__))
     cmd = [sys.executable, os.path.join(repo, "bench.py"),
            "--metric", name, "--child", "1",
            "--timed-steps", str(timed_steps),
            "--steps-per-call", str(steps_per_call)]
     res = subprocess.run(cmd, capture_output=True, text=True, cwd=repo,
-                         timeout=budget)
+                         timeout=budget, env=env)
     if res.returncode != 0:
         raise RuntimeError(f"child {name}/{timed_steps} rc={res.returncode}: "
                            f"{res.stderr[-600:]}")
@@ -966,11 +981,7 @@ def run_smoke(K=4, M=2, timing_passes=3):
     # error dict only when there is no parseable line (a crash before
     # printing), and then carry the stderr tail so the traceback isn't
     # lost.
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    aflags = [f for f in env.get("XLA_FLAGS", "").split()
-              if "xla_force_host_platform_device_count" not in f]
-    aflags.append("--xla_force_host_platform_device_count=2")
-    env["XLA_FLAGS"] = " ".join(aflags)
+    env = _force_cpu_devices(os.environ, 2)
     repo = os.path.dirname(os.path.abspath(__file__))
 
     def run_gate_child(flag):
@@ -1480,12 +1491,60 @@ def run_serving_child():
               and ret_leg["leak_free"]
               and ret_leg["compile_counts"] == {"prefill": 1, "tick": 1})
 
+    # --- ISSUE 15 leg (f): tensor-parallel sharded tick — the tp=2
+    # engine (2 forced host devices) is token-identical to the
+    # single-device engine on the ragged churn workload across TWO
+    # waves on one engine (wave 2 pins zero retraces), per-shard KV
+    # bytes halve (capacity at equal per-device pool bytes doubles),
+    # and the tick's tp collectives classify into the serving comm
+    # table of the attribution report.
+    from jax.sharding import Mesh
+    tp_mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
+
+    def run_tp(mesh):
+        eng = DecodeEngine(model, vs, max_slots=4, block_size=4,
+                           mesh=mesh)
+        toks = []
+        for _ in range(2):
+            sched = ContinuousBatchingScheduler(eng)
+            reqs = [sched.submit(p, m) for p, m in zip(prompts, maxnew)]
+            sched.run()
+            toks.append([r.tokens for r in reqs])
+        return toks, eng
+
+    toks_tp, eng_tp = run_tp(tp_mesh)
+    toks_1d, eng_1d = run_tp(None)
+    tp_comm = (eng_tp.attribution_report(emit=False).get("decode")
+               or {}).get("comm") or {}
+    tp_leg = {
+        "tokens_identical": toks_tp == toks_1d,
+        "tp_degree": eng_tp.tp_degree,
+        "compile_counts": eng_tp.compile_counts(),
+        "kv_bytes_per_token_tp": eng_tp.cache.kv_bytes_per_token,
+        "kv_bytes_per_token_1dev": eng_1d.cache.kv_bytes_per_token,
+        # per-shard capacity ratio: blocks a device's HBM budget holds
+        # under tp vs alone (the head split's whole capacity story)
+        "per_shard_capacity_ratio": round(
+            eng_1d.cache.kv_bytes_per_token
+            / eng_tp.cache.kv_bytes_per_token, 3),
+        "decode_comm_ops": tp_comm.get("ops", 0),
+        "decode_comm_kinds": tp_comm.get("kinds"),
+        "leak_free": eng_tp.cache.free_blocks
+        == eng_tp.cache.num_blocks - 1,
+    }
+    tp_ok = (tp_leg["tokens_identical"] and tp_leg["tp_degree"] == 2
+             and tp_leg["compile_counts"] == {"prefill": 1, "tick": 1}
+             and tp_leg["per_shard_capacity_ratio"] >= 2.0
+             and tp_leg["decode_comm_ops"] >= 1
+             and tp_leg["leak_free"])
+
     ok = (cont["completed"] == 8 and stat["completed"] == 8
           and no_retrace and records_ok
           and cont["tokens_per_sec"] > stat["tokens_per_sec"]
           and cont["ticks"] < stat["ticks"]
           and decode_block.get("bound") == "memory"
-          and share_ok and spec_ok and chunk_ok and quant_ok and ret_ok)
+          and share_ok and spec_ok and chunk_ok and quant_ok and ret_ok
+          and tp_ok)
     print(json.dumps({
         "child": "serving", "ok": bool(ok),
         "requests": 8, "max_slots": 4, "block_size": 4,
@@ -1502,6 +1561,7 @@ def run_serving_child():
         "chunked_prefill": {**chunk_leg, "ok": bool(chunk_ok)},
         "quantization": {**quant_leg, "ok": bool(quant_ok)},
         "retention": {**ret_leg, "ok": bool(ret_ok)},
+        "tp": {**tp_leg, "ok": bool(tp_ok)},
         "device": jax.devices()[0].device_kind,
     }))
     return 0 if ok else 1
@@ -1942,6 +2002,94 @@ def bench_serving(budget=None, kv_dtype=None):
     }
 
 
+def run_serving_tp_bench_child(max_slots=8, block_size=16, seq_len=1024,
+                               dim=512, layers=6, heads=8, vocab=32000,
+                               prompt_len=128, warmup_ticks=8,
+                               timed_ticks=64):
+    """The ``transformer_decode_tp`` metric (ISSUE 15): steady-state
+    decode tokens/sec through the TENSOR-PARALLEL tick — the same
+    full-slot workload as ``transformer_decode`` but with params
+    megatron-placed and the KV pools head-sharded over a 2-device mesh.
+    On real TPU the interesting number is the tick time at HALF the
+    per-device KV/weight bytes (the capacity-latency trade tp buys); on
+    the forced-CPU proxy it is a correctness/overhead gate. Prints one
+    JSON line for the parent."""
+    from jax.sharding import Mesh
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.serve import DecodeEngine
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError(
+            "transformer_decode_tp needs >= 2 devices (force with "
+            "--xla_force_host_platform_device_count=2)")
+    mesh = Mesh(np.asarray(devs[:2]), ("model",))
+    ffn = 4 * dim
+    model = TransformerLM(vocab=vocab, dim=dim, num_layers=layers,
+                          num_heads=heads, ffn_hidden=ffn, max_len=seq_len)
+    vs = model.init(jax.random.PRNGKey(0),
+                    jnp.zeros((1, seq_len), jnp.int32))
+    eng = DecodeEngine(model, vs, max_slots=max_slots,
+                       block_size=block_size, mesh=mesh)
+    rng = np.random.RandomState(0)
+    target = prompt_len + warmup_ticks + timed_ticks + 2
+    assert target <= eng.context_width
+    for slot in range(max_slots):
+        eng.admit(slot, list(rng.randint(0, vocab, prompt_len)),
+                  reserve_len=target)
+    for _ in range(warmup_ticks):
+        eng.decode_tick()
+    t0 = time.perf_counter()
+    for _ in range(timed_ticks):
+        eng.decode_tick()
+    wall = time.perf_counter() - t0
+    tokens = timed_ticks * max_slots
+    print(json.dumps({
+        "child": "transformer_decode_tp",
+        "decode_tokens_per_sec": round(tokens / wall, 2),
+        "ms_per_tick": round(wall / timed_ticks * 1e3, 3),
+        "tp_degree": eng.tp_degree,
+        "max_slots": max_slots, "block_size": block_size,
+        "context_width": eng.context_width, "prompt_len": prompt_len,
+        "timed_ticks": timed_ticks, "dim": dim, "layers": layers,
+        "vocab": vocab, "attention": eng.attention,
+        "kv_bytes_per_token_per_shard": eng.cache.kv_bytes_per_token,
+        "compile_counts": eng.compile_counts(),
+        "device": jax.devices()[0].device_kind,
+        "n_devices": len(devs),
+    }))
+
+
+def bench_serving_tp(budget=None):
+    """Fresh-subprocess wrapper for run_serving_tp_bench_child. The
+    child needs >= 2 devices; when the driver environment has fewer
+    (one real chip) it is spawned on a forced 2-virtual-device CPU
+    platform — a correctness/overhead proxy, labelled in the record."""
+    budget = budget or PLANS["transformer_decode_tp"]["budget"]
+    forced = len(jax.devices()) < 2
+    r = _spawn_child("transformer_decode_tp", 0, 1, budget,
+                     env=_force_cpu_devices(os.environ, 2)
+                     if forced else None)
+    return {
+        "metric": "transformer_decode_tp_tokens_per_sec",
+        "unit": "tokens/sec",
+        "value": r["decode_tokens_per_sec"],
+        "ms_per_tick": r["ms_per_tick"],
+        "tp_degree": r["tp_degree"],
+        "max_slots": r["max_slots"], "block_size": r["block_size"],
+        "context_width": r["context_width"],
+        "prompt_len": r["prompt_len"], "dim": r["dim"],
+        "layers": r["layers"], "attention": r["attention"],
+        "kv_bytes_per_token_per_shard":
+            r["kv_bytes_per_token_per_shard"],
+        "device": r["device"],
+        "environment_note": "forced-2-virtual-cpu-devices (shared host "
+                            "cores; correctness/overhead proxy)"
+        if forced else None,
+        "baseline": None, "vs_baseline": None,
+    }
+
+
 def run_serving_spec_bench_child(max_slots=4, block_size=16, seq_len=256,
                                  dim=256, layers=4, heads=8, vocab=8000,
                                  prompt_len=32, speculative=4,
@@ -2291,12 +2439,7 @@ def bench_scaling(per_device_batch=32, iters=2, steps_per_call=4):
     devices = jax.devices()
     if len(devices) < 8:
         # re-launch on the virtual CPU mesh (env must be set pre-jax-import)
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        flags = [f for f in env.get("XLA_FLAGS", "").split()
-                 if "xla_force_host_platform_device_count" not in f]
-        flags.append("--xla_force_host_platform_device_count=8")
-        env["XLA_FLAGS"] = " ".join(flags)
+        env = _force_cpu_devices(os.environ, 8)
         repo = os.path.dirname(os.path.abspath(__file__))
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
         code = ("import jax; jax.config.update('jax_platforms','cpu'); "
@@ -2363,7 +2506,7 @@ def bench_scaling(per_device_batch=32, iters=2, steps_per_call=4):
 DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_fused",
                 "transformer_dp_overlap", "transformer_pipelined",
                 "transformer_decode", "transformer_decode_int8",
-                "transformer_decode_spec",
+                "transformer_decode_spec", "transformer_decode_tp",
                 "transformer_big", "lstm", "lstm_h256", "lstm_h1280"]
 
 
@@ -2458,6 +2601,8 @@ def main():
             run_serving_bench_child(kv_dtype="int8")
         elif metric == "transformer_decode_spec":
             run_serving_spec_bench_child()
+        elif metric == "transformer_decode_tp":
+            run_serving_tp_bench_child()
         else:
             run_timed_child(metric, flag("--timed-steps", 100, int),
                             flag("--steps-per-call", 1, int))
@@ -2467,12 +2612,15 @@ def main():
         print(json.dumps(bench_scaling()))
         return
     if metric in ("transformer_pipelined", "transformer_decode",
-                  "transformer_decode_int8", "transformer_decode_spec"):
+                  "transformer_decode_int8", "transformer_decode_spec",
+                  "transformer_decode_tp"):
         try:
             out = (bench_pipelined() if metric == "transformer_pipelined"
                    else bench_serving() if metric == "transformer_decode"
                    else bench_serving(kv_dtype="int8")
                    if metric == "transformer_decode_int8"
+                   else bench_serving_tp()
+                   if metric == "transformer_decode_tp"
                    else bench_serving_spec())
         except (RuntimeError, subprocess.TimeoutExpired, ValueError,
                 IndexError, KeyError) as e:
@@ -2485,7 +2633,7 @@ def main():
     if metric is not None and metric not in PREPS:
         print(json.dumps(
             {"error": f"unknown metric {metric!r}; choose from "
-                      f"{sorted(PREPS) + ['scaling', 'transformer_pipelined', 'transformer_decode', 'transformer_decode_int8', 'transformer_decode_spec']}"
+                      f"{sorted(PREPS) + ['scaling', 'transformer_pipelined', 'transformer_decode', 'transformer_decode_int8', 'transformer_decode_spec', 'transformer_decode_tp']}"
              }))
         sys.exit(2)
     if metric in PREPS:
@@ -2514,8 +2662,14 @@ def main():
                     results[name] = bench_pipelined()
                 elif name == "transformer_decode":
                     results[name] = bench_serving()
+                elif name == "transformer_decode_int8":
+                    # own child protocol — bench_differential would ask
+                    # the serving child for per_step_s it never prints
+                    results[name] = bench_serving(kv_dtype="int8")
                 elif name == "transformer_decode_spec":
                     results[name] = bench_serving_spec()
+                elif name == "transformer_decode_tp":
+                    results[name] = bench_serving_tp()
                 else:
                     results[name] = bench_differential(name)
                 errors.pop(name, None)

@@ -244,10 +244,20 @@ class TransformerLM(Module):
 
     # -- serving entry points (paddle_tpu.serve) ---------------------------
     #
-    # Both run the block stack as ONE lax.scan over the per-block param
-    # subtrees STACKED AT TRACE TIME (the _scan_blocks recipe, minus
+    # All three run the block stack as ONE lax.scan over the per-block
+    # param subtrees STACKED AT TRACE TIME (the _scan_blocks recipe, minus
     # checkpoint — no gradients flow here), so the variables tree is the
     # training tree unchanged: any training checkpoint serves as-is.
+    #
+    # Shard-in-scope (ISSUE 15): the bodies are mesh-oblivious, but when
+    # the engine traces them inside `parallel.tp_shard_scope` the
+    # attention layer pins its projections/pools head-sharded and the
+    # residual stream + logits pin REPLICATED here — classic Megatron tp
+    # (not sequence-parallel: decode is one token per slot, so there is
+    # no sequence to split; the head axis is the only parallel axis with
+    # work on it). The logits assemble on the existing tp head path: the
+    # row-parallel out/ffn2 projections all-reduce back to the replicated
+    # residual, and the tied readout runs replicated on every shard.
 
     def _stacked_blocks(self):
         block0 = self.blocks[0]
@@ -266,24 +276,25 @@ class TransformerLM(Module):
         compiled shape (no retraces) and keeps each row's softmax
         reduction width identical to the training forward's — the f32
         bit-equality contract the serve tests pin."""
+        from paddle_tpu.parallel.sharding import tp_constrain
         T = ids.shape[1]
         assert T <= self.max_len, f"T={T} exceeds max_len={self.max_len}"
         pos = jnp.arange(T)[None] if positions is None else positions
         with jax.named_scope("decode/prefill"):
             with jax.named_scope("embed"):
-                x = self.emb(ids) + self.pos(pos)
+                x = tp_constrain(self.emb(ids) + self.pos(pos))
             block0, stacked = self._stacked_blocks()
 
             def body(h, bp):
                 y, _aux, kv = block0.apply(
                     {"params": {block0._name: bp}}, h, train=False,
                     return_kv=True)
-                return y, kv
+                return tp_constrain(y), kv
 
             with jax.named_scope("block_scan"):
                 x, (ks, vs) = lax.scan(body, x, stacked)
             with jax.named_scope("head"):
-                logits = self.emb.attend(self.ln_f(x))
+                logits = tp_constrain(self.emb.attend(self.ln_f(x)))
         return logits, (ks, vs)
 
     def decode_step(self, token, kv, positions, active=None,
@@ -296,6 +307,7 @@ class TransformerLM(Module):
         ``active [S]`` bool (default: all). Returns ``(logits [S,
         vocab], kv')`` with the updated pools — same structure, so the
         engine's jit carry donates cleanly."""
+        from paddle_tpu.parallel.sharding import tp_constrain
         pages_k, pages_v, tables = kv
         S = token.shape[0]
         if active is None:
@@ -305,7 +317,8 @@ class TransformerLM(Module):
         pos_idx = jnp.minimum(positions, self.max_len - 1)
         with jax.named_scope("decode/step"):
             with jax.named_scope("embed"):
-                x = self.emb(token[:, None]) + self.pos(pos_idx[:, None])
+                x = tp_constrain(self.emb(token[:, None])
+                                 + self.pos(pos_idx[:, None]))
             block0, stacked = self._stacked_blocks()
 
             def body(h, xs):
@@ -314,13 +327,13 @@ class TransformerLM(Module):
                     {"params": {block0._name: bp}}, h, pk, pv, tables,
                     positions, active, attn_impl=attn_impl,
                     method="decode_step")
-                return y, (pk, pv)
+                return tp_constrain(y), (pk, pv)
 
             with jax.named_scope("block_scan"):
                 x, (pages_k, pages_v) = lax.scan(
                     body, x, (stacked, pages_k, pages_v))
             with jax.named_scope("head"):
-                logits = self.emb.attend(self.ln_f(x))
+                logits = tp_constrain(self.emb.attend(self.ln_f(x)))
         return logits[:, 0], (pages_k, pages_v, tables)
 
     def decode_span(self, tokens, kv, start, n, active=None,
@@ -337,6 +350,7 @@ class TransformerLM(Module):
         a live slot is bit-equal (f32) to what :meth:`decode_step`
         would produce at that position — the structural losslessness
         the serve tests pin."""
+        from paddle_tpu.parallel.sharding import tp_constrain
         pages_k, pages_v, tables = kv
         S, Q = tokens.shape
         if active is None:
@@ -346,7 +360,7 @@ class TransformerLM(Module):
                           self.max_len - 1)
         with jax.named_scope("decode/span"):
             with jax.named_scope("embed"):
-                x = self.emb(tokens) + self.pos(pos)
+                x = tp_constrain(self.emb(tokens) + self.pos(pos))
             block0, stacked = self._stacked_blocks()
 
             def body(h, xs):
@@ -355,13 +369,13 @@ class TransformerLM(Module):
                     {"params": {block0._name: bp}}, h, pk, pv, tables,
                     start, n, active, attn_impl=attn_impl,
                     write_from=write_from, method="decode_span")
-                return y, (pk, pv)
+                return tp_constrain(y), (pk, pv)
 
             with jax.named_scope("block_scan"):
                 x, (pages_k, pages_v) = lax.scan(
                     body, x, (stacked, pages_k, pages_v))
             with jax.named_scope("head"):
-                logits = self.emb.attend(self.ln_f(x))
+                logits = tp_constrain(self.emb.attend(self.ln_f(x)))
         return logits, (pages_k, pages_v, tables)
 
     def grad_sync_scan_paths(self):

@@ -26,6 +26,38 @@ __all__ = ["AdditiveAttention", "DotProductAttention", "MultiHeadAttention",
            "dot_product_attention_weights"]
 
 
+def _tp_paged_kernel(kernel, q, pages_k, pages_v, *rest, head_dim: int):
+    """Run a paged Pallas kernel PER SHARD over the active tp scope's
+    head groups (ISSUE 15): the kernel is head-parallel by construction
+    (its grid iterates heads independently), so a ``shard_map`` over the
+    model axis hands each device its ``H/tp`` local heads of the query
+    and of every pool block — block tables and lengths replicate. With
+    no scope active the kernel runs whole, unchanged. ``head_dim`` is
+    the axis of ``q`` (and of the kernel's output) carrying heads; pool
+    leaves always carry heads on axis 2 (``[N, bs, H, hd]`` values,
+    ``[N, bs, H]`` scale pages)."""
+    from ..parallel.sharding import current_tp_shard
+    scope = current_tp_shard()
+    if scope is None:
+        return kernel(q, pages_k, pages_v, *rest)
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.overlap import shard_map_compat
+    mesh, axis = scope
+    qspec = P(*[axis if i == head_dim else None for i in range(q.ndim)])
+
+    def pool_spec(pool):
+        return jax.tree_util.tree_map(
+            lambda leaf: P(*[axis if i == 2 else None
+                             for i in range(leaf.ndim)]), pool)
+
+    sharded = shard_map_compat(
+        kernel, mesh=mesh,
+        in_specs=(qspec, pool_spec(pages_k), pool_spec(pages_v))
+        + tuple(P() for _ in rest),
+        out_specs=qspec)
+    return sharded(q, pages_k, pages_v, *rest)
+
+
 def dot_product_attention_weights(q, k, mask=None, scale: Optional[float] = None):
     """softmax(q·kᵀ/√d) with additive masking; q [B, Tq, D], k [B, Tk, D]."""
     d = q.shape[-1]
@@ -213,7 +245,11 @@ class MultiHeadAttention(Module):
         with jax.named_scope("out_proj"):
             out = proj("wo", ctx, out_d)
         if return_kv:
-            return out, (k, v)
+            # serving prefill captures (k, v) into the paged pools; under
+            # a tp_shard_scope (ISSUE 15) pin them head-sharded so the
+            # engine's scatter lands on the sharded pools reshard-free
+            from ..parallel.sharding import tp_constrain
+            return out, (tp_constrain(k, 2), tp_constrain(v, 2))
         return out
 
     def decode(self, q_in, pages_k, pages_v, tables, positions, active,
@@ -238,8 +274,15 @@ class MultiHeadAttention(Module):
         the serving engine reaches it via
         ``model.apply(..., method="decode_step")``. Quantized pools (the
         ``(int8, scales)`` tuples, ISSUE 14) flow through transparently:
-        the scatter quantizes, the kernel/gather dequantizes."""
+        the scatter quantizes, the kernel/gather dequantizes. Under an
+        active ``tp_shard_scope`` (ISSUE 15) the projections and pools
+        are constrained head-sharded — qkv column-parallel, attention on
+        local heads, the out projection's row-parallel partial sums
+        all-reduced — the Megatron tp recipe with the partitioner
+        inserting the collectives; the paged kernel path runs per shard
+        via :func:`_tp_paged_kernel`."""
         from ..serve.kv_cache import gather_pages, scatter_token_pages
+        from ..parallel.sharding import tp_constrain
         with self.scope():
             pol = current_policy()
             d_model = q_in.shape[-1]
@@ -254,30 +297,36 @@ class MultiHeadAttention(Module):
                                preferred_element_type=pol.accum_dtype)
 
             with jax.named_scope("qkv_proj"):
-                q = proj("wq", q_in, h * hd).reshape(S, 1, h, hd)
-                k = proj("wk", q_in, h * hd).reshape(S, 1, h, hd)
-                v = proj("wv", q_in, h * hd).reshape(S, 1, h, hd)
+                q = tp_constrain(
+                    proj("wq", q_in, h * hd).reshape(S, 1, h, hd), 2)
+                k = tp_constrain(
+                    proj("wk", q_in, h * hd).reshape(S, 1, h, hd), 2)
+                v = tp_constrain(
+                    proj("wv", q_in, h * hd).reshape(S, 1, h, hd), 2)
             with jax.named_scope("kv_scatter"):
-                pages_k = scatter_token_pages(pages_k, k[:, 0], tables,
-                                              positions, active)
-                pages_v = scatter_token_pages(pages_v, v[:, 0], tables,
-                                              positions, active)
+                pages_k = tp_constrain(
+                    scatter_token_pages(pages_k, k[:, 0], tables,
+                                        positions, active), 2)
+                pages_v = tp_constrain(
+                    scatter_token_pages(pages_v, v[:, 0], tables,
+                                        positions, active), 2)
             # the new token sees itself: effective length = position + 1
             eff_len = jnp.where(active, positions + 1, 0)
             if impl == "paged":
                 from .pallas_attention import paged_decode_attention
                 with jax.named_scope("paged_attention"):
-                    ctx = paged_decode_attention(
-                        q[:, 0], pages_k, pages_v, tables, eff_len)
+                    ctx = _tp_paged_kernel(
+                        paged_decode_attention, q[:, 0], pages_k,
+                        pages_v, tables, eff_len, head_dim=1)
                     ctx = ctx.reshape(S, 1, h, hd).astype(pol.compute_dtype)
             else:
                 with jax.named_scope("sdpa_xla"):
                     kg = gather_pages(pages_k, tables)      # [S, W, h, hd]
                     vg = gather_pages(pages_v, tables)
                     ctx = self._sdpa_row(q, kg, vg, eff_len, pol, hd)
-            ctx = ctx.reshape(S, 1, h * hd)
+            ctx = tp_constrain(ctx, 2).reshape(S, 1, h * hd)
             with jax.named_scope("out_proj"):
-                out = proj("wo", ctx, out_d)
+                out = tp_constrain(proj("wo", ctx, out_d))
             return out, pages_k, pages_v
 
     @staticmethod
@@ -338,8 +387,12 @@ class MultiHeadAttention(Module):
         ISSUE 14) — streams only the slot's own pages instead of the
         O(W)-per-row gather; tolerance-accurate vs the oracle, bit-equal
         to the q_len=1 kernel at Q=1. Quantized pools flow through both
-        (scatter quantizes, kernel/gather dequantizes)."""
+        (scatter quantizes, kernel/gather dequantizes). Under an active
+        ``tp_shard_scope`` (ISSUE 15) the span runs tp-sharded exactly
+        like :meth:`decode` — head-sharded projections/pools/kernel,
+        all-reduced out projection."""
         from ..serve.kv_cache import gather_pages, scatter_span_pages
+        from ..parallel.sharding import tp_constrain
         if impl not in ("xla", "paged"):
             raise ValueError(
                 f"decode_span supports impl='xla'|'paged', got {impl!r}")
@@ -357,20 +410,26 @@ class MultiHeadAttention(Module):
                                preferred_element_type=pol.accum_dtype)
 
             with jax.named_scope("qkv_proj"):
-                q = proj("wq", q_in, h * hd).reshape(S, Q, h, hd)
-                k = proj("wk", q_in, h * hd).reshape(S, Q, h, hd)
-                v = proj("wv", q_in, h * hd).reshape(S, Q, h, hd)
+                q = tp_constrain(
+                    proj("wq", q_in, h * hd).reshape(S, Q, h, hd), 2)
+                k = tp_constrain(
+                    proj("wk", q_in, h * hd).reshape(S, Q, h, hd), 2)
+                v = tp_constrain(
+                    proj("wv", q_in, h * hd).reshape(S, Q, h, hd), 2)
             n_eff = jnp.where(active, n, 0)
             with jax.named_scope("kv_scatter"):
-                pages_k = scatter_span_pages(pages_k, k, tables, start,
-                                             n_eff, write_from)
-                pages_v = scatter_span_pages(pages_v, v, tables, start,
-                                             n_eff, write_from)
+                pages_k = tp_constrain(
+                    scatter_span_pages(pages_k, k, tables, start,
+                                       n_eff, write_from), 2)
+                pages_v = tp_constrain(
+                    scatter_span_pages(pages_v, v, tables, start,
+                                       n_eff, write_from), 2)
             if impl == "paged":
                 from .pallas_attention import paged_span_attention
                 with jax.named_scope("paged_span_attention"):
-                    ctx = paged_span_attention(q, pages_k, pages_v,
-                                               tables, start, n_eff)
+                    ctx = _tp_paged_kernel(
+                        paged_span_attention, q, pages_k, pages_v,
+                        tables, start, n_eff, head_dim=2)
                     ctx = ctx.astype(pol.compute_dtype)
             else:
                 with jax.named_scope("sdpa_xla"):
@@ -389,7 +448,7 @@ class MultiHeadAttention(Module):
                                                    vg, eff_len, pol,
                                                    hd))
                     ctx = jnp.concatenate(ctxs, axis=1)  # [S, Q, h, hd]
-            ctx = ctx.reshape(S, Q, h * hd)
+            ctx = tp_constrain(ctx, 2).reshape(S, Q, h * hd)
             with jax.named_scope("out_proj"):
-                out = proj("wo", ctx, out_d)
+                out = tp_constrain(proj("wo", ctx, out_d))
             return out, pages_k, pages_v

@@ -194,6 +194,7 @@ def build_report(analysis: ModuleAnalysis, *,
     grad_ar_wire = grad_ar_count = 0
     grad_rows = []
     gs_scope = hloprof_grad_sync_scope()
+    decode_rows_comm = []
     for c in inventory:
         wire_total = c.wire_bytes * c.multiplier
         t_comm_ms = wire_total / comm_bw * 1e3
@@ -205,14 +206,22 @@ def build_report(analysis: ModuleAnalysis, *,
         # the accumulated-gradient sync is traced OUTSIDE the transpose
         # (no backward metadata) but is still the gradient collective.
         is_grad_sync = bool(c.scope) and c.scope[0] == gs_scope
+        # a collective under a decode/* scope is SERVING communication
+        # (ISSUE 15: the tp-sharded tick's out-proj/ffn all-reduces and
+        # any AG/RS the partitioner derives) — classified into the
+        # serving comm table below instead of falling through unlabeled
+        is_decode = bool(c.scope) and c.scope[0] == DECODE_SCOPE
         overlappable = c.backward or is_grad_sync
         d = c.to_dict()
         d.update({
             "wire_bytes_total": round(wire_total),
             "t_comm_ms": round(t_comm_ms, 6),
             "overlappable": overlappable,
+            "region": DECODE_SCOPE if is_decode else None,
         })
         collectives.append(d)
+        if is_decode:
+            decode_rows_comm.append(d)
         total_wire += wire_total
         if overlappable:
             overlappable_ms += t_comm_ms
@@ -279,6 +288,29 @@ def build_report(analysis: ModuleAnalysis, *,
             if d_bytes else None,
             "scopes": len(decode_rows),
         }
+        if decode_rows_comm:
+            # the serving comm table (ISSUE 15): tensor-parallel
+            # collectives the sharded tick pays per dispatch — on the
+            # tick's critical path (no backward to hide behind), so
+            # their wire time adds directly to per-token latency
+            decode["comm"] = {
+                "ops": len(decode_rows_comm),
+                "kinds": {},
+                "wire_bytes_total": round(sum(
+                    r["wire_bytes_total"] for r in decode_rows_comm)),
+                "t_comm_ms": round(sum(
+                    r["t_comm_ms"] for r in decode_rows_comm), 6),
+                "collectives": [
+                    {"kind": r["kind"], "scope": r["scope"],
+                     "payload_bytes": r["payload_bytes"],
+                     "wire_bytes_total": r["wire_bytes_total"],
+                     "t_comm_ms": r["t_comm_ms"],
+                     "multiplier": r["multiplier"]}
+                    for r in decode_rows_comm],
+            }
+            for r in decode_rows_comm:
+                k = decode["comm"]["kinds"]
+                k[r["kind"]] = k.get(r["kind"], 0) + 1
 
     # -- headline ------------------------------------------------------------
     compute_ms = flops_total / peak * 1e3
@@ -469,6 +501,14 @@ def format_report(report: Dict[str, Any], top_n: int = 12) -> str:
                 f"  {row['scope']:<32}{row['payload_bytes'] / 1e6:>8.2f} MB"
                 f"{row['t_comm_ms']:>10.4f} ms  x{row['multiplier']:g}"
                 f"  sched_distance={'-' if sd is None else sd}")
+    dec_comm = (report.get("decode") or {}).get("comm")
+    if dec_comm:
+        kinds = ", ".join(f"{k} x{v}" for k, v in
+                          sorted(dec_comm.get("kinds", {}).items()))
+        lines.append(
+            f"decode tp comm: {dec_comm['ops']} ops ({kinds}), "
+            f"{dec_comm['wire_bytes_total'] / 1e6:.3f} MB wire/dev, "
+            f"{dec_comm['t_comm_ms']:.4f} ms per tick (critical path)")
     measured = report.get("measured")
     if measured:
         lines.append(

@@ -114,6 +114,11 @@ def summarize_requests(records: List[Dict[str, Any]]
         out["quant_dtype"] = next(
             (r.get("quant_dtype") for r in reversed(ticks)
              if r.get("quant_dtype") is not None), None)
+        # tensor-parallel mesh width (ISSUE 15): a gauge like the rest —
+        # with tp > 1 the kv_bytes_per_token above is PER SHARD
+        out["tp_degree"] = next(
+            (r.get("tp_degree") for r in reversed(ticks)
+             if r.get("tp_degree") is not None), None)
     dl = [r for r in terminal if r.get("deadline_s") is not None]
     met = [r for r in dl
            if r.get("finish_reason") in GOODPUT_REASONS

@@ -241,9 +241,17 @@ def format_summary(s: Dict[str, Any]) -> str:
                          f"(rate {sv.get('retention_hit_rate')}, "
                          f"{sv.get('retained_blocks')} retained now)")
         if sv.get("kv_bytes_per_token") is not None:
+            tp = sv.get("tp_degree") or 1
             lines.append(f"  {'KV bytes/token':<28}"
                          f"{sv['kv_bytes_per_token']} "
-                         f"({sv.get('quant_dtype')})")
+                         f"({sv.get('quant_dtype')}"
+                         + (f", per shard)" if tp > 1 else ")"))
+        # the serving mesh shape (ISSUE 15): rendered whenever the tick
+        # stream says the engine ran tensor-parallel
+        if (sv.get("tp_degree") or 1) > 1:
+            lines.append(f"  {'tensor-parallel mesh':<28}"
+                         f"tp={sv['tp_degree']} "
+                         f"(head-sharded KV, per-shard bytes)")
     # autoscaler decisions (ISSUE 13) — rendered whenever scale events
     # exist, even for a stream with no request records
     sc = (sv or {}).get("scale")

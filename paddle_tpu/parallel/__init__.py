@@ -20,7 +20,8 @@ This package owns:
 """
 
 from .sharding import (ShardingRules, spec_tree, named_shardings,
-                       shard_tree, sharded_init)
+                       shard_tree, sharded_init, tp_shard_scope,
+                       current_tp_shard, tp_constrain)
 from .overlap import (Bucket, partition_buckets, sync_tangent,
                       mark_buckets, apply_bucket_sync, sync_scan_slice,
                       scan_sync_scope, resolve_grad_sync)
@@ -37,7 +38,8 @@ from .megatron import megatron_sp_rules, make_megatron_sp_lm_apply
 
 __all__ = [
     "ShardingRules", "spec_tree", "named_shardings", "shard_tree",
-    "sharded_init", "ring_attention", "make_ring_attention",
+    "sharded_init", "tp_shard_scope", "current_tp_shard", "tp_constrain",
+    "ring_attention", "make_ring_attention",
     "ulysses_attention", "make_ulysses_attention", "initialize",
     "pipeline_apply", "make_pipeline", "pipeline_grads_1f1b",
     "make_pipeline_1f1b", "pipeline_loss_apply", "make_pipeline_loss",

@@ -51,15 +51,18 @@ from .sharding import ShardingRules
 __all__ = ["megatron_sp_rules", "make_megatron_sp_lm_apply"]
 
 
-def megatron_sp_rules() -> ShardingRules:
+def megatron_sp_rules(model_axis: str = "model") -> ShardingRules:
     """The param-tree layout both tp paths share: qkv/ffn1 column-parallel,
     wo/ffn2 row-parallel, everything else (LN, embeddings, biases of
-    row-parallel layers) replicated."""
+    row-parallel layers) replicated. ``model_axis`` names the mesh axis
+    carrying the tensor-parallel degree (callers with a non-standard
+    axis name — e.g. ``DecodeEngine(tp_axis=)`` — get matching specs)."""
+    m = model_axis
     return ShardingRules([
-        ("*/attn/wq", P(None, "model")), ("*/attn/wk", P(None, "model")),
-        ("*/attn/wv", P(None, "model")), ("*/attn/wo", P("model", None)),
-        ("*/ffn1/w", P(None, "model")), ("*/ffn1/b", P("model")),
-        ("*/ffn2/w", P("model", None)),
+        ("*/attn/wq", P(None, m)), ("*/attn/wk", P(None, m)),
+        ("*/attn/wv", P(None, m)), ("*/attn/wo", P(m, None)),
+        ("*/ffn1/w", P(None, m)), ("*/ffn1/b", P(m)),
+        ("*/ffn2/w", P(m, None)),
     ])
 
 

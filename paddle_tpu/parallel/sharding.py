@@ -23,14 +23,17 @@ value-independent constants and land on one device.
 
 from __future__ import annotations
 
+import contextlib
 import fnmatch
-from typing import List, Sequence, Tuple, Union
+import threading
+from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["ShardingRules", "spec_tree", "named_shardings", "shard_tree",
-           "sharded_init"]
+           "sharded_init", "tp_shard_scope", "current_tp_shard",
+           "tp_constrain"]
 
 
 def _path_str(path) -> str:
@@ -87,6 +90,78 @@ def shard_tree(mesh: Mesh, tree, specs=None):
     elif isinstance(specs, ShardingRules):
         specs = specs(tree)
     return jax.device_put(tree, named_shardings(mesh, specs))
+
+
+# ---------------------------------------------------------------------------
+# shard-in-scope (ISSUE 15): a trace-time tensor-parallel context
+# ---------------------------------------------------------------------------
+#
+# The serving decode programs are written once, mesh-oblivious
+# (`models/transformer.py` prefill/decode_step/decode_span), and the
+# engine runs them either on one device or over a tp mesh. The seam is
+# this scope: the engine enters `tp_shard_scope(mesh, axis)` around its
+# traced program bodies, and the layers sprinkle `tp_constrain` at the
+# points whose placement must be PINNED (the head-sharded KV pools and
+# projections, the replicated residual/logits) — identity no-ops when no
+# scope is active, so the single-device path stays byte-identical.
+# Everything not constrained is left to the SPMD partitioner, which is
+# the whole reason one set of program bodies serves both worlds.
+
+_TP_TLS = threading.local()
+
+
+def _tp_stack() -> list:
+    if not hasattr(_TP_TLS, "stack"):
+        _TP_TLS.stack = []
+    return _TP_TLS.stack
+
+
+@contextlib.contextmanager
+def tp_shard_scope(mesh: Mesh, axis: str = "model"):
+    """Activate a tensor-parallel shard scope for code traced inside:
+    :func:`tp_constrain` calls become real ``with_sharding_constraint``\\ s
+    on ``mesh``'s ``axis``. Thread-local (trace-time state, like
+    ``core.dtypes.current_policy``)."""
+    _tp_stack().append((mesh, axis))
+    try:
+        yield
+    finally:
+        _tp_stack().pop()
+
+
+def current_tp_shard() -> Optional[Tuple[Mesh, str]]:
+    """The innermost active ``(mesh, axis)`` shard scope, or None."""
+    stack = _tp_stack()
+    return stack[-1] if stack else None
+
+
+def tp_constrain(x, dim: Optional[int] = None):
+    """Constrain every array leaf of ``x`` to carry the scope's tp axis
+    on dimension ``dim`` (``None`` = fully replicated). Identity when no
+    :func:`tp_shard_scope` is active — layers call this unconditionally
+    and single-device traces are unchanged. ``dim`` indexes each leaf's
+    OWN axes, so a quantized KV pool's ``(values [..., hd], scales
+    [...])`` tuple constrains both leaves with one call."""
+    scope = current_tp_shard()
+    if scope is None:
+        return x
+    mesh, axis = scope
+
+    def one(leaf):
+        if dim is None:
+            spec = P()
+        else:
+            # TRIMMED spec (trailing replicated dims omitted): the
+            # partitioner normalizes constraint outputs to this form,
+            # and some jax versions hash trimmed vs padded specs as
+            # DIFFERENT shardings — a padded input spec would retrace
+            # the engine's second call on a no-op layout change
+            parts: List[Optional[str]] = [None] * dim + [axis]
+            spec = P(*parts)
+        return jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(one, x)
 
 
 def sharded_init(model, rng, *args, mesh: Mesh, rules=None, **kwargs):

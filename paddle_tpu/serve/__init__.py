@@ -16,8 +16,11 @@ doctrine):
   programs (padded-width or chunked prefill, max-slot decode tick with
   an active mask — optionally the ``[S, 1+k]`` speculative verify
   tick), donated KV carry, greedy or seeded-stochastic sampling
-  (:class:`SamplingConfig`), COW fork-on-write, retrace accounting, and
-  the structured :class:`AdmitProbe` backpressure verdict.
+  (:class:`SamplingConfig`), COW fork-on-write, retrace accounting,
+  the structured :class:`AdmitProbe` backpressure verdict, and — with
+  ``mesh=`` (ISSUE 15) — the whole tick tensor-parallel over a tp mesh
+  (megatron-placed params, head-axis-sharded KV pools, token-identical
+  to single-device with the host side shard-oblivious).
 - :mod:`.scheduler` — :class:`ContinuousBatchingScheduler`: iteration-
   level request admission/eviction between decode ticks with
   FCFS/SJF/priority queue policies, chunked-prefill interleaving,

@@ -63,6 +63,20 @@ agreement vs the f32 pool on its gate set. **Radix retention** rides
 the prefix cache (see ``kv_cache``): evicted registered blocks park in
 a retained LRU and later same-prefix admissions hit them without any
 concurrently-resident sharer.
+
+**Tensor-parallel serving** (``mesh=``, ISSUE 15): the same two
+programs run sharded over a tp mesh — params placed by the megatron
+rule, KV pools split on the HEAD axis (each shard owns ``H/tp`` heads
+of every block; int8 scale pages split identically), per-shard
+attention over local heads, and the row-parallel out/ffn2 projections
+all-reduced back to the replicated residual so the logits assemble on
+the existing tp head path. The host side never learns about shards:
+one logical block table drives every device's pool, which is why CoW,
+retention, speculation, chunking and the scheduler compose unchanged
+and the tp=2 engine is token-identical (greedy, f32) to the
+single-device one. Capacity accounting (``kv_bytes_per_token``) turns
+per-shard, so resident sequences at equal per-device HBM scale with
+the mesh.
 """
 
 from __future__ import annotations
@@ -76,6 +90,7 @@ import jax
 import jax.numpy as jnp
 
 from .kv_cache import PagedKVCache, scatter_prefill_pages
+from ..parallel.sharding import tp_constrain, tp_shard_scope
 
 __all__ = ["DecodeEngine", "AdmitProbe", "SamplingConfig"]
 
@@ -221,6 +236,25 @@ class DecodeEngine:
       kv_dtype: ``None``/``"f32"`` (pools at ``dtype``) or ``"int8"`` —
         quantized pools with per-row-per-head scale pages (ISSUE 14):
         ~4x fewer HBM bytes per resident token, dequantized in-kernel.
+      mesh: optional ``jax.sharding.Mesh`` carrying a ``tp_axis`` axis
+        (ISSUE 15): the engine's two compiled programs run TENSOR
+        PARALLEL over it — params placed by the megatron
+        ``param_sharding`` rule, KV pools sharded on the head axis
+        (each shard holds ``H/tp`` heads of every block, int8 scale
+        pages split identically), attention + MLP as the tp-sharded
+        forward with the out/ffn2 all-reduce assembling the replicated
+        residual and logits. The HOST side is shard-oblivious: one
+        logical block table, so CoW forks, quantized scatters,
+        retention, speculation and the scheduler/fleet compose
+        unchanged, and ``compile_counts()`` stays {prefill: 1, tick: 1}.
+        ``mesh=None`` (default) is the single-device engine, unchanged.
+      param_sharding: with ``mesh=``, the parameter placement — a
+        :class:`~paddle_tpu.parallel.ShardingRules` or a PartitionSpec
+        pytree (default: :func:`~paddle_tpu.parallel.megatron_sp_rules`,
+        the same layout the training tp paths use, so tp-trained
+        checkpoints serve with zero resharding).
+      tp_axis: the mesh axis name carrying the tensor-parallel degree
+        (default ``"model"``, the framework's standard axis).
     """
 
     def __init__(self, model, variables, *, max_slots: int = 4,
@@ -232,7 +266,8 @@ class DecodeEngine:
                  prefill_chunk: Optional[int] = None,
                  sampling: Optional[SamplingConfig] = None,
                  telemetry=None, dtype=jnp.float32,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 mesh=None, param_sharding=None, tp_axis: str = "model"):
         self.model = model
         self.variables = variables
         self.telemetry = telemetry
@@ -252,6 +287,32 @@ class DecodeEngine:
         num_heads = model.blocks[0].attn.num_heads
         dim = model.emb.dim
         head_dim = model.blocks[0].attn.head_dim or dim // num_heads
+        # tensor-parallel mesh (ISSUE 15): resolve the tp degree, place
+        # the params by the megatron rule, and shard the pools on the
+        # head axis. All of it is PLACEMENT — the traced program bodies
+        # below are identical either way (shard-in-scope pins the layout
+        # at trace time; the SPMD partitioner inserts the collectives).
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        if mesh is not None:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if tp_axis not in sizes:
+                raise ValueError(f"mesh has no {tp_axis!r} axis "
+                                 f"(axes: {list(sizes)})")
+            self.tp_degree = int(sizes[tp_axis])
+            if num_heads % self.tp_degree:
+                raise ValueError(
+                    f"num_heads {num_heads} must divide by tp degree "
+                    f"{self.tp_degree} (head-axis KV sharding)")
+            from ..parallel.sharding import shard_tree
+            if param_sharding is None:
+                from ..parallel.megatron import megatron_sp_rules
+                # thread tp_axis through: a mesh whose tp axis is not
+                # named "model" must get matching default specs
+                param_sharding = megatron_sp_rules(model_axis=tp_axis)
+            self.variables = shard_tree(mesh, variables, param_sharding)
+        else:
+            self.tp_degree = 1
         if max_blocks_per_seq is None:
             max_blocks_per_seq = max(1, model.max_len // block_size)
         if max_blocks_per_seq * block_size > model.max_len:
@@ -264,7 +325,9 @@ class DecodeEngine:
             num_layers, num_heads, head_dim, num_blocks, block_size,
             max_slots=max_slots, max_blocks_per_seq=max_blocks_per_seq,
             dtype=dtype, share_prefix=share_prefix, kv_dtype=kv_dtype,
-            retain_prefix=retain_prefix)
+            retain_prefix=retain_prefix, tp_degree=self.tp_degree)
+        if mesh is not None:
+            self.cache.shard_pools(mesh, tp_axis)
         self.max_slots = max_slots
         # host-authoritative slot state beside the cache's tables/lengths
         self.active = np.zeros((max_slots,), bool)
@@ -393,19 +456,53 @@ class DecodeEngine:
                     role(2), x).astype(jnp.int32)   # [S, 1+k]
                 return pages_k, pages_v, accept, resample, bonus
 
+        # shard-in-scope wrapping (ISSUE 15): with a mesh, every traced
+        # body runs inside tp_shard_scope (the attention layer pins
+        # head-sharded projections/pools, the model pins replicated
+        # residual/logits). _in_scope is the ONE place scope entry
+        # happens; without a mesh it is the identity and every
+        # tp_constrain below no-ops, so the single-device trace is
+        # byte-identical.
+        def _in_scope(fn):
+            if self.mesh is None:
+                return fn
+
+            def wrapped(*args):
+                with tp_shard_scope(self.mesh, self.tp_axis):
+                    return fn(*args)
+            return wrapped
+
+        # The compiled programs' RETURNED pools are constrained back to
+        # the head-sharded input placement — without the output pin the
+        # partitioner may pick a different pool layout, which both
+        # breaks donation and retraces the next call on the changed
+        # input sharding (the no-retrace invariant would die quietly).
+        def _pin_pools(fn, pool_outs=(0, 1)):
+            def pinned(*args):
+                out = fn(*args)
+                return tuple(tp_constrain(o, 3) if i in pool_outs else o
+                             for i, o in enumerate(out))
+            return pinned
+
         # donate the KV pools: the tick's carry flips between two
         # allocations instead of growing HBM per token
-        self._prefill_fn = jax.jit(prefill_fn, donate_argnums=(1, 2))
-        self._tick_fn = jax.jit(tick_fn, donate_argnums=(1, 2))
+        self._prefill_fn = jax.jit(_in_scope(_pin_pools(prefill_fn)),
+                                   donate_argnums=(1, 2))
+        self._tick_fn = jax.jit(_in_scope(_pin_pools(tick_fn)),
+                                donate_argnums=(1, 2))
         # COW block copy: [L, bs, H, hd] pages move pool-internally, one
         # tiny donated program (not an engine entry point — not counted
         # in compile_counts, traced once for the process lifetime).
         # tree_map covers the quantized (values, scales) tuple pools —
-        # a fork copies the scale page with its value page.
-        self._cow_fn = jax.jit(
-            lambda pages, src, dst: jax.tree_util.tree_map(
-                lambda p: p.at[:, dst].set(p[:, src]), pages),
-            donate_argnums=(0,))
+        # a fork copies the scale page with its value page. Sharded
+        # pools copy shard-locally (the block axis is unsharded, the
+        # head axis untouched) — the output pin keeps the carry layout.
+        def _cow(pages, src, dst):
+            out = jax.tree_util.tree_map(
+                lambda p: p.at[:, dst].set(p[:, src]), pages)
+            return tp_constrain(out, 3)
+
+        self._cow_fn = jax.jit(_in_scope(_cow), donate_argnums=(0,))
         self._zero_keys = jnp.zeros((max_slots, 2), jnp.uint32)
         seed = sampling.seed if sampling is not None else 0
         self._tick_keys = jax.jit(lambda t: jax.vmap(
@@ -831,10 +928,13 @@ class DecodeEngine:
                 "draft_accept_rate": round(accepted_tick / drafted_tick,
                                            4) if drafted_tick else None,
                 # gauges, not per-tick deltas: the retained-LRU size and
-                # the pool's capacity accounting (ISSUE 14)
+                # the pool's capacity accounting (ISSUE 14); with a tp
+                # mesh kv_bytes_per_token is PER SHARD and tp_degree
+                # carries the mesh width (ISSUE 15)
                 "retained_blocks": self.cache.retained_blocks,
                 "kv_bytes_per_token": self.cache.kv_bytes_per_token,
                 "quant_dtype": self.cache.quant_dtype,
+                "tp_degree": self.tp_degree,
                 **delta,
             })
         return self.tokens.copy()
@@ -873,13 +973,14 @@ class DecodeEngine:
         report = attr_lib.build_report(
             analysis,
             device_kind=getattr(jax.devices()[0], "device_kind", ""),
-            n_devices=1,
+            n_devices=self.tp_degree,
             cost_analysis_flops=lowered_hlo_flops(compiled),
             meta={"program": "decode_tick", "max_slots": self.max_slots,
                   "context_width": self._W,
                   "block_size": self.cache.block_size,
                   "attention": self.attention,
-                  "speculative": self.speculative})
+                  "speculative": self.speculative,
+                  "tp_degree": self.tp_degree})
         if emit and self.telemetry is not None:
             self.telemetry.emit_event(report)
         return report

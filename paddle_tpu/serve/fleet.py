@@ -1180,16 +1180,28 @@ def build_proc_spec(model, variables, root: str, *,
                     engine_kwargs: Optional[Dict[str, Any]] = None,
                     model_spec: Optional[Dict[str, Any]] = None,
                     order: str = "fcfs",
-                    est_tick_s: Optional[float] = None
+                    est_tick_s: Optional[float] = None,
+                    mesh_axes: Optional[Dict[str, int]] = None
                     ) -> Dict[str, Any]:
     """The child-process build spec: model constructor kwargs, engine
     kwargs, scheduler policy, and the variables npz (written once under
     ``root``; every replica loads the same file — a training checkpoint
-    serves unmodified, just across a process boundary)."""
+    serves unmodified, just across a process boundary).
+
+    ``mesh_axes`` (ISSUE 15): an optional ``{axis_name: size}`` dict —
+    e.g. ``{"model": 2}`` — shipped as ``spec["mesh"]`` so a
+    process-mode replica builds its engine TENSOR-PARALLEL over its own
+    local devices (a Mesh object cannot cross the JSON wire; the axis
+    layout can). Deliberately ABSENT from the spec when None, so a
+    single-device spec is byte-identical to the pre-tp schema —
+    replicas on old and new code agree on the frame bytes."""
     from .replica_proc import save_variables_npz
     npz = os.path.join(root, "variables.npz")
     save_variables_npz(npz, variables)
-    return {"model": dict(model_spec or _introspect_lm(model)),
+    spec = {"model": dict(model_spec or _introspect_lm(model)),
             "engine": dict(engine_kwargs or {}),
             "variables_npz": npz, "order": order,
             "est_tick_s": est_tick_s, "root": root}
+    if mesh_axes:
+        spec["mesh"] = dict(mesh_axes)
+    return spec

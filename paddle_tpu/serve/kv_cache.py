@@ -486,10 +486,21 @@ class PagedKVCache:
                  num_blocks: int, block_size: int, max_slots: int,
                  max_blocks_per_seq: int, dtype=jnp.float32,
                  share_prefix: bool = False, kv_dtype: Optional[str] = None,
-                 retain_prefix: bool = True):
+                 retain_prefix: bool = True, tp_degree: int = 1):
         self.num_layers = num_layers
         self.num_heads = num_heads
         self.head_dim = head_dim
+        # tensor-parallel degree (ISSUE 15): the pools are LOGICALLY
+        # [L, N, bs, H, hd] but physically head-sharded over a tp mesh
+        # (`shard_pools`), so every per-byte accounting number here is
+        # PER SHARD — each device holds H/tp heads of every block, and
+        # capacity at equal per-device HBM scales with the mesh. Tables,
+        # lengths and the allocator stay shard-oblivious: a block id
+        # names the same logical block on every shard.
+        if num_heads % tp_degree:
+            raise ValueError(f"num_heads {num_heads} must divide by "
+                             f"tp_degree {tp_degree}")
+        self.tp_degree = int(tp_degree)
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.max_slots = max_slots
@@ -565,19 +576,24 @@ class PagedKVCache:
     @property
     def kv_bytes_per_token(self) -> int:
         """HBM bytes one resident token costs across all layers, K and
-        V: the capacity accounting behind the int8 ~3-4x win (values at
-        1 byte + one f32 scale per head vs 4 bytes per element)."""
+        V, PER SHARD: the capacity accounting behind the int8 ~3-4x win
+        (values at 1 byte + one f32 scale per head vs 4 bytes per
+        element). Under tensor parallelism each device holds
+        ``num_heads / tp_degree`` heads of every row (ISSUE 15), so this
+        is the number a device's HBM budget divides by — capacity scales
+        with the mesh."""
         if self.quantized:
             per_head = self.head_dim * 1 + 4          # int8 + f32 scale
         else:
             per_head = self.head_dim * jnp.dtype(self.dtype).itemsize
-        return 2 * self.num_layers * self.num_heads * per_head
+        heads_local = self.num_heads // self.tp_degree
+        return 2 * self.num_layers * heads_local * per_head
 
     @property
     def bytes_per_block(self) -> int:
-        """HBM bytes one pool block costs (both pools, scales
+        """HBM bytes one pool block costs PER SHARD (both pools, scales
         included) — the equal-pool-bytes denominator the quantization
-        bench leg sizes with."""
+        and tensor-parallel bench legs size with."""
         return self.kv_bytes_per_token * self.block_size
 
     def blocks_needed(self, length: int) -> int:
@@ -727,6 +743,32 @@ class PagedKVCache:
         self._decref(src)
         self.cow_forks += 1
         return src, dst
+
+    def shard_pools(self, mesh, axis: str = "model") -> None:
+        """Commit the device pools head-sharded over ``mesh``'s ``axis``
+        (ISSUE 15): values ``[L, N, bs, H, hd]`` split on the H axis,
+        int8 scale pages ``[L, N, bs, H]`` split identically, so every
+        shard owns its head group of EVERY block. The host side — tables,
+        lengths, allocator, prefix cache — is untouched and stays
+        shard-oblivious: admission/eviction/CoW/retention reason about
+        one logical block table while the bytes live distributed."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        # TRIMMED spec (no trailing None): matches the normalized form
+        # `tp_constrain` pins on the compiled programs' pool outputs, so
+        # the carry's sharding hashes identical call to call (padded vs
+        # trimmed specs retrace on some jax versions). Covers both the
+        # [L, N, bs, H, hd] value pages and [L, N, bs, H] scale pages.
+        sh = NamedSharding(mesh, P(None, None, None, axis))
+
+        def put(pool):
+            if isinstance(pool, tuple):
+                return (jax.device_put(pool[0], sh),
+                        jax.device_put(pool[1], sh))
+            return jax.device_put(pool, sh)
+
+        self.k = put(self.k)
+        self.v = put(self.v)
 
     def device_tables(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """The current (tables, lengths) as device operands for a tick."""

@@ -128,7 +128,12 @@ class SettableClock:
 def _build(spec: Dict[str, Any]):
     """Heavy construction (jax import lives here): model from spec,
     variables from the parent's npz (or a seeded init — bit-identical
-    to a parent that used the same seed), engine + scheduler."""
+    to a parent that used the same seed), engine + scheduler. An
+    optional ``spec["mesh"]`` (``{axis_name: size}``, ISSUE 15) builds
+    the engine tensor-parallel over this process's local devices — the
+    Mesh itself is constructed HERE because device handles cannot cross
+    the JSON wire; a spec without the key is the single-device engine,
+    bit-identical to the pre-tp build."""
     import jax
     import jax.numpy as jnp
 
@@ -143,7 +148,23 @@ def _build(spec: Dict[str, Any]):
     else:
         vs = model.init(jax.random.PRNGKey(int(spec.get("seed", 0))),
                         jnp.zeros((1, model.max_len), jnp.int32))
-    engine = DecodeEngine(model, vs, **(spec.get("engine") or {}))
+    ek = dict(spec.get("engine") or {})
+    mesh_axes = spec.get("mesh")
+    if mesh_axes:
+        import numpy as np
+        from jax.sharding import Mesh
+        names = tuple(mesh_axes)
+        sizes = tuple(int(mesh_axes[n]) for n in names)
+        need = int(np.prod(sizes))
+        devs = jax.devices()
+        if len(devs) < need:
+            raise RuntimeError(
+                f"spec mesh {dict(mesh_axes)} needs {need} devices, "
+                f"replica has {len(devs)} — spawn with "
+                f"--xla_force_host_platform_device_count or drop the "
+                f"mesh from the spec")
+        ek["mesh"] = Mesh(np.asarray(devs[:need]).reshape(sizes), names)
+    engine = DecodeEngine(model, vs, **ek)
     buf = EventBuffer()
     clock = SettableClock()
     sched = ContinuousBatchingScheduler(
@@ -175,6 +196,9 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
         rep.update({
             "free_blocks": engine.cache.free_blocks,
             "free_slots": len(engine.free_slots()),
+            # getattr: the engine surface here is duck-typed (tests and
+            # remote views fake it); tp arrived in ISSUE 15
+            "tp_degree": getattr(engine, "tp_degree", 1),
             "engine_ticks": engine.ticks,
             "prefix_hit_blocks": engine.cache.prefix_hit_blocks,
             "cow_forks": engine.cache.cow_forks,

@@ -144,6 +144,22 @@ def test_bench_smoke_cpu_green_and_equal():
             < rt["wave2_fresh_allocs_unretained"])
     assert rt["leak_free"] is True
     assert rt["compile_counts"] == {"prefill": 1, "tick": 1}
+    # ISSUE 15: the tensor-parallel leg — the tp=2 engine (2 forced
+    # host devices) is token-identical to the single-device engine
+    # across two churn waves on ONE engine (zero retraces after
+    # warmup), per-shard KV bytes halve so the per-device capacity
+    # ratio is >= 2, the tick's tp collectives classify into the
+    # serving comm table, and nothing leaks
+    tpl = srv["tp"]
+    assert tpl["ok"] is True, tpl
+    assert tpl["tokens_identical"] is True
+    assert tpl["tp_degree"] == 2
+    assert tpl["compile_counts"] == {"prefill": 1, "tick": 1}
+    assert tpl["per_shard_capacity_ratio"] >= 2.0
+    assert (tpl["kv_bytes_per_token_tp"] * 2
+            == tpl["kv_bytes_per_token_1dev"])
+    assert tpl["decode_comm_ops"] >= 1
+    assert tpl["leak_free"] is True
     # ISSUE 10: the fault-tolerance gate ran — the supervisor resumed an
     # injected crash, a corrupted latest pass was quarantined (renamed
     # .corrupt, never deleted) with fallback to the previous readable
@@ -356,6 +372,25 @@ def test_bench_serving_spec_child_builds(capsys):
     assert out["spec"]["tokens"] > out["base"]["tokens"]
     assert out["draft_accept_rate"] is not None
     assert 0 < out["draft_accept_rate"] <= 1
+
+
+def test_bench_serving_tp_child_builds(capsys):
+    """ISSUE 15: the transformer_decode_tp metric child runs at a tiny
+    config on the conftest 8-device CPU platform — the steady-state tick
+    over a 2-device tensor-parallel mesh with the programs pinned and
+    the PER-SHARD KV accounting at half the single-device bytes."""
+    sys.path.insert(0, REPO)
+    import bench
+    bench.run_serving_tp_bench_child(
+        max_slots=2, block_size=4, seq_len=64, dim=32, layers=2, heads=4,
+        vocab=64, prompt_len=8, warmup_ticks=2, timed_ticks=6)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["child"] == "transformer_decode_tp"
+    assert out["decode_tokens_per_sec"] > 0
+    assert out["tp_degree"] == 2
+    assert out["compile_counts"] == {"prefill": 1, "tick": 1}
+    # half the heads per shard: 2 * L(2) * H_local(2) * hd(8) * 4 bytes
+    assert out["kv_bytes_per_token_per_shard"] == 2 * 2 * 2 * 8 * 4
 
 
 def test_bench_prep_transformer_dp_overlap_builds():

@@ -1340,3 +1340,249 @@ def test_decode_tick_records_carry_retention_and_quant_fields(
     text = format_summary(summarize(mem.records))
     assert "retained prefix hits" in text
     assert "KV bytes/token" in text
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel sharded decode tick (ISSUE 15)
+# ---------------------------------------------------------------------------
+#
+# The tp=2 engine runs the SAME two compiled programs over a 2-device
+# mesh (conftest forces 8 virtual CPU devices): params placed by the
+# megatron rule, KV pools head-sharded, out/ffn2 all-reduced. The
+# contract is the one every serving PR pinned — token-identical (greedy,
+# f32) to the single-device engine across admit/evict/CoW/speculative
+# churn, with compile_counts() == {prefill: 1, tick: 1} and the host
+# side fully shard-oblivious.
+
+
+def _tp_mesh():
+    from jax.sharding import Mesh
+    assert len(jax.devices()) >= 2, "conftest forces 8 CPU devices"
+    return Mesh(np.asarray(jax.devices()[:2]), ("model",))
+
+
+def _churn_run(model, vs, mesh, waves=1, **kw):
+    """One engine, `waves` sequential scheduler waves of 8 ragged
+    requests over 4 slots (admissions + evictions churn within and
+    across waves). Returns (per-wave token lists, engine)."""
+    eng = DecodeEngine(model, vs, max_slots=4, block_size=BS, mesh=mesh,
+                       **kw)
+    rng = np.random.RandomState(3)
+    prompts = [list(rng.randint(0, V, rng.randint(2, 8)))
+               for _ in range(8)]
+    maxnew = [2, 12, 2, 12, 2, 12, 2, 2]
+    out = []
+    for _ in range(waves):
+        sched = ContinuousBatchingScheduler(eng)
+        reqs = [sched.submit(p, m) for p, m in zip(prompts, maxnew)]
+        sched.run()
+        out.append([r.tokens for r in reqs])
+    return out, eng
+
+
+def test_tp_engine_token_identical_greedy_churn(model_and_vars):
+    """The tentpole pin: tp=2 greedy tokens == single-device greedy
+    tokens across two full admit/evict waves on one engine, with zero
+    retraces after warmup (wave 2 reuses wave 1's two programs) and the
+    per-shard KV accounting halved."""
+    model, vs = model_and_vars
+    base, eng_b = _churn_run(model, vs, None, waves=2)
+    tp, eng_t = _churn_run(model, vs, _tp_mesh(), waves=2)
+    assert tp == base
+    assert eng_t.tp_degree == 2 and eng_b.tp_degree == 1
+    assert eng_t.compile_counts() == {"prefill": 1, "tick": 1}
+    assert eng_b.compile_counts() == {"prefill": 1, "tick": 1}
+    # head split halves the per-shard bytes; block math is unchanged
+    assert eng_t.cache.kv_bytes_per_token * 2 \
+        == eng_b.cache.kv_bytes_per_token
+    assert eng_t.cache.blocks_needed(13) == eng_b.cache.blocks_needed(13)
+    # leak-free after both waves: every block back (free or retained)
+    assert eng_t.cache.free_blocks == eng_t.cache.num_blocks - 1
+
+
+def test_tp_engine_stochastic_speculative_identical(model_and_vars):
+    """Seeded stochastic sampling x speculation under tp: the [S3]
+    accept/resample walk replays the exact single-device token stream
+    (same seeds, same coins — the tp mesh only changes WHERE the matmuls
+    run, never the sampled distribution)."""
+    from paddle_tpu.serve import SamplingConfig
+    model, vs = model_and_vars
+    cfg = SamplingConfig(temperature=0.8, top_k=16, seed=11)
+    base, _ = _churn_run(model, vs, None, speculative=3, sampling=cfg)
+    tp, eng = _churn_run(model, vs, _tp_mesh(), speculative=3,
+                         sampling=cfg)
+    assert tp == base
+    assert eng.compile_counts() == {"prefill": 1, "tick": 1}
+
+
+def test_tp_engine_int8_pools_identical(model_and_vars):
+    """Quantized pools under tp: int8 value pages AND f32 scale pages
+    shard on the head axis; quantize-on-scatter/dequant-on-gather run
+    per shard. Tokens match the single-device int8 engine exactly."""
+    model, vs = model_and_vars
+    base, eng_b = _churn_run(model, vs, None, kv_dtype="int8")
+    tp, eng_t = _churn_run(model, vs, _tp_mesh(), kv_dtype="int8")
+    assert tp == base
+    assert eng_t.compile_counts() == {"prefill": 1, "tick": 1}
+    # per-shard int8 accounting: half the heads' values+scales per token
+    assert eng_t.cache.kv_bytes_per_token * 2 \
+        == eng_b.cache.kv_bytes_per_token
+
+
+def test_tp_cow_fork_and_retention_under_sharding(model_and_vars,
+                                                  nprng):
+    """Sharing composes with sharding: duplicate prompts adopt + COW-
+    fork (the donated one-block device copy runs on the sharded pools),
+    a second same-prefix wave revives retained blocks, and the pool
+    stays leak-free — all through the ONE logical block table the host
+    keeps (shard-obliviousness is the design's point)."""
+    model, vs = model_and_vars
+    pre = list(nprng.randint(0, V, 2 * BS))
+    tails = [list(nprng.randint(0, V, 2)) for _ in range(4)]
+
+    def run(mesh):
+        eng = DecodeEngine(model, vs, max_slots=2, block_size=BS,
+                           mesh=mesh)
+        toks = []
+        # wave 1: a CONCURRENT exact-duplicate pair (both slots resident
+        # at once) -> full-chain adoption + partial-boundary COW fork;
+        # wave 2: fresh same-prefix tails with no live sharer ->
+        # retained-LRU hits
+        for wave in ([tails[0], tails[0]], tails[2:]):
+            sched = ContinuousBatchingScheduler(eng)
+            reqs = [sched.submit(pre + t, 4) for t in wave]
+            sched.run()
+            toks.append([r.tokens for r in reqs])
+        return toks, eng
+
+    base, eng_b = run(None)
+    tp, eng_t = run(_tp_mesh())
+    assert tp == base
+    assert eng_t.cache.cow_forks >= 1           # forks actually fired
+    assert eng_t.cache.retained_hits >= 1       # retention revived
+    assert eng_t.cache.cow_forks == eng_b.cache.cow_forks
+    assert eng_t.cache.retained_hits == eng_b.cache.retained_hits
+    assert eng_t.cache.free_blocks == eng_t.cache.num_blocks - 1
+    assert eng_t.compile_counts() == {"prefill": 1, "tick": 1}
+
+
+def test_tp_paged_kernel_runs_per_shard(model_and_vars):
+    """attention='paged' under a tp mesh: the Pallas q_len=1 and span
+    kernels run PER SHARD over local heads via shard_map (the
+    _tp_paged_kernel seam) and reproduce the xla path's greedy tokens."""
+    model, vs = model_and_vars
+
+    def run(attention, speculative=0):
+        eng = DecodeEngine(model, vs, max_slots=2, block_size=BS,
+                           mesh=_tp_mesh(), attention=attention,
+                           speculative=speculative)
+        eng.admit(0, [1, 2, 3, 4, 5], reserve_len=eng.context_width)
+        return [int(eng.decode_tick()[0]) for _ in range(4)], eng
+
+    tx, _ = run("xla")
+    tk, eng = run("paged")
+    assert tk == tx
+    assert eng.compile_counts() == {"prefill": 1, "tick": 1}
+    # the span kernel (speculative tick) per shard
+    sx, _ = run("xla", speculative=2)
+    sk, _ = run("paged", speculative=2)
+    assert sk == sx
+
+
+def test_tp_kv_cache_accounting_and_validation():
+    """PagedKVCache(tp_degree=): per-shard bytes divide by the head
+    split, block math never changes, and a non-dividing head count
+    fails loud (the kernel path needs whole head groups)."""
+    mk = lambda tp: PagedKVCache(num_layers=2, num_heads=4, head_dim=8,
+                                 num_blocks=9, block_size=BS,
+                                 max_slots=2, max_blocks_per_seq=4,
+                                 tp_degree=tp)
+    c1, c2 = mk(1), mk(2)
+    assert c2.kv_bytes_per_token * 2 == c1.kv_bytes_per_token
+    assert c2.bytes_per_block * 2 == c1.bytes_per_block
+    assert c2.blocks_needed(9) == c1.blocks_needed(9)
+    with pytest.raises(ValueError, match="divide"):
+        mk(3)
+    with pytest.raises(ValueError, match="model"):
+        # a mesh without the tp axis fails loud in the engine
+        from jax.sharding import Mesh
+        model = TransformerLM(vocab=V, dim=DIM, num_layers=1,
+                              num_heads=HEADS, ffn_hidden=FFN, max_len=W)
+        vs = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, W), jnp.int32))
+        DecodeEngine(model, vs, mesh=Mesh(np.asarray(jax.devices()[:2]),
+                                          ("data",)))
+
+
+def test_tp_decode_tick_records_and_report(model_and_vars):
+    """ISSUE 15 telemetry: decode_tick records carry tp_degree and the
+    PER-SHARD kv_bytes_per_token; summarize_requests surfaces the mesh
+    gauge; obs.report renders the tensor-parallel row."""
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    from paddle_tpu.obs.percentiles import summarize_requests
+    from paddle_tpu.obs.report import format_summary, summarize
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    eng = DecodeEngine(model, vs, max_slots=2, block_size=BS,
+                       mesh=_tp_mesh(), telemetry=Telemetry(sinks=[mem]))
+    sched = ContinuousBatchingScheduler(eng)
+    sched.submit([1, 2, 3], 4)
+    sched.run()
+    recs = mem.by_kind("decode_tick")
+    assert recs
+    for r in recs:
+        assert r["tp_degree"] == 2
+        assert r["kv_bytes_per_token"] == eng.cache.kv_bytes_per_token
+    summary = summarize_requests(mem.records)
+    assert summary["tp_degree"] == 2
+    text = format_summary(summarize(mem.records))
+    assert "tensor-parallel mesh" in text and "tp=2" in text
+
+
+def test_tp_attribution_classifies_decode_collectives(model_and_vars):
+    """ISSUE 15 satellite: the sharded tick's tp collectives (the
+    out-proj/ffn all-reduces under decode/* scopes) classify into the
+    serving comm table — region='decode', aggregated under
+    report['decode']['comm'] — instead of falling through unlabeled."""
+    from paddle_tpu.obs.attribution import format_report
+    model, vs = model_and_vars
+    eng = DecodeEngine(model, vs, max_slots=2, block_size=BS,
+                       mesh=_tp_mesh())
+    rep = eng.attribution_report(emit=False)
+    assert rep["n_devices"] == 2 and rep["tp_degree"] == 2
+    comm = rep["decode"]["comm"]
+    assert comm["ops"] >= 1 and comm["wire_bytes_total"] > 0
+    assert comm["kinds"].get("all-reduce", 0) >= 1
+    for row in comm["collectives"]:
+        assert row["scope"].startswith("decode/")
+    for c in rep["collectives"]:
+        assert c["region"] == "decode"
+    assert "decode tp comm" in format_report(rep)
+    # the single-device tick keeps its collective-free report shape
+    eng1 = DecodeEngine(model, vs, max_slots=2, block_size=BS)
+    rep1 = eng1.attribution_report(emit=False)
+    assert "comm" not in (rep1["decode"] or {})
+
+
+def test_proc_spec_ships_mesh_and_single_device_roundtrip(
+        model_and_vars, tmp_path):
+    """ISSUE 15 satellite: build_proc_spec(mesh_axes=) ships the axis
+    layout (a Mesh can't cross the JSON wire); a spec WITHOUT it is
+    byte-identical to the pre-tp schema (old/new replicas agree on the
+    frame bytes), and replica_proc._build raises the mesh into a real
+    tensor-parallel engine."""
+    import json
+    from paddle_tpu.serve import build_proc_spec
+    from paddle_tpu.serve import replica_proc
+    model, vs = model_and_vars
+    plain = build_proc_spec(model, vs, str(tmp_path))
+    assert "mesh" not in plain
+    assert json.loads(json.dumps(plain)) == plain       # round-trips
+    meshy = build_proc_spec(model, vs, str(tmp_path),
+                            mesh_axes={"model": 2})
+    assert meshy["mesh"] == {"model": 2}
+    assert {k: v for k, v in meshy.items() if k != "mesh"} == plain
+    eng, sched, buf, clock = replica_proc._build(
+        dict(meshy, engine={"max_slots": 2, "block_size": BS}))
+    assert eng.tp_degree == 2
+    assert eng.cache.kv_bytes_per_token * 2 == 512      # per-shard
