@@ -582,6 +582,9 @@ PLANS = {
     # tensor-parallel sharded tick over a 2-device mesh (ISSUE 15; own
     # child protocol: run_serving_tp_bench_child; n/k unused)
     "transformer_decode_tp": dict(n=0, k=1, budget=2400),
+    # cold-vs-warm fresh-process spawn TTFT (ISSUE 16; own child
+    # protocol: run_replica_spawn_child; n/k unused)
+    "replica_spawn": dict(n=0, k=1, budget=2400),
 }
 
 
@@ -1040,6 +1043,14 @@ def run_smoke(K=4, M=2, timing_passes=3):
     fleet = run_gate_child("--fleet-child")
     fleet_ok = fleet.get("ok") is True
 
+    # cold-vs-warm spawn gate (ISSUE 16): two fresh replica children
+    # against one cache root — the cold one pays autotune trials + XLA
+    # compiles and misses both persistent caches, the warm one runs zero
+    # trials and hits both, compile_counts stay {prefill:1, tick:1}
+    # through real traffic, and the two emit identical tokens.
+    spawn = run_gate_child("--spawn-child")
+    spawn_ok = spawn.get("ok") is True
+
     out = {
         "metric": "fused_vs_plain_smoke",
         "equal": bool(eq_params and eq_losses),
@@ -1058,6 +1069,7 @@ def run_smoke(K=4, M=2, timing_passes=3):
         "serving": serving,
         "faults": faults,
         "fleet": fleet,
+        "spawn": spawn,
     }
     print(json.dumps(out))
     ok = (out["equal"] and jsonl_ok
@@ -1065,7 +1077,7 @@ def run_smoke(K=4, M=2, timing_passes=3):
           and pipeline["losses_equal"] and pipeline["overlap_keys_ok"]
           and trace_ok and trace["losses_equal_with_tracer"]
           and attribution_ok and overlap_ok and serving_ok and faults_ok
-          and fleet_ok)
+          and fleet_ok and spawn_ok)
     return 0 if ok else 1
 
 
@@ -1940,6 +1952,7 @@ def run_serving_bench_child(max_slots=8, block_size=16, seq_len=1024,
     answers "what does halving-to-quartering KV HBM traffic buy the
     memory-bound tick". Prints one JSON line for the parent."""
     from paddle_tpu.models import TransformerLM
+    from paddle_tpu.nn.autotune import time_kernel
     from paddle_tpu.serve import DecodeEngine
 
     ffn = 4 * dim
@@ -1955,12 +1968,9 @@ def run_serving_bench_child(max_slots=8, block_size=16, seq_len=1024,
     for slot in range(max_slots):
         eng.admit(slot, list(rng.randint(0, vocab, prompt_len)),
                   reserve_len=target)
-    for _ in range(warmup_ticks):
-        eng.decode_tick()
-    t0 = time.perf_counter()
-    for _ in range(timed_ticks):
-        eng.decode_tick()
-    wall = time.perf_counter() - t0
+    # decode_tick drains to host internally, so no extra fence is needed
+    wall, _ = time_kernel(eng.decode_tick, warmup=warmup_ticks,
+                          iters=timed_ticks, fence=None)
     tokens = timed_ticks * max_slots
     print(json.dumps({
         "child": ("transformer_decode" if kv_dtype is None
@@ -2016,6 +2026,7 @@ def run_serving_tp_bench_child(max_slots=8, block_size=16, seq_len=1024,
     JSON line for the parent."""
     from jax.sharding import Mesh
     from paddle_tpu.models import TransformerLM
+    from paddle_tpu.nn.autotune import time_kernel
     from paddle_tpu.serve import DecodeEngine
 
     devs = jax.devices()
@@ -2037,12 +2048,8 @@ def run_serving_tp_bench_child(max_slots=8, block_size=16, seq_len=1024,
     for slot in range(max_slots):
         eng.admit(slot, list(rng.randint(0, vocab, prompt_len)),
                   reserve_len=target)
-    for _ in range(warmup_ticks):
-        eng.decode_tick()
-    t0 = time.perf_counter()
-    for _ in range(timed_ticks):
-        eng.decode_tick()
-    wall = time.perf_counter() - t0
+    wall, _ = time_kernel(eng.decode_tick, warmup=warmup_ticks,
+                          iters=timed_ticks, fence=None)
     tokens = timed_ticks * max_slots
     print(json.dumps({
         "child": "transformer_decode_tp",
@@ -2172,6 +2179,178 @@ def bench_serving_spec(budget=None):
         "layers": r["layers"], "device": r["device"],
         "baseline": None, "vs_baseline": None,
     }
+
+
+# ---------------------------------------------------------------------------
+# replica cold-start metric (ISSUE 16): TTFT of a FRESH child process,
+# cold caches vs populated persistent caches
+# ---------------------------------------------------------------------------
+
+def _replica_spawn_once(spec, replica_id, prompt, new_tokens, env):
+    """Spawn ONE fresh replica child against ``spec``, drive a single
+    request to completion over the stdio transport, and return the
+    end-to-end walls (hello = process start -> engine ready, ttft =
+    process start -> first completed request) plus the child's own
+    ``startup_ms`` breakdown and the generated tokens."""
+    from paddle_tpu.serve import transport as tp
+    t0 = time.perf_counter()
+    proc = tp.spawn_replica_process(dict(spec, replica_id=replica_id),
+                                    stderr=subprocess.DEVNULL, env=env)
+    trans = tp.ReplicaTransport(proc.stdout, proc.stdin, proc=proc,
+                                timeout_s=300.0)
+    try:
+        hello = trans.request("hello", now=0.0, timeout_s=300.0)
+        hello_s = time.perf_counter() - t0
+        trans.request("submit", rid=1, prompt=list(prompt),
+                      max_new_tokens=new_tokens, now=0.0)
+        tokens, ttft_s, load = None, None, {}
+        for i in range(16 + 4 * new_tokens):
+            rep = trans.request("tick", now=0.05 * (i + 1), timeout_s=120.0)
+            load = rep.get("load") or load
+            if rep.get("completed"):
+                tokens = rep["completed"][0]["tokens"]
+                ttft_s = time.perf_counter() - t0
+                break
+        trans.request("stop", now=9.0)
+    finally:
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    return {"hello_s": hello_s, "ttft_s": ttft_s, "tokens": tokens,
+            "startup_ms": hello.get("startup_ms") or {},
+            "hello_compile_counts": (hello.get("load") or {}).get(
+                "compile_counts"),
+            "final_compile_counts": load.get("compile_counts")}
+
+
+def run_replica_spawn_child(dim=128, layers=2, heads=4, vocab=512,
+                            max_len=128, prompt_len=16, new_tokens=4,
+                            max_slots=2, block_size=8):
+    """The ``replica_spawn`` metric (ISSUE 16): time-to-first-token of a
+    FRESH serving child process, cold vs warm. Two spawns share one
+    cache root: the first pays the XLA compiles and the autotune trials
+    and populates the persistent caches; the second deserializes its
+    executables and reads the tuner's stored configs. The delta is the
+    cold-start cost the warmup+cache stack removes from autoscaler
+    cold-spawns and supervisor replacements — the fleet's effective
+    scale-up latency. Children are pinned to CPU: the cold-vs-warm
+    ratio is backend-portable, and the remote-TPU (axon) plugin cannot
+    execute cache-deserialized executables (the caveat at the top of
+    this file), so the persistent cache stays CPU/local-TPU-only.
+    Prints one JSON line for the parent."""
+    import tempfile
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.serve import fleet as fleet_lib
+
+    model = TransformerLM(vocab=vocab, dim=dim, num_layers=layers,
+                          num_heads=heads, ffn_hidden=4 * dim,
+                          max_len=max_len)
+    vs = model.init(jax.random.PRNGKey(0),
+                    jnp.zeros((1, max_len), jnp.int32))
+    root = tempfile.mkdtemp(prefix="paddle_tpu_replica_spawn_")
+    spec = fleet_lib.build_proc_spec(
+        model, vs, root,
+        engine_kwargs=dict(max_slots=max_slots, block_size=block_size),
+        warmup=True,
+        compile_cache_dir=os.path.join(root, "xla-cache"),
+        autotune_cache_dir=os.path.join(root, "autotune"))
+    env = _force_cpu_devices(os.environ, 1)
+    rng = np.random.RandomState(0)
+    prompt = list(rng.randint(2, vocab, prompt_len))
+    cold = _replica_spawn_once(spec, 0, prompt, new_tokens, env)
+    warm = _replica_spawn_once(spec, 1, prompt, new_tokens, env)
+    su_c, su_w = cold["startup_ms"], warm["startup_ms"]
+    rec = {
+        "child": "replica_spawn",
+        "cold_ttft_s": round(cold["ttft_s"], 3),
+        "warm_ttft_s": round(warm["ttft_s"], 3),
+        "cold_hello_s": round(cold["hello_s"], 3),
+        "warm_hello_s": round(warm["hello_s"], 3),
+        "spawn_speedup": round(cold["ttft_s"] / warm["ttft_s"], 3),
+        "cold_startup_ms": su_c, "warm_startup_ms": su_w,
+        "cold_autotune_trials": su_c.get("autotune_trials"),
+        "warm_autotune_trials": su_w.get("autotune_trials"),
+        "cold_autotune_cache_hit": su_c.get("autotune_cache_hit"),
+        "warm_autotune_cache_hit": su_w.get("autotune_cache_hit"),
+        "cold_xla_cache_hit": su_c.get("xla_cache_hit"),
+        "warm_xla_cache_hit": su_w.get("xla_cache_hit"),
+        "token_identical": cold["tokens"] == warm["tokens"]
+        and cold["tokens"] is not None,
+        "cold_compile_counts": cold["final_compile_counts"],
+        "warm_compile_counts": warm["final_compile_counts"],
+        "hello_compile_counts": warm["hello_compile_counts"],
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "max_slots": max_slots, "block_size": block_size,
+        "dim": dim, "layers": layers, "vocab": vocab,
+        "device": "cpu (pinned; see docstring)",
+    }
+    print(json.dumps(rec))
+    return rec
+
+
+def bench_replica_spawn(budget=None):
+    """Fresh-subprocess wrapper for run_replica_spawn_child (one child =
+    one tunnel session; that child then spawns the two measured replica
+    processes itself)."""
+    budget = budget or PLANS["replica_spawn"]["budget"]
+    r = _spawn_child("replica_spawn", 0, 1, budget)
+    return {
+        "metric": "replica_spawn_cold_vs_warm",
+        "unit": "x ttft speedup",
+        "value": r["spawn_speedup"],
+        "cold_ttft_s": r["cold_ttft_s"], "warm_ttft_s": r["warm_ttft_s"],
+        "cold_hello_s": r["cold_hello_s"],
+        "warm_hello_s": r["warm_hello_s"],
+        "cold_startup_ms": r["cold_startup_ms"],
+        "warm_startup_ms": r["warm_startup_ms"],
+        "warm_autotune_trials": r["warm_autotune_trials"],
+        "warm_autotune_cache_hit": r["warm_autotune_cache_hit"],
+        "warm_xla_cache_hit": r["warm_xla_cache_hit"],
+        "token_identical": r["token_identical"],
+        "prompt_len": r["prompt_len"], "new_tokens": r["new_tokens"],
+        "dim": r["dim"], "layers": r["layers"],
+        "device": r["device"],
+        "baseline": None, "vs_baseline": None,
+    }
+
+
+def run_spawn_child():
+    """Cold-vs-warm spawn SMOKE GATE (ISSUE 16; tiny config): asserts
+    the warmup/cache contract rather than reporting a perf number —
+    the cold child runs >= 1 autotune trial and misses both caches, the
+    warm child runs ZERO trials and hits both, both children keep
+    ``compile_counts == {prefill: 1, tick: 1}`` through real traffic
+    (warmup adds no variants), and the two children emit identical
+    tokens (warmup + caches are semantically invisible). Prints the
+    verdict as one JSON line; exit 0 iff every check holds."""
+    r = run_replica_spawn_child(dim=32, layers=1, heads=2, vocab=64,
+                                max_len=64, prompt_len=4, new_tokens=2,
+                                max_slots=2, block_size=4)
+    pinned = {"prefill": 1, "tick": 1}
+    checks = {
+        "cold_tuned": (r["cold_autotune_trials"] or 0) >= 1,
+        "cold_autotune_miss": r["cold_autotune_cache_hit"] is False,
+        "cold_xla_miss": r["cold_xla_cache_hit"] is False,
+        "warm_zero_trials": r["warm_autotune_trials"] == 0,
+        "warm_autotune_hit": r["warm_autotune_cache_hit"] is True,
+        "warm_xla_hit": r["warm_xla_cache_hit"] is True,
+        "token_identical": r["token_identical"] is True,
+        "compile_counts_pinned":
+            r["cold_compile_counts"] == pinned
+            and r["warm_compile_counts"] == pinned
+            and r["hello_compile_counts"] == pinned,
+        "warm_faster_hello": r["warm_hello_s"] < r["cold_hello_s"],
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "child": "spawn_gate", "ok": bool(ok), **checks,
+        "cold_ttft_s": r["cold_ttft_s"], "warm_ttft_s": r["warm_ttft_s"],
+        "cold_startup_ms": r["cold_startup_ms"],
+        "warm_startup_ms": r["warm_startup_ms"],
+        "spawn_speedup": r["spawn_speedup"],
+    }))
+    return 0 if ok else 1
 
 
 # ---------------------------------------------------------------------------
@@ -2507,6 +2686,7 @@ DEFAULT_PLAN = ["resnet50", "seq2seq", "transformer", "transformer_fused",
                 "transformer_dp_overlap", "transformer_pipelined",
                 "transformer_decode", "transformer_decode_int8",
                 "transformer_decode_spec", "transformer_decode_tp",
+                "replica_spawn",
                 "transformer_big", "lstm", "lstm_h256", "lstm_h1280"]
 
 
@@ -2514,6 +2694,7 @@ _KNOWN_FLAGS = ("--metric", "--child", "--probe", "--n", "--k",
                 "--timed-steps", "--steps-per-call", "--smoke",
                 "--attribution-child", "--overlap-child",
                 "--serving-child", "--faults-child", "--fleet-child",
+                "--spawn-child",
                 "--compare",
                 "--threshold")
 
@@ -2569,6 +2750,9 @@ def main():
     if flag("--fleet-child", cast=int):
         sys.exit(run_fleet_child())
 
+    if flag("--spawn-child", cast=int):
+        sys.exit(run_spawn_child())
+
     if "--smoke" in args or flag("--smoke", cast=int):
         # CPU mode: the gate must be deterministic and CI-runnable — on any
         # other backend re-launch pinned to CPU (JAX_PLATFORMS must be set
@@ -2603,6 +2787,8 @@ def main():
             run_serving_spec_bench_child()
         elif metric == "transformer_decode_tp":
             run_serving_tp_bench_child()
+        elif metric == "replica_spawn":
+            run_replica_spawn_child()
         else:
             run_timed_child(metric, flag("--timed-steps", 100, int),
                             flag("--steps-per-call", 1, int))
@@ -2613,7 +2799,7 @@ def main():
         return
     if metric in ("transformer_pipelined", "transformer_decode",
                   "transformer_decode_int8", "transformer_decode_spec",
-                  "transformer_decode_tp"):
+                  "transformer_decode_tp", "replica_spawn"):
         try:
             out = (bench_pipelined() if metric == "transformer_pipelined"
                    else bench_serving() if metric == "transformer_decode"
@@ -2621,6 +2807,8 @@ def main():
                    if metric == "transformer_decode_int8"
                    else bench_serving_tp()
                    if metric == "transformer_decode_tp"
+                   else bench_replica_spawn()
+                   if metric == "replica_spawn"
                    else bench_serving_spec())
         except (RuntimeError, subprocess.TimeoutExpired, ValueError,
                 IndexError, KeyError) as e:
@@ -2633,7 +2821,7 @@ def main():
     if metric is not None and metric not in PREPS:
         print(json.dumps(
             {"error": f"unknown metric {metric!r}; choose from "
-                      f"{sorted(PREPS) + ['scaling', 'transformer_pipelined', 'transformer_decode', 'transformer_decode_int8', 'transformer_decode_spec', 'transformer_decode_tp']}"
+                      f"{sorted(PREPS) + ['scaling', 'transformer_pipelined', 'transformer_decode', 'transformer_decode_int8', 'transformer_decode_spec', 'transformer_decode_tp', 'replica_spawn']}"
              }))
         sys.exit(2)
     if metric in PREPS:
@@ -2670,6 +2858,8 @@ def main():
                     results[name] = bench_serving_spec()
                 elif name == "transformer_decode_tp":
                     results[name] = bench_serving_tp()
+                elif name == "replica_spawn":
+                    results[name] = bench_replica_spawn()
                 else:
                     results[name] = bench_differential(name)
                 errors.pop(name, None)

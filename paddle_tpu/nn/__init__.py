@@ -1,7 +1,8 @@
 """Layer library: core layers, activations, costs, sequence ops, recurrent nets,
 attention — the TPU-native successor of paddle/gserver/layers (+ fluid operators)."""
 
-from . import activations, costs, ctc, detection, moe, sequence_ops
+from . import activations, autotune, costs, ctc, detection, moe, sequence_ops
+from .fused_ln import fused_ln_matmul, ln_matmul_reference
 from .attention import (AdditiveAttention, DotProductAttention,
                         MultiHeadAttention)
 from .crf import CRF, crf_decode, crf_log_likelihood
@@ -22,4 +23,5 @@ __all__ = list(_layers_all) + [
     "MultiHeadAttention", "detection", "DetectionOutput", "MultiBoxLoss",
     "ROIPool", "prior_box", "nms", "iou_matrix", "encode_boxes", "decode_boxes",
     "MoEFFN", "moe_sharding_rules", "moe",
+    "autotune", "fused_ln_matmul", "ln_matmul_reference",
 ]

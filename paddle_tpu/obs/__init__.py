@@ -46,7 +46,7 @@ the hot loop is unchanged: same traced step, same dispatch count, same
 donation, zero extra device fetches.
 """
 
-from . import attribution, hloprof, xla_flags
+from . import attribution, hloprof, xla_cache, xla_flags
 from .anomaly import ANOMALY_KINDS, AnomalyDetector, Verdict
 from .attribution import build_report, format_report, parse_profile_trace
 from .hloprof import (DCN_BYTES_PER_S, HBM_BANDWIDTH, ICI_BANDWIDTH,

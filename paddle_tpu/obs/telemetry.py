@@ -221,8 +221,15 @@ class Telemetry:
 
     def record_compile(self, fingerprint, wall_s: float,
                        hlo_flops: Optional[float] = None,
-                       meta: Optional[Dict[str, Any]] = None) -> None:
-        """Emit a compile record (fires once per new fingerprint)."""
+                       meta: Optional[Dict[str, Any]] = None,
+                       cache_hit: Optional[bool] = None,
+                       autotune_trials: Optional[int] = None) -> None:
+        """Emit a compile record (fires once per new fingerprint).
+        ``cache_hit``: whether the executable came out of the persistent
+        compilation cache (None = cache not configured / unknown);
+        ``autotune_trials``: kernel-tuner trials this compile paid
+        (0 = every key was already cached). Both keys are always present
+        on the record so downstream readers need no schema probe."""
         if hlo_flops is not None:
             self.hlo_flops_per_call = hlo_flops
         rec = {"kind": "compile", "ts": time.time(),
@@ -230,7 +237,10 @@ class Telemetry:
                "compile_count": self.compile_count,
                "retrace_count": self.retrace_count,
                "wall_s": round(float(wall_s), 6),
-               "hlo_flops": hlo_flops}
+               "hlo_flops": hlo_flops,
+               "cache_hit": cache_hit,
+               "autotune_trials": (None if autotune_trials is None
+                                   else int(autotune_trials))}
         if meta:
             rec.update(meta)
         self._emit(rec)

@@ -527,6 +527,137 @@ class DecodeEngine:
         return {"prefill": int(self._prefill_fn._cache_size()),
                 "tick": int(self._tick_fn._cache_size())}
 
+    # -- warmup (ISSUE 16) -------------------------------------------------
+
+    def warmup(self) -> Dict[str, Any]:
+        """Pay both programs' compiles NOW, before the first request.
+
+        Executes each compiled entry point once with all-inactive dummy
+        operands — every slot masked off, lengths 0, zero ids — built
+        with the exact aval construction of the real call sites, so the
+        jit cache ends at ``{prefill: 1, tick: 1}`` and the first real
+        request retraces nothing. Executing (rather than AOT
+        ``lower().compile()``) is what populates the jit cache AND the
+        persistent compilation cache in one move; it is numerically
+        harmless because pool contents only matter where an active
+        slot's table+length mark them valid (the eviction rule: stale
+        pool contents are finite and always length-masked — warmup's
+        stray writes land in block 0, which the first real prefill
+        rewrites before any read), and it consumes no entropy — the
+        PRNG keys fold stateless counters that warmup leaves untouched,
+        so warmed and unwarmed engines emit identical token streams.
+
+        With :mod:`~paddle_tpu.nn.autotune` enabled, each program's
+        timed warmup registers under the engine's shape key (the
+        program-level analog of a kernel's block entry — this is where
+        the paged/span programs' grids get their cache row): a restarted
+        replica with a populated cache reports the hit and pays zero
+        trials. Returns the startup breakdown the replica child ships in
+        its hello/heartbeat payloads."""
+        assert not self.active.any() and not self._prefilling, \
+            "warmup() must run before any admission (fresh engine)"
+        from ..nn import autotune
+        from ..obs import xla_cache
+        t0 = time.perf_counter()
+        xla_before = xla_cache.cache_entry_count()
+        trials_before = autotune.stats()["trials"]
+        timings: Dict[str, float] = {}
+
+        def _prefill_once():
+            table = jnp.asarray(self.cache.tables[0:1])
+            key = self._prefill_key()
+            if self.prefill_chunk is None:
+                out = self._prefill_fn(
+                    self.variables, self.cache.k, self.cache.v,
+                    jnp.zeros((1, self._W), jnp.int32),
+                    jnp.asarray([1], jnp.int32),
+                    jnp.asarray([0], jnp.int32), table, key)
+            else:
+                out = self._prefill_fn(
+                    self.variables, self.cache.k, self.cache.v,
+                    jnp.zeros((1, self.prefill_chunk), jnp.int32),
+                    jnp.asarray([0], jnp.int32),
+                    jnp.asarray([1], jnp.int32),
+                    jnp.asarray([0], jnp.int32), table, key)
+            # donated pools: the engine's carry is the returned pair
+            self.cache.k, self.cache.v = out[0], out[1]
+            return out[2]
+
+        def _tick_once():
+            tables, lengths = self.cache.device_tables()
+            if self.speculative == 0:
+                keys = (self._zero_keys if self.sampling is None
+                        else self._tick_keys(self.ticks))
+                out = self._tick_fn(
+                    self.variables, self.cache.k, self.cache.v, tables,
+                    lengths, jnp.asarray(self.tokens),
+                    jnp.asarray(self.active), keys)
+            elif self.sampling is not None:
+                out = self._tick_fn(
+                    self.variables, self.cache.k, self.cache.v, tables,
+                    lengths, jnp.zeros((self.max_slots, self._K1),
+                                       jnp.int32),
+                    jnp.zeros((self.max_slots,), jnp.int32),
+                    jnp.asarray(self.active), self._tick_keys(self.ticks))
+            else:
+                out = self._tick_fn(
+                    self.variables, self.cache.k, self.cache.v, tables,
+                    lengths, jnp.zeros((self.max_slots, self._K1),
+                                       jnp.int32),
+                    jnp.zeros((self.max_slots,), jnp.int32),
+                    jnp.asarray(self.active))
+            self.cache.k, self.cache.v = out[0], out[1]
+            return out[2]
+
+        def _measured(name, fn):
+            t = time.perf_counter()
+            if autotune.is_enabled():
+                key = autotune.make_key(
+                    f"serve_{name}",
+                    shape=(self.max_slots, self._W, self._K1,
+                           self.cache.block_size, self.cache.num_blocks),
+                    dtype=self.cache.quant_dtype,
+                    extra=(self.speculative,
+                           int(self.sampling is not None),
+                           self.prefill_chunk, self.attention))
+                before = autotune.stats()["trials"]
+                autotune.choose(f"serve_{name}", key=key,
+                                candidates=[{}], runner=fn, default={})
+                if autotune.stats()["trials"] == before:
+                    fn()    # cache hit skipped the timed trial — still
+                    #         warm this process's jit cache
+            else:
+                fn()
+            jax.block_until_ready((self.cache.k, self.cache.v))
+            timings[name] = time.perf_counter() - t
+
+        _measured("prefill", _prefill_once)
+        _measured("tick", _tick_once)
+        wall = time.perf_counter() - t0
+        trials = autotune.stats()["trials"] - trials_before
+        added = xla_cache.cache_entry_count() - xla_before
+        xla_hit = (None if xla_cache.active_dir() is None
+                   else added == 0)
+        report = {
+            "prefill_s": round(timings["prefill"], 6),
+            "tick_s": round(timings["tick"], 6),
+            "wall_s": round(wall, 6),
+            "autotune_trials": trials,
+            "autotune_cache_hit": (None if not autotune.is_enabled()
+                                   else trials == 0),
+            "xla_cache_entries_added": added,
+            "xla_cache_hit": xla_hit,
+            "compile_counts": self.compile_counts(),
+        }
+        if self.telemetry is not None:
+            self.telemetry.record_compile(
+                "serve_warmup", wall, cache_hit=xla_hit,
+                autotune_trials=trials,
+                meta={"warmup": True,
+                      "prefill_s": report["prefill_s"],
+                      "tick_s": report["tick_s"]})
+        return report
+
     def free_slots(self) -> List[int]:
         return [s for s in range(self.max_slots)
                 if not self.active[s] and s not in self._prefilling]

@@ -1154,7 +1154,10 @@ class ServingFleet:
             spec = build_proc_spec(
                 model, variables, root, engine_kwargs=ek,
                 model_spec=model_spec, order=kw.get("order", "fcfs"),
-                est_tick_s=kw.get("est_tick_s"))
+                est_tick_s=kw.get("est_tick_s"),
+                warmup=kw.pop("warmup", None),
+                compile_cache_dir=kw.pop("compile_cache_dir", None),
+                autotune_cache_dir=kw.pop("autotune_cache_dir", None))
             return cls(None, n_replicas, replica_mode="process",
                        proc_spec=spec, root=root, **kw)
 
@@ -1181,7 +1184,10 @@ def build_proc_spec(model, variables, root: str, *,
                     model_spec: Optional[Dict[str, Any]] = None,
                     order: str = "fcfs",
                     est_tick_s: Optional[float] = None,
-                    mesh_axes: Optional[Dict[str, int]] = None
+                    mesh_axes: Optional[Dict[str, int]] = None,
+                    warmup: Optional[bool] = None,
+                    compile_cache_dir: Optional[str] = None,
+                    autotune_cache_dir: Optional[str] = None
                     ) -> Dict[str, Any]:
     """The child-process build spec: model constructor kwargs, engine
     kwargs, scheduler policy, and the variables npz (written once under
@@ -1194,7 +1200,14 @@ def build_proc_spec(model, variables, root: str, *,
     local devices (a Mesh object cannot cross the JSON wire; the axis
     layout can). Deliberately ABSENT from the spec when None, so a
     single-device spec is byte-identical to the pre-tp schema —
-    replicas on old and new code agree on the frame bytes."""
+    replicas on old and new code agree on the frame bytes.
+
+    ``warmup`` / ``compile_cache_dir`` / ``autotune_cache_dir``
+    (ISSUE 16): the cold-start trio — the child executes both engine
+    programs before its hello reply, against a persistent XLA compile
+    cache and kernel-autotune cache shared across spawns, so autoscaler
+    cold-spawns and supervisor restarts come up warm. Same
+    schema-stability rule as ``mesh``: each key is ABSENT when unset."""
     from .replica_proc import save_variables_npz
     npz = os.path.join(root, "variables.npz")
     save_variables_npz(npz, variables)
@@ -1204,4 +1217,10 @@ def build_proc_spec(model, variables, root: str, *,
             "est_tick_s": est_tick_s, "root": root}
     if mesh_axes:
         spec["mesh"] = dict(mesh_axes)
+    if warmup is not None:
+        spec["warmup"] = bool(warmup)
+    if compile_cache_dir:
+        spec["compile_cache_dir"] = str(compile_cache_dir)
+    if autotune_cache_dir:
+        spec["autotune_cache_dir"] = str(autotune_cache_dir)
     return spec

@@ -1815,6 +1815,83 @@ class Trainer:
         metrics = self.evaluator.result() if self.evaluator is not None else {}
         return float(np.mean(costs)) if costs else 0.0, metrics
 
+    # -- AOT warmup (ISSUE 16) -----------------------------------------------
+
+    def warmup(self, sample_batches, rng: Optional[Any] = None
+               ) -> Dict[str, Any]:
+        """AOT-compile the training step for ``sample_batches``' shapes
+        — one ``lower().compile()``, ZERO executions (``train_state``
+        and the host step mirror are untouched; executing a step to warm
+        it would mutate params). The step fingerprint (PR 2) already
+        names exactly what must be cached, so a resume harness warms by
+        replaying its known fingerprints' batch shapes through here.
+
+        What the compile buys: with the persistent compilation cache
+        configured (:func:`paddle_tpu.obs.xla_cache.
+        setup_compilation_cache`) the serialized executable lands on
+        disk, so THIS process's first real dispatch — and every future
+        process resuming the same step — deserializes instead of
+        recompiling. With the kernel autotuner enabled, the lowering's
+        trace also runs any untuned flash-kernel trials now, off the
+        training hot path. Returns ``{fingerprint, wall_s, cache_hit,
+        autotune_trials, xla_cache_entries_added}``; emits a
+        ``kind="compile"`` record (``meta.warmup=True``) when telemetry
+        is attached.
+
+        Args:
+          sample_batches: host batches fixing the step's input shapes —
+            ``steps_per_call * grad_accum`` batches in fused mode
+            (``compile_fused``'s contract), one batch (or a one-element
+            list) in plain mode.
+          rng: PRNGKey for the lowering (default PRNGKey(0)).
+        """
+        assert self.train_state is not None, "call init() first"
+        from ..nn import autotune
+        from ..obs import xla_cache
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        fused = self.steps_per_call > 1 or self.grad_accum > 1
+        ts = self.train_state
+        if fused:
+            K, M = self.steps_per_call, self.grad_accum
+            if not isinstance(sample_batches, (list, tuple)) \
+                    or len(sample_batches) != K * M:
+                raise ValueError(
+                    f"warmup needs steps_per_call*grad_accum = {K * M} "
+                    f"host batches in fused mode (compile_fused's "
+                    f"contract)")
+            stacked = self._stack_group(list(sample_batches), K, M)
+            if self._fused_step is None:
+                self._build_fused_step(stacked)
+            batch = self._shard_fused(stacked)
+            step_fn = self._fused_step
+            fp = _step_fingerprint(stacked)
+        else:
+            one = (sample_batches[0]
+                   if isinstance(sample_batches, (list, tuple))
+                   else sample_batches)
+            batch = self._shard(one)
+            if self._train_step is None:
+                self._build_train_step()
+            step_fn = self._train_step
+            fp = ((1, 1),) + _step_fingerprint(one)
+        entries_before = xla_cache.cache_entry_count()
+        trials_before = autotune.stats()["trials"]
+        t0 = time.perf_counter()
+        step_fn.lower(ts.params, ts.state, ts.opt_state, ts.step, batch,
+                      rng).compile()
+        wall = time.perf_counter() - t0
+        added = xla_cache.cache_entry_count() - entries_before
+        cache_hit = (None if xla_cache.active_dir() is None
+                     else added == 0)
+        trials = autotune.stats()["trials"] - trials_before
+        if self.telemetry is not None:
+            self.telemetry.record_compile(
+                fp, wall, cache_hit=cache_hit, autotune_trials=trials,
+                meta={"warmup": True, "aot": True})
+        return {"fingerprint": fp, "wall_s": round(wall, 6),
+                "cache_hit": cache_hit, "autotune_trials": trials,
+                "xla_cache_entries_added": added}
+
     # -- device-side attribution (ISSUE 6) -----------------------------------
 
     def attribution_report(self, sample_batches, rng: Optional[Any] = None,

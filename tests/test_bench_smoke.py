@@ -224,6 +224,26 @@ def test_bench_smoke_cpu_green_and_equal():
     assert {"sigkill_replica_at_tick", "transport_hang_at",
             "corrupt_reply_at"} <= set(pr["faults_fired"])
     assert any(e["action"] == "replace" for e in pr["scale_events"])
+    # ISSUE 16: the cold-vs-warm spawn gate ran — two fresh replica
+    # children against one cache root. The cold child pays >= 1 autotune
+    # trial and misses both persistent caches; the warm child runs ZERO
+    # trials, hits the autotune JSON and the XLA compile cache, and
+    # comes up strictly faster to hello; both keep compile_counts
+    # pinned at {prefill: 1, tick: 1} through real traffic and emit
+    # identical tokens (warmup + caches are semantically invisible)
+    sp = out["spawn"]
+    assert sp["ok"] is True, sp
+    assert sp["cold_tuned"] is True
+    assert sp["cold_autotune_miss"] is True and sp["cold_xla_miss"] is True
+    assert sp["warm_zero_trials"] is True
+    assert sp["warm_autotune_hit"] is True and sp["warm_xla_hit"] is True
+    assert sp["token_identical"] is True
+    assert sp["compile_counts_pinned"] is True
+    assert sp["warm_faster_hello"] is True
+    assert sp["cold_ttft_s"] > 0 and sp["warm_ttft_s"] > 0
+    assert sp["cold_startup_ms"]["total"] > 0
+    assert sp["warm_startup_ms"]["xla_cache_entries_added"] == 0
+    assert sp["spawn_speedup"] > 1.0
 
 
 def _write_bench(tmp_path, name, metrics):

@@ -1582,7 +1582,14 @@ def test_proc_spec_ships_mesh_and_single_device_roundtrip(
                             mesh_axes={"model": 2})
     assert meshy["mesh"] == {"model": 2}
     assert {k: v for k, v in meshy.items() if k != "mesh"} == plain
-    eng, sched, buf, clock = replica_proc._build(
+    eng, sched, buf, clock, startup = replica_proc._build(
         dict(meshy, engine={"max_slots": 2, "block_size": BS}))
     assert eng.tp_degree == 2
     assert eng.cache.kv_bytes_per_token * 2 == 512      # per-shard
+    # ISSUE 16: startup breakdown exists even with warmup off — the
+    # hello/heartbeat payloads always carry the build wall
+    assert startup["build"] > 0 and startup["warmup"] == 0.0
+    # warmup/cache fields stay ABSENT from an unconfigured spec (the
+    # PR-15 schema-stability rule extends to the ISSUE-16 fields)
+    for k in ("warmup", "compile_cache_dir", "autotune_cache_dir"):
+        assert k not in plain
