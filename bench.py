@@ -1736,6 +1736,18 @@ def run_fleet_child():
       survivors are leak- and retrace-free (evidence from each child's
       own stats probe), and the autoscaler cold-spawns a replacement
       within its restart budget.
+    - **observability drill** (ISSUE 17): the SAME process-mode
+      SIGKILL-resubmit shape run twice — once fully instrumented
+      (tracing + SLO + serving anomaly detection + child telemetry
+      JSONL sinks), once with everything off. Asserts the merged fleet
+      trace JSON-round-trips with ≥2 replica lanes plus the router
+      lane and the killed-and-resubmitted rid renders as ONE connected
+      s→t→f flow across processes; the streaming SLO report has finite
+      percentiles and a burn rate in ``stats()``; an injected stall
+      fires the ``tick_stall`` anomaly and dumps a forensic bundle;
+      the killed child's JSONL telemetry survives its SIGKILL; and the
+      instrumented run's tokens and finish reasons are IDENTICAL to
+      the dark run's — observability changes nothing it observes.
 
     Prints the verdict as one JSON line."""
     import collections
@@ -1909,10 +1921,98 @@ def run_fleet_child():
     finally:
         fleet3.shutdown()
 
+    # -- leg 4: fleet observability drill (ISSUE 17) — the same
+    # SIGKILL-resubmit shape traced and dark, compared
+    from paddle_tpu.obs import ServingAnomalyDetector
+    from paddle_tpu.obs.fleet_trace import flow_connected, lane_monotonic
+
+    def run_obs_drill(instrumented):
+        mem4 = InMemorySink()
+        clock4 = SimClock()
+        faults4 = FaultSchedule(sigkill_replica_at_tick=(6, 0),
+                                stall_replica_at_tick=(8, 1, 3))
+        root4 = tempfile.mkdtemp(prefix="paddle_tpu_fleet_obs_")
+        anom = (ServingAnomalyDetector(
+                    out_dir=os.path.join(root4, "anomalies"),
+                    stall_ticks=2)
+                if instrumented else None)
+        # heartbeat timeout ABOVE the injected stall (3 ticks = 0.3s
+        # plus the wake tick): the stall must fire the tick_stall
+        # anomaly, not the death verdict — replica 1 is the sole
+        # survivor once replica 0 is SIGKILLed
+        f = ServingFleet.from_model(
+            model, vs, 2, engine_kwargs=dict(max_slots=2, block_size=4),
+            replica_mode="process", telemetry=Telemetry(sinks=[mem4]),
+            clock=clock4, heartbeat_timeout_s=0.55, est_tick_s=0.1,
+            faults=faults4, transport_timeout_s=5.0, root=root4,
+            trace=instrumented, slo=instrumented, anomaly=anom,
+            telemetry_dir=(os.path.join(root4, "child_telemetry")
+                           if instrumented else None))
+        wl4 = make_workload(8, V, seed=7, rate_rps=30.0,
+                            prompt_len=(2, 6), max_new=(3, 8),
+                            max_total=W)
+        try:
+            frs4 = f.play(wl4, dt_s=0.1)
+        finally:
+            f.shutdown()
+        return f, frs4, anom, root4
+
+    fleet_tr, frs_tr, anom4, root_tr = run_obs_drill(True)
+    fleet_dk, frs_dk, _, _ = run_obs_drill(False)
+
+    trace4 = fleet_tr.fleet_trace()
+    trace4 = json.loads(json.dumps(trace4))      # Chrome-parseable
+    lanes = sorted({e.get("pid") for e in trace4["traceEvents"]
+                    if e.get("ph") != "M"})
+    lanes_ok = 0 in lanes and len([p for p in lanes if p > 0]) >= 2
+    retried4 = [fr.rid for fr in frs_tr if fr.retries > 0]
+    resub_flow_ok = bool(retried4) and all(
+        flow_connected(trace4, r) for r in retried4)
+    slo4 = fleet_tr.slo_report()
+    stats4 = fleet_tr.stats()
+    slo_ok = (slo4["wall_ms_p99"] is not None
+              and np.isfinite(slo4["wall_ms_p99"])
+              and "burn_rate" in stats4.get("slo", {}))
+    stall_fired = any(v.kind == "tick_stall" for v in anom4.verdicts)
+    bundle_ok = stall_fired and any(
+        "tick_stall" in d for d in (
+            os.listdir(os.path.join(root_tr, "anomalies"))
+            if os.path.isdir(os.path.join(root_tr, "anomalies"))
+            else []))
+    # the SIGKILLed child's line-flushed JSONL outlives its process
+    killed_jsonl = os.path.join(root_tr, "child_telemetry",
+                                "replica_0.jsonl")
+    jsonl_ok = (os.path.isfile(killed_jsonl)
+                and os.path.getsize(killed_jsonl) > 0)
+    # instrumentation must not change the work: identical tokens and
+    # finish reasons per rid against the dark run
+    tok_tr = {fr.rid: (fr.finish_reason, list(fr.tokens))
+              for fr in frs_tr}
+    tok_dk = {fr.rid: (fr.finish_reason, list(fr.tokens))
+              for fr in frs_dk}
+    dark_identical = tok_tr == tok_dk
+    tracing = {
+        "ok": bool(lanes_ok and resub_flow_ok and slo_ok and bundle_ok
+                   and jsonl_ok and dark_identical
+                   and lane_monotonic(trace4)),
+        "lanes": lanes,
+        "resubmitted_rids": retried4,
+        "resubmit_flow_connected": bool(resub_flow_ok),
+        "lane_monotonic": bool(lane_monotonic(trace4)),
+        "trace_events": len(trace4["traceEvents"]),
+        "slo": {k: slo4[k] for k in
+                ("requests", "goodput_pct", "burn_rate", "ttft_ms_p99",
+                 "wall_ms_p99")},
+        "tick_stall_fired": bool(stall_fired),
+        "anomaly_bundle": bool(bundle_ok),
+        "killed_child_jsonl_survives": bool(jsonl_ok),
+        "identical_to_uninstrumented": bool(dark_identical),
+    }
+
     ok = (all_terminal and lineage_ok and no_leak and no_retrace
           and p99_finite and shed_bounded and stats["resubmits"] >= 1
           and stats["stale_completions"] == 0 and sjf_wins
-          and proc["ok"])
+          and proc["ok"] and tracing["ok"])
     print(json.dumps({
         "child": "fleet", "ok": bool(ok),
         "workload": workload_stats(wl),
@@ -1928,6 +2028,7 @@ def run_fleet_child():
         "stats": stats, "requests": summary,
         "faults_fired": [p for p, _ in faults.fired],
         "process": proc,
+        "tracing": tracing,
         "device": jax.devices()[0].device_kind,
     }))
     return 0 if ok else 1

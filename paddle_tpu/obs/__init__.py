@@ -34,6 +34,18 @@ The layers:
 - :mod:`~paddle_tpu.obs.report` — ``python -m paddle_tpu.obs.report
   run.jsonl``: run-summary table (throughput, MFU, retraces, overlap,
   anomalies) from a telemetry JSONL.
+- The fleet observability plane (ISSUE 17):
+  :mod:`~paddle_tpu.obs.fleet_trace` merges router + per-replica span
+  batches (shipped back on tick replies, stamped with the one fleet
+  clock) into a single Chrome/Perfetto timeline with per-replica lanes
+  and rid-keyed flows; :mod:`~paddle_tpu.obs.slo` streams rolling
+  P²-estimated p50/p95/p99 TTFT/TPOT, goodput, and error-budget burn
+  rate over terminal request records (``fleet.slo_report()``);
+  ``python -m paddle_tpu.obs.top`` is the live text dashboard over
+  heartbeat files + telemetry JSONL; and
+  :class:`~paddle_tpu.obs.anomaly.ServingAnomalyDetector` extends the
+  flight recorder with per-replica serving kinds (tick stall, accept/
+  prefix-hit collapse, retransmit burst, queue divergence).
 
 :mod:`~paddle_tpu.obs.xla_flags` (ISSUE 8) assembles the opt-in TPU
 async-collective / latency-hiding XLA flag set (documented provenance +
@@ -47,15 +59,19 @@ donation, zero extra device fetches.
 """
 
 from . import attribution, hloprof, xla_cache, xla_flags
-from .anomaly import ANOMALY_KINDS, AnomalyDetector, Verdict
+from .anomaly import (ANOMALY_KINDS, SERVING_ANOMALY_KINDS,
+                      AnomalyDetector, ServingAnomalyDetector, Verdict)
 from .attribution import build_report, format_report, parse_profile_trace
+from .fleet_trace import (flow_connected, flow_summary, lane_monotonic,
+                          merge_fleet_trace, save_fleet_trace)
 from .hloprof import (DCN_BYTES_PER_S, HBM_BANDWIDTH, ICI_BANDWIDTH,
                       collective_inventory, parse_collectives, parse_module)
 from .health import (HEALTH_KEYS, health_scalars, tree_l2_norm,
                      tree_nonfinite_count)
-from .percentiles import (GOODPUT_REASONS, percentile,
+from .percentiles import (GOODPUT_REASONS, P2Quantile, percentile,
                           summarize_requests, summarize_scale)
 from .sinks import InMemorySink, JsonlSink, LoggingSink, Sink
+from .slo import SLOMonitor, SLOTargets
 from .telemetry import (PEAK_FLOPS, Telemetry, device_memory_stats,
                         device_peak_flops, lowered_hlo_flops)
 from .trace import Tracer, jax_profile, tspan
@@ -66,11 +82,15 @@ __all__ = [
     "PEAK_FLOPS", "device_peak_flops", "lowered_hlo_flops",
     "device_memory_stats",
     "Tracer", "tspan", "jax_profile",
-    "AnomalyDetector", "Verdict", "ANOMALY_KINDS",
+    "AnomalyDetector", "ServingAnomalyDetector", "Verdict",
+    "ANOMALY_KINDS", "SERVING_ANOMALY_KINDS",
     "hloprof", "attribution", "xla_flags",
     "parse_module", "collective_inventory", "parse_collectives",
     "build_report", "format_report", "parse_profile_trace",
     "ICI_BANDWIDTH", "DCN_BYTES_PER_S", "HBM_BANDWIDTH",
-    "percentile", "summarize_requests", "summarize_scale",
+    "percentile", "P2Quantile", "summarize_requests", "summarize_scale",
     "GOODPUT_REASONS",
+    "SLOMonitor", "SLOTargets",
+    "merge_fleet_trace", "save_fleet_trace", "flow_summary",
+    "flow_connected", "lane_monotonic",
 ]

@@ -63,12 +63,17 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["AnomalyDetector", "Verdict", "ANOMALY_KINDS"]
+__all__ = ["AnomalyDetector", "ServingAnomalyDetector", "Verdict",
+           "ANOMALY_KINDS", "SERVING_ANOMALY_KINDS"]
 
 _log = logging.getLogger("paddle_tpu.anomaly")
 
 ANOMALY_KINDS = ("nonfinite", "retrace_burst", "drain_stall",
                  "memory_high_water", "slow_step")
+
+SERVING_ANOMALY_KINDS = ("tick_stall", "accept_collapse",
+                         "prefix_hit_collapse", "retransmit_burst",
+                         "queue_divergence")
 
 # Step-record keys whose sum approximates the call's host-observable wall.
 # Stager-staged records (stage_ms present — the fused pipeline): dispatch +
@@ -381,5 +386,273 @@ class AnomalyDetector:
                         self._tracer.tail(self.trace_tail)), f)
             except Exception:
                 _log.exception("trace-tail dump failed")
+        self.bundles.append(bundle)
+        return bundle
+
+
+class ServingAnomalyDetector(AnomalyDetector):
+    """Serving-fleet anomaly detector (ISSUE 17, tentpole part 3): the
+    same one-shot/rearm flight-recorder machinery, watching PER-REPLICA
+    serving signals instead of training step records. One detector
+    serves the whole fleet; each kind fires once per *replica* (the
+    one-shot keys are ``"<kind>@r<replica>"``), so replica 1's stall
+    is not hidden by replica 0's.
+
+    The fleet feeds three seams, all mode-blind (the same calls work
+    for in-process and subprocess replicas, because they consume the
+    evidence the fleet already holds — its own tick view, terminal
+    request records, transport counters):
+
+    - :meth:`observe_fleet_tick` — per fleet tick, per live replica:
+
+      - ``tick_stall``: the replica has work (running/queued) but its
+        engine tick counter has not advanced for ``stall_ticks``
+        consecutive fleet ticks — a wedged child, a stalled engine, a
+        replica about to be declared dead.
+      - ``queue_divergence``: the replica's queue grew monotonically by
+        ≥ ``queue_growth`` across the rolling window — arrival rate has
+        diverged from service rate (the precursor of mass timeouts).
+
+    - :meth:`observe_serving` — per terminal request record:
+
+      - ``accept_collapse``: speculative draft accept-rate was healthy
+        (> 2x ``accept_floor`` at least once) and the last
+        ``accept_window`` draft-carrying requests ALL came in at or
+        under the floor — the draft model has stopped predicting the
+        target (distribution shift, corrupted draft state).
+      - ``prefix_hit_collapse``: prefix-cache hits existed earlier but
+        the last ``prefix_window`` requests saw none — retention was
+        evicted or session affinity broke.
+
+    - :meth:`observe_transport` — per tick, per process replica, fed
+      the cumulative transport counters:
+
+      - ``retransmit_burst``: retransmits rose by ≥
+        ``retransmit_burst`` within the rolling window — the link to
+        that child is flapping.
+
+    On trigger the bundle directory (``anomaly_NNN_<kind>_r<replica>``)
+    holds ``verdict.json``, the replica's last-N fleet-tick ring
+    (``tick_ring.jsonl``) and terminal-record tail
+    (``records_tail.jsonl``), plus whatever fleet-level evidence is
+    bound via :meth:`bind_fleet`: transport counters
+    (``transport.json``), heartbeat payloads (``heartbeats.json``), and
+    the merged fleet trace tail (``fleet_trace_tail.json``)."""
+
+    def __init__(self, out_dir: str, stall_ticks: int = 3,
+                 accept_floor: float = 0.2, accept_window: int = 6,
+                 prefix_window: int = 6, retransmit_burst: int = 3,
+                 queue_growth: int = 6, queue_window: int = 8, **kw):
+        super().__init__(out_dir, **kw)
+        self.stall_ticks = int(stall_ticks)
+        self.accept_floor = float(accept_floor)
+        self.accept_window = int(accept_window)
+        self.prefix_window = int(prefix_window)
+        # base class reuses the name for training retraces; keep ours
+        # distinct
+        self.retransmit_burst_n = int(retransmit_burst)
+        self.queue_growth = int(queue_growth)
+        self.queue_window = int(queue_window)
+        self._rep: Dict[Any, Dict[str, Any]] = {}
+        self._fleet_ctx: Dict[str, Callable[[], Any]] = {}
+        self._serving_replica: Optional[int] = None
+
+    # -- wiring --------------------------------------------------------------
+
+    def bind_fleet(self, heartbeats: Optional[Callable[[], Any]] = None,
+                   trace_tail: Optional[Callable[[], Any]] = None,
+                   transport: Optional[Callable[[], Any]] = None) -> None:
+        """Attach fleet-level evidence sources (zero-arg callables,
+        each sampled at trigger time; a failing source is recorded as
+        its error, never raised)."""
+        for name, fn in (("heartbeats", heartbeats),
+                         ("trace_tail", trace_tail),
+                         ("transport", transport)):
+            if fn is not None:
+                self._fleet_ctx[name] = fn
+
+    def _rstate(self, replica) -> Dict[str, Any]:
+        st = self._rep.get(replica)
+        if st is None:
+            st = self._rep[replica] = {
+                "tick_ring": collections.deque(maxlen=self._ring.maxlen),
+                "records": collections.deque(maxlen=self._ring.maxlen),
+                "static_ticks": 0, "last_engine_ticks": None,
+                "accepts": collections.deque(maxlen=self.accept_window),
+                "accept_healthy": False,
+                "prefix": collections.deque(maxlen=self.prefix_window),
+                "prefix_hits_total": 0,
+                "queued": collections.deque(maxlen=self.queue_window),
+                "retransmits": collections.deque(maxlen=self.window),
+            }
+        return st
+
+    def reset(self) -> None:
+        super().reset()
+        self._rep.clear()
+
+    # -- detection seams -----------------------------------------------------
+
+    def observe_fleet_tick(self, replica, *, tick: int,
+                           engine_ticks: Optional[int], queued: int,
+                           busy: bool) -> List[Verdict]:
+        """Feed one fleet-tick view of one live replica: the fleet's
+        tick index, the replica's engine tick counter (its locally
+        reported progress), queue depth, and whether it holds work."""
+        st = self._rstate(replica)
+        row = {"tick": int(tick), "engine_ticks": engine_ticks,
+               "queued": int(queued), "busy": bool(busy)}
+        st["tick_ring"].append(row)
+        out: List[Verdict] = []
+        if busy and engine_ticks is not None \
+                and engine_ticks == st["last_engine_ticks"]:
+            st["static_ticks"] += 1
+        else:
+            st["static_ticks"] = 0
+        st["last_engine_ticks"] = engine_ticks
+        if st["static_ticks"] >= self.stall_ticks:
+            v = self._maybe_fire(replica, Verdict(
+                kind="tick_stall", step=int(tick),
+                value=float(st["static_ticks"]),
+                threshold=float(self.stall_ticks),
+                detail=(f"replica {replica} holds work but its engine "
+                        f"tick counter has been static for "
+                        f"{st['static_ticks']} consecutive fleet ticks "
+                        f"(wedged child? stalled engine?)")), row)
+            if v:
+                out.append(v)
+        st["queued"].append(int(queued))
+        qs = st["queued"]
+        if (len(qs) == qs.maxlen
+                and all(b >= a for a, b in zip(qs, list(qs)[1:]))
+                and qs[-1] - qs[0] >= self.queue_growth):
+            v = self._maybe_fire(replica, Verdict(
+                kind="queue_divergence", step=int(tick),
+                value=float(qs[-1] - qs[0]),
+                threshold=float(self.queue_growth),
+                detail=(f"replica {replica} queue grew monotonically "
+                        f"{qs[0]} -> {qs[-1]} over the last {len(qs)} "
+                        f"ticks — arrivals have diverged from service "
+                        f"rate")), row)
+            if v:
+                out.append(v)
+        return out
+
+    def observe_serving(self, replica, rec: Dict[str, Any]
+                        ) -> List[Verdict]:
+        """Feed one terminal ``kind="request"`` (or per-tick
+        ``decode_tick``) record attributed to ``replica``."""
+        if rec.get("kind") not in ("request", "decode_tick"):
+            return []
+        if rec.get("finish_reason") == "retried":
+            return []
+        st = self._rstate(replica)
+        st["records"].append(dict(rec))
+        out: List[Verdict] = []
+        proposed = rec.get("draft_proposed") or 0
+        if proposed:
+            rate = (rec.get("draft_accepted") or 0) / proposed
+            st["accepts"].append(rate)
+            if rate > 2.0 * self.accept_floor:
+                st["accept_healthy"] = True
+            acc = st["accepts"]
+            if (st["accept_healthy"] and len(acc) == acc.maxlen
+                    and max(acc) <= self.accept_floor):
+                v = self._maybe_fire(replica, Verdict(
+                    kind="accept_collapse", step=rec.get("rid"),
+                    value=round(max(acc), 4),
+                    threshold=self.accept_floor,
+                    detail=(f"replica {replica} draft accept-rate "
+                            f"collapsed: last {len(acc)} draft-carrying "
+                            f"requests all ≤ {self.accept_floor:.0%} "
+                            f"after a healthy phase — the draft model "
+                            f"has stopped predicting the target")), rec)
+                if v:
+                    out.append(v)
+        hits = rec.get("prefix_hit_blocks")
+        if hits is not None:
+            st["prefix"].append(int(hits))
+            st["prefix_hits_total"] += int(hits)
+            pf = st["prefix"]
+            before = st["prefix_hits_total"] - sum(pf)
+            if len(pf) == pf.maxlen and sum(pf) == 0 and before > 0:
+                v = self._maybe_fire(replica, Verdict(
+                    kind="prefix_hit_collapse", step=rec.get("rid"),
+                    value=0.0, threshold=1.0,
+                    detail=(f"replica {replica} prefix-cache hits "
+                            f"vanished: {before} blocks hit earlier, "
+                            f"zero across the last {len(pf)} requests "
+                            f"(retention evicted? affinity broken?)")),
+                    rec)
+                if v:
+                    out.append(v)
+        return out
+
+    def observe_transport(self, replica, stats: Dict[str, Any]
+                          ) -> List[Verdict]:
+        """Feed a process replica's cumulative transport counters (the
+        ``transport_stats()`` dict) once per fleet tick."""
+        cur = stats.get("retransmits")
+        if cur is None:
+            return []
+        st = self._rstate(replica)
+        ring = st["retransmits"]
+        rise = int(cur) - ring[0] if ring else 0
+        ring.append(int(cur))
+        if rise >= self.retransmit_burst_n:
+            v = self._maybe_fire(replica, Verdict(
+                kind="retransmit_burst", step=None, value=float(rise),
+                threshold=float(self.retransmit_burst_n),
+                detail=(f"replica {replica} transport retransmits rose "
+                        f"by {rise} within the last {len(ring)} ticks — "
+                        f"the link to that child is flapping")),
+                dict(stats))
+            return [v] if v else []
+        return []
+
+    # -- flight recorder -----------------------------------------------------
+
+    def _maybe_fire(self, replica, verdict: Verdict,
+                    rec: Dict[str, Any]) -> Optional[Verdict]:
+        key = f"{verdict.kind}@r{replica}"
+        if not self.rearm and key in self._fired:
+            return None
+        self._fired.add(key)
+        self._serving_replica = replica
+        try:
+            self._trigger(verdict, rec)
+        finally:
+            self._serving_replica = None
+        return verdict
+
+    def _dump_bundle(self, verdict: Verdict, rec: Dict[str, Any]) -> str:
+        rep = self._serving_replica
+        if rep is None:
+            return super()._dump_bundle(verdict, rec)
+        seq = len(self.bundles)
+        bundle = os.path.join(
+            self.out_dir, f"anomaly_{seq:03d}_{verdict.kind}_r{rep}")
+        os.makedirs(bundle, exist_ok=True)
+        verdict.bundle = bundle
+        st = self._rstate(rep)
+        with open(os.path.join(bundle, "verdict.json"), "w") as f:
+            json.dump({"ts": time.time(), "replica": rep,
+                       "verdict": verdict.to_dict(),
+                       "trigger_record": rec}, f, indent=2, default=str)
+        with open(os.path.join(bundle, "tick_ring.jsonl"), "w") as f:
+            for r in st["tick_ring"]:
+                f.write(json.dumps(r, default=str) + "\n")
+        with open(os.path.join(bundle, "records_tail.jsonl"), "w") as f:
+            for r in st["records"]:
+                f.write(json.dumps(r, default=str) + "\n")
+        for name, fn in self._fleet_ctx.items():
+            try:
+                payload = fn()
+            except Exception as e:
+                payload = {"error": f"{type(e).__name__}: {e}"}
+            fname = ("fleet_trace_tail.json" if name == "trace_tail"
+                     else f"{name}.json")
+            with open(os.path.join(bundle, fname), "w") as f:
+                json.dump(payload, f, default=str)
         self.bundles.append(bundle)
         return bundle

@@ -26,8 +26,8 @@ import collections
 import math
 from typing import Any, Dict, Iterable, List, Optional
 
-__all__ = ["percentile", "summarize_requests", "summarize_scale",
-           "GOODPUT_REASONS"]
+__all__ = ["percentile", "P2Quantile", "summarize_requests",
+           "summarize_scale", "GOODPUT_REASONS"]
 
 # finish reasons that count as useful completed work
 GOODPUT_REASONS = ("length", "eos")
@@ -44,6 +44,89 @@ def percentile(values: Iterable[Optional[float]],
         return None
     k = max(1, int(math.ceil(p / 100.0 * len(vals))))
     return vals[min(k, len(vals)) - 1]
+
+
+class P2Quantile:
+    """Streaming quantile estimate — the P² algorithm (Jain & Chlamtac,
+    CACM 1985): five markers tracking (min, p/2, p, (1+p)/2, max) whose
+    heights are adjusted with a piecewise-parabolic fit as observations
+    arrive. O(1) memory and O(1) per observation, which is the point
+    (ISSUE 17): :func:`summarize_requests` is batch-only — it needs
+    every record in hand — while a live SLO monitor must answer "what
+    is p99 TTFT *right now*" over an unbounded stream. P² over a
+    reservoir sample because the estimate is deterministic for a given
+    stream (SimClock drills stay reproducible) and never holds more
+    than five floats.
+
+    Below five observations the estimate is EXACT: the raw values are
+    kept and :meth:`value` answers with the same nearest-rank rule as
+    :func:`percentile` (p99 of a 3-sample stream is the max, not an
+    extrapolation).
+    """
+
+    def __init__(self, p: float):
+        if not 0.0 < p < 100.0:
+            raise ValueError(f"p must be in (0, 100), got {p}")
+        self.p = float(p) / 100.0
+        self.n = 0
+        self._q: List[float] = []          # marker heights
+        self._pos: List[float] = []        # actual marker positions
+        self._want: List[float] = []       # desired marker positions
+        self._dpos: List[float] = []       # desired-position increments
+
+    def observe(self, x: float) -> None:
+        x = float(x)
+        self.n += 1
+        if self.n <= 5:
+            self._q.append(x)
+            self._q.sort()
+            if self.n == 5:
+                p = self.p
+                self._pos = [1.0, 2.0, 3.0, 4.0, 5.0]
+                self._want = [1.0, 1.0 + 2.0 * p, 1.0 + 4.0 * p,
+                              3.0 + 2.0 * p, 5.0]
+                self._dpos = [0.0, p / 2.0, p, (1.0 + p) / 2.0, 1.0]
+            return
+        q, pos = self._q, self._pos
+        # locate the cell; clamp the extremes to the observation
+        if x < q[0]:
+            q[0] = x
+            k = 0
+        elif x >= q[4]:
+            q[4] = x
+            k = 3
+        else:
+            k = next(i for i in range(4) if q[i] <= x < q[i + 1])
+        for i in range(k + 1, 5):
+            pos[i] += 1.0
+        for i in range(5):
+            self._want[i] += self._dpos[i]
+        # adjust the three interior markers toward their desired
+        # positions — parabolic when the fit stays monotone, linear
+        # otherwise (the P² fallback rule)
+        for i in (1, 2, 3):
+            d = self._want[i] - pos[i]
+            if ((d >= 1.0 and pos[i + 1] - pos[i] > 1.0)
+                    or (d <= -1.0 and pos[i - 1] - pos[i] < -1.0)):
+                d = 1.0 if d >= 1.0 else -1.0
+                qn = q[i] + d / (pos[i + 1] - pos[i - 1]) * (
+                    (pos[i] - pos[i - 1] + d) * (q[i + 1] - q[i])
+                    / (pos[i + 1] - pos[i])
+                    + (pos[i + 1] - pos[i] - d) * (q[i] - q[i - 1])
+                    / (pos[i] - pos[i - 1]))
+                if not q[i - 1] < qn < q[i + 1]:
+                    j = i + int(d)
+                    qn = q[i] + d * (q[j] - q[i]) / (pos[j] - pos[i])
+                q[i] = qn
+                pos[i] += d
+
+    def value(self) -> Optional[float]:
+        """The current estimate (None before any observation)."""
+        if self.n == 0:
+            return None
+        if self.n < 5:
+            return percentile(self._q, self.p * 100.0)
+        return self._q[2]
 
 
 def summarize_requests(records: List[Dict[str, Any]]

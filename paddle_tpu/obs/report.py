@@ -23,6 +23,7 @@ is read first automatically so the window spans both files.
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import os
 import sys
@@ -141,6 +142,28 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     scale = summarize_scale(records)
     if scale is not None:
         out.setdefault("serving", {})["scale"] = scale
+    # transport-fault counters (ISSUE 17 satellite): the fleet counts
+    # retransmits/timeouts/corrupt replies in `fleet.stats()` but the
+    # report rendered none of it. Prefer the fleet's own aggregate
+    # record (`fleet.emit_stats()`, carries retransmits — those never
+    # appear as stream events); fall back to counting the transport
+    # events the stream does carry.
+    fleet_rec = next((r for r in reversed(records)
+                      if r.get("kind") == "fleet"), None)
+    tev = [r for r in records if r.get("kind") == "transport"]
+    transport: Optional[Dict[str, Any]] = None
+    if fleet_rec is not None and fleet_rec.get("transport") is not None:
+        transport = dict(fleet_rec["transport"])
+    elif tev:
+        transport = dict(collections.Counter(
+            r.get("event") or "?" for r in tev))
+    if transport is not None:
+        transport["events"] = len(tev)
+        out.setdefault("serving", {})["transport"] = transport
+    # the streaming-SLO aggregate (burn rate etc.) rides the same
+    # fleet record when the monitor was on
+    if fleet_rec is not None and fleet_rec.get("slo") is not None:
+        out.setdefault("serving", {})["slo"] = fleet_rec["slo"]
     return out
 
 
@@ -266,6 +289,31 @@ def format_summary(s: Dict[str, Any]) -> str:
             lines.append(f"  {'scale reasons':<28}"
                          + ", ".join(f"{k}={v}" for k, v in
                                      sorted(reasons.items())))
+    # transport-fault counters (ISSUE 17 satellite) — like the
+    # autoscaler block, rendered whenever the evidence exists, even for
+    # a stream with no request records
+    tr = (sv or {}).get("transport")
+    if tr:
+        lines.append("transport")
+        lines.append(f"  {'retransmits/timeouts/corrupt':<28}"
+                     f"{tr.get('retransmits', 0)} / "
+                     f"{tr.get('timeouts', tr.get('timeout', 0))} / "
+                     f"{tr.get('corrupt_replies', tr.get('corrupt', 0))}")
+        if tr.get("errors") is not None:
+            lines.append(f"  {'transport errors':<28}{tr['errors']}")
+        if tr.get("events"):
+            lines.append(f"  {'transport events in stream':<28}"
+                         f"{tr['events']}")
+    slo = (sv or {}).get("slo")
+    if slo:
+        lines.append("slo (streaming)")
+        lines.append(f"  {'burn rate':<28}{slo.get('burn_rate')} "
+                     f"(budget {slo.get('error_budget_pct')}%, window "
+                     f"goodput {slo.get('window_goodput_pct')}%)")
+        ps = [slo.get(f"ttft_ms_p{p}") for p in (50, 95, 99)]
+        if any(v is not None for v in ps):
+            lines.append(f"  {'TTFT ms p50/p95/p99 (P2)':<28}"
+                         + " / ".join(str(v) for v in ps))
     return "\n".join(lines)
 
 

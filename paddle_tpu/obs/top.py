@@ -1,0 +1,143 @@
+"""``python -m paddle_tpu.obs.top`` — live fleet text dashboard
+(ISSUE 17, tentpole part 2).
+
+Tails the evidence a serving fleet already writes to disk — heartbeat
+files under ``<root>/heartbeats/`` (seq, age, queue depth, free
+blocks/slots) and, when given, the telemetry JSONL of terminal request
+records — and renders one screenful per interval: a per-replica
+liveness table plus the streaming SLO block (:class:`SLOMonitor` over
+the tail of the record stream: rolling p50/p95/p99 TTFT/TPOT, goodput,
+error-budget burn rate).
+
+Read-only by construction: it opens the same files the fleet writes
+atomically and never talks to the fleet process, so it can watch a
+drill, a bench run, or a production-style deployment without being
+part of it. ``--once`` prints a single frame and exits (the testable
+mode; also handy for cron/CI snapshots)::
+
+    python -m paddle_tpu.obs.top --root /tmp/fleet --jsonl tel.jsonl
+    python -m paddle_tpu.obs.top --root /tmp/fleet --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ..parallel import multihost
+from .report import load_records
+from .slo import SLOMonitor, SLOTargets
+
+__all__ = ["render", "main"]
+
+_HB_COLS = ("seq", "queued", "running", "prefilling",
+            "pending_new_tokens", "free_blocks", "free_slots")
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.2f}"
+    return str(v)
+
+
+def render(root: Optional[str] = None, jsonl: Optional[str] = None, *,
+           now: Optional[float] = None, window: int = 256,
+           targets: Optional[SLOTargets] = None) -> str:
+    """One dashboard frame as a string (pure function of the files —
+    what ``--once`` prints and what the test asserts on)."""
+    now = time.time() if now is None else float(now)
+    lines: List[str] = ["== paddle_tpu fleet top =="]
+    beats: Dict[int, Dict] = (
+        multihost.read_heartbeats(root) if root else {})
+    if beats:
+        hdr = ["replica", "age_s"] + list(_HB_COLS)
+        rows = [hdr]
+        for hid in sorted(beats):
+            b = beats[hid]
+            age = now - float(b.get("ts") or b.get("_mtime") or now)
+            rows.append([str(hid), f"{age:.2f}"]
+                        + [_fmt(b.get(c)) for c in _HB_COLS])
+        widths = [max(len(r[i]) for r in rows)
+                  for i in range(len(hdr))]
+        for r in rows:
+            lines.append("  " + "  ".join(
+                c.rjust(w) for c, w in zip(r, widths)))
+    else:
+        lines.append("  (no heartbeats under "
+                     f"{root!r})" if root else "  (no --root given)")
+    if jsonl:
+        mon = SLOMonitor(targets=targets, window=window)
+        transport = 0
+        try:
+            records = load_records(jsonl)
+        except OSError:
+            records = []
+        for rec in records:
+            mon.observe(rec)
+            if rec.get("kind") == "transport":
+                transport += 1
+        rep = mon.report()
+        lines.append("-- slo (streaming) --")
+        lines.append(
+            f"  requests={rep['requests']} retried="
+            f"{rep['retried_attempts']} transport_events={transport}")
+        lines.append(
+            f"  goodput={_fmt(rep['goodput_pct'])}% window="
+            f"{_fmt(rep['window_goodput_pct'])}% "
+            f"burn_rate={_fmt(rep['burn_rate'])} "
+            f"(budget {rep['error_budget_pct']}%)")
+        for m in ("ttft_ms", "tpot_ms", "wall_ms"):
+            lines.append(
+                f"  {m}: p50={_fmt(rep[m + '_p50'])} "
+                f"p95={_fmt(rep[m + '_p95'])} "
+                f"p99={_fmt(rep[m + '_p99'])}")
+        if rep["finish_reasons"]:
+            reasons = " ".join(f"{k}={v}" for k, v in
+                               sorted(rep["finish_reasons"].items()))
+            lines.append(f"  finish: {reasons}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.obs.top",
+        description="Live fleet dashboard over heartbeat files + "
+                    "telemetry JSONL (read-only).")
+    ap.add_argument("--root", default=None,
+                    help="fleet root (reads <root>/heartbeats/)")
+    ap.add_argument("--jsonl", default=None,
+                    help="telemetry JSONL of request records")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    ap.add_argument("--window", type=int, default=256,
+                    help="burn-rate rolling window (requests)")
+    ap.add_argument("--slo-goodput", type=float, default=99.0,
+                    help="goodput objective in percent")
+    ap.add_argument("--slo-ttft-ms", type=float, default=None,
+                    help="optional absolute TTFT target (ms)")
+    args = ap.parse_args(argv)
+    targets = SLOTargets(goodput_pct=args.slo_goodput,
+                         ttft_ms=args.slo_ttft_ms)
+    while True:
+        frame = render(args.root, args.jsonl, window=args.window,
+                       targets=targets)
+        if args.once:
+            print(frame)
+            return 0
+        # clear + home, then the frame — plain ANSI, no curses dep
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":                      # pragma: no cover
+    raise SystemExit(main())

@@ -120,10 +120,20 @@ class Tracer:
       max_events: ring-buffer bound on retained events (oldest dropped;
         ``dropped_events`` counts evictions). Metadata (process/thread
         names) is kept separately and never evicted.
+      clock: optional injectable clock (``callable() -> seconds``).
+        When set, every timestamp is ``clock() * 1e6`` — an ABSOLUTE
+        microsecond time base shared by whoever else reads the same
+        clock. This is the fleet-tracing mode (ISSUE 17): parent and
+        child replicas all stamp spans with the message-carried fleet
+        clock, so a SimClock drill's merged timeline is deterministic
+        and cross-process spans land on one comparable axis. Default
+        (None) keeps the PR-4 behavior: ``perf_counter_ns`` relative to
+        tracer construction.
     """
 
-    def __init__(self, max_events: int = 200_000):
+    def __init__(self, max_events: int = 200_000, clock=None):
         self.pid = os.getpid()
+        self._clock = clock
         self._t0 = time.perf_counter_ns()
         self._events: collections.deque = collections.deque(
             maxlen=int(max_events))
@@ -138,7 +148,14 @@ class Tracer:
     # -- clock / bookkeeping -------------------------------------------------
 
     def _now_us(self) -> float:
+        if self._clock is not None:
+            return float(self._clock()) * 1e6
         return (time.perf_counter_ns() - self._t0) / 1e3
+
+    def now_us(self) -> float:
+        """The tracer's current timestamp (us) — for callers recording
+        already-timed spans via :meth:`complete`."""
+        return self._now_us()
 
     def _note_thread(self, tid: int) -> None:
         # Compare the LIVE name every call, not just first-seen: OS thread
@@ -182,24 +199,44 @@ class Tracer:
         try:
             yield
         finally:
-            t1 = self._now_us()
-            ev: Dict[str, Any] = {
-                "ph": "X", "name": name, "cat": "paddle_tpu",
-                "pid": self.pid, "tid": tid,
-                "ts": t0, "dur": max(t1 - t0, 0.001)}
-            if args:
-                ev["args"] = {k: _json_safe(v) for k, v in args.items()}
-            evs = [ev]
-            for fid, ph in ((flow_start, "s"), (flow_step, "t"),
-                            (flow_end, "f")):
-                if fid is None:
-                    continue
-                fe = {"ph": ph, "name": "group", "cat": "flow",
-                      "id": int(fid), "pid": self.pid, "tid": tid, "ts": t0}
-                if ph == "f":
-                    fe["bp"] = "e"       # bind to the enclosing slice
-                evs.append(fe)
-            self._append(evs)
+            self._emit_span(name, tid, t0, self._now_us(), flow_start,
+                            flow_step, flow_end, args)
+
+    def complete(self, name: str, t0_us: float,
+                 t1_us: Optional[float] = None,
+                 flow_start: Optional[int] = None,
+                 flow_step: Optional[int] = None,
+                 flow_end: Optional[int] = None, **args) -> None:
+        """Record an ALREADY-TIMED span with explicit microsecond
+        timestamps (the tracer's time base — with an injected clock,
+        ``seconds * 1e6``). This is how retroactive spans are stamped:
+        a scheduler records a request's queue wait only at admit time,
+        from the request's own submit timestamp (ISSUE 17)."""
+        tid = threading.get_ident()
+        self._note_thread(tid)
+        self._emit_span(name, tid, float(t0_us),
+                        float(t0_us if t1_us is None else t1_us),
+                        flow_start, flow_step, flow_end, args)
+
+    def _emit_span(self, name, tid, t0, t1, flow_start, flow_step,
+                   flow_end, args) -> None:
+        ev: Dict[str, Any] = {
+            "ph": "X", "name": name, "cat": "paddle_tpu",
+            "pid": self.pid, "tid": tid,
+            "ts": t0, "dur": max(t1 - t0, 0.001)}
+        if args:
+            ev["args"] = {k: _json_safe(v) for k, v in args.items()}
+        evs = [ev]
+        for fid, ph in ((flow_start, "s"), (flow_step, "t"),
+                        (flow_end, "f")):
+            if fid is None:
+                continue
+            fe = {"ph": ph, "name": "group", "cat": "flow",
+                  "id": int(fid), "pid": self.pid, "tid": tid, "ts": t0}
+            if ph == "f":
+                fe["bp"] = "e"       # bind to the enclosing slice
+            evs.append(fe)
+        self._append(evs)
 
     def instant(self, name: str, **args) -> None:
         """A zero-duration marker (``ph="i"``) — e.g. an anomaly verdict
@@ -230,6 +267,17 @@ class Tracer:
         with self._lock:
             return list(self._meta) + list(self._events)
 
+    def drain_events(self) -> List[Dict[str, Any]]:
+        """Pop every buffered span/flow/instant event (metadata stays).
+        The child→parent span-batch shipping primitive (ISSUE 17): a
+        process replica drains its tracer into each tick reply, so
+        spans ride the transport the work already uses — no
+        side-channel files, nothing to garbage-collect on a SIGKILL."""
+        with self._lock:
+            evs = list(self._events)
+            self._events.clear()
+        return evs
+
     def tail(self, n: int) -> List[Dict[str, Any]]:
         """Metadata + the most recent ``n`` events (the flight-recorder
         window); ``n <= 0`` returns metadata only (``[-0:]`` would be the
@@ -246,10 +294,12 @@ class Tracer:
         monotonicity on exactly this serialization)."""
         evs = self.events() if events is None else list(events)
         evs.sort(key=lambda e: e.get("ts", -1.0))
+        clock = ("injected clock (absolute us)"
+                 if self._clock is not None else
+                 "perf_counter_ns (us since tracer construction)")
         return {"traceEvents": evs, "displayTimeUnit": "ms",
                 "otherData": {"producer": "paddle_tpu.obs.trace",
-                              "clock": "perf_counter_ns (us since tracer "
-                                       "construction)",
+                              "clock": clock,
                               "dropped_events": self.dropped_events}}
 
     def save(self, path: str) -> str:

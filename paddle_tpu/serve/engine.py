@@ -271,6 +271,9 @@ class DecodeEngine:
         self.model = model
         self.variables = variables
         self.telemetry = telemetry
+        # optional Tracer (ISSUE 17): assigned by the fleet/replica when
+        # request tracing is on; None costs one attribute test per tick
+        self.tracer = None
         self.attention = _resolve_attention(attention)
         if speculative < 0:
             raise ValueError(f"speculative must be >= 0, "
@@ -803,6 +806,7 @@ class DecodeEngine:
         st = self._prefilling[slot]
         prompt, P = st["prompt"], len(st["prompt"])
         stats = self.slot_stats[slot]
+        tr0 = self.tracer.now_us() if self.tracer is not None else None
         if self.prefill_chunk is None:
             ids = st["staged"] if st["staged"] is not None \
                 else self.stage_prompt(prompt)
@@ -832,6 +836,10 @@ class DecodeEngine:
             stats["prefill_chunks"] += 1
             self.prefill_chunks += 1
             done = st["cursor"] >= P
+        if tr0 is not None:
+            self.tracer.complete("prefill_dispatch", tr0,
+                                 self.tracer.now_us(), slot=slot,
+                                 done=done)
         if not done:
             return None
         del self._prefilling[slot]
@@ -942,6 +950,7 @@ class DecodeEngine:
         active slot to the list of tokens it retired this tick — one for
         the plain tick, up to ``k+1`` under speculation."""
         t0 = time.perf_counter()
+        tr0 = self.tracer.now_us() if self.tracer is not None else None
         n = self._pre_tick_guard()
         tables, lengths = self.cache.device_tables()
         drafted_tick, accepted_tick = 0, 0
@@ -1036,6 +1045,11 @@ class DecodeEngine:
         self.tokens_generated += tokens_tick
         self.draft_proposed += drafted_tick
         self.draft_accepted += accepted_tick
+        if tr0 is not None:
+            self.tracer.complete("engine_tick", tr0,
+                                 self.tracer.now_us(), tick=self.ticks,
+                                 active=n_active, tokens=tokens_tick,
+                                 accepted_drafts=accepted_tick)
         if self.telemetry is not None:
             wall = time.perf_counter() - t0
             # sharing/chunk counters are emitted as PER-TICK DELTAS

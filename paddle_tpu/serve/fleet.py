@@ -76,6 +76,10 @@ import tempfile
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..obs.fleet_trace import merge_fleet_trace
+from ..obs.fleet_trace import save_fleet_trace as _save_fleet_trace
+from ..obs.slo import SLOMonitor
+from ..obs.trace import Tracer
 from ..parallel import multihost
 from . import transport as transport_lib
 from .engine import AdmitProbe
@@ -110,6 +114,10 @@ class ReplicaWorker:
         self.known: set = set()           # rids actually delivered here
         self._collected = 0               # scheduler.completed cursor
         self._hb_seq = 0
+        # per-replica Tracer (ISSUE 17): the fleet installs one when
+        # tracing is on; spans drain into the merged fleet trace each
+        # tick — the in-process twin of the child's span-batch shipping
+        self.tracer = None
 
     # -- fault hooks -------------------------------------------------------
 
@@ -188,6 +196,13 @@ class ReplicaWorker:
 
     def transport_stats(self) -> Optional[Dict[str, int]]:
         return None
+
+    def drain_spans(self) -> List[Dict[str, Any]]:
+        """Pop this replica's buffered trace events for the fleet-level
+        merge (empty when tracing is off)."""
+        if self.tracer is None:
+            return []
+        return self.tracer.drain_events()
 
     # -- liveness ----------------------------------------------------------
 
@@ -430,6 +445,9 @@ class ProcReplicaWorker:
         self.engine = _RemoteEngineView()
         self.transport_down = False
         self.transport_errors = 0
+        # trace events shipped piggybacked on tick replies (ISSUE 17),
+        # buffered here until the fleet's per-tick span drain
+        self._spans: List[Dict[str, Any]] = []
         self._spawn_timeout_s = float(spawn_timeout_s)
         spec = dict(spec, replica_id=self.replica_id, root=root)
         proc = transport_lib.spawn_replica_process(spec, stderr=stderr)
@@ -575,6 +593,9 @@ class ProcReplicaWorker:
         self.engine.update(load)
         for ev in reply.get("events") or ():
             self._emit(ev)              # the fleet's ONE telemetry stream
+        sp = reply.get("spans")
+        if sp:
+            self._spans.extend(sp)
         for item in reply.get("completed") or ():
             rec = item.get("record") or {}
             rid = rec.get("rid")
@@ -637,6 +658,12 @@ class ProcReplicaWorker:
                 "timeouts": self.transport.timeouts,
                 "corrupt_replies": self.transport.corrupt_replies}
 
+    def drain_spans(self) -> List[Dict[str, Any]]:
+        """Pop the child's shipped trace events (no transport round —
+        the spans already rode the tick replies)."""
+        sp, self._spans = self._spans, []
+        return sp
+
 
 class ServingFleet:
     """N replica workers + a router + the recovery loop (see module
@@ -669,6 +696,24 @@ class ServingFleet:
         once).
       autoscaler: an :class:`~paddle_tpu.serve.autoscaler.Autoscaler`
         to bind; its policy loop runs inside every fleet tick.
+      trace: distributed request tracing (ISSUE 17). The fleet gets a
+        router-lane :class:`~paddle_tpu.obs.Tracer` and every replica
+        gets its own (a process replica builds one in the child and
+        ships span batches back on tick replies); all of them stamp the
+        SHARED fleet clock, and :meth:`fleet_trace` merges the lanes
+        into one Chrome/Perfetto timeline with ``s``/``t``/``f`` flow
+        events linking each rid across processes. Default off —
+        tracing off is the byte-identical pre-trace fleet.
+      slo: streaming SLO monitoring — ``True`` for a default
+        :class:`~paddle_tpu.obs.SLOMonitor`, or a configured instance.
+        Every terminal record feeds it; :meth:`slo_report` and the
+        ``"slo"`` key of :meth:`stats` surface rolling p50/p95/p99
+        TTFT/TPOT and the error-budget burn rate.
+      anomaly: a :class:`~paddle_tpu.obs.ServingAnomalyDetector`; the
+        fleet feeds it per-tick replica views, terminal records and
+        transport counters, and binds fleet evidence sources (live
+        heartbeats, the trace tail, transport totals) into its
+        forensic bundles.
     """
 
     def __init__(self, make_engine: Optional[Callable[[int], Any]],
@@ -681,7 +726,8 @@ class ServingFleet:
                  proc_spec: Optional[Dict[str, Any]] = None,
                  transport_timeout_s: float = 2.0,
                  spawn_timeout_s: float = 300.0,
-                 autoscaler=None):
+                 autoscaler=None, trace: bool = False, slo=None,
+                 anomaly=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         if replica_mode not in ("inprocess", "process"):
@@ -703,13 +749,23 @@ class ServingFleet:
         self._proc_spec = dict(proc_spec or {})
         self._transport_timeout_s = float(transport_timeout_s)
         self._spawn_timeout_s = float(spawn_timeout_s)
+        # observability (ISSUE 17) — all default-off; the tracer must
+        # exist BEFORE the spawn loop (process replicas read the spec's
+        # "trace" key at build, transport observers hook at construction)
+        self.tracer = Tracer(clock=self.clock) if trace else None
+        self._replica_spans: Dict[int, List[Dict[str, Any]]] = \
+            collections.defaultdict(list)
+        if self.tracer is not None and replica_mode == "process":
+            self._proc_spec["trace"] = True
+        self.slo = SLOMonitor() if slo is True else (slo or None)
+        self.anomaly = anomaly
         self.workers: List[Any] = []
         for _ in range(n_replicas):       # Popen-spawn (or build) all…
             self._spawn_worker()
         self.router = FleetRouter(
             self.workers, self.root,
             heartbeat_timeout_s=heartbeat_timeout_s, clock=self.clock,
-            affinity=affinity, shed=shed)
+            affinity=affinity, shed=shed, tracer=self.tracer)
         now = self.clock()
         for w in self.workers:            # …then join: children paid
             w.join(now)                   # their jax bring-up in parallel
@@ -729,6 +785,15 @@ class ServingFleet:
         self.shed_count = 0
         self.duplicates_dropped = 0
         self.stale_completions = 0
+        if self.anomaly is not None:
+            # bundles capture fleet-level evidence at trigger time:
+            # live heartbeats, the merged-trace tail, transport totals
+            self.anomaly.bind(tracer=self.tracer)
+            self.anomaly.bind_fleet(
+                heartbeats=lambda: multihost.read_heartbeats(self.root),
+                trace_tail=((lambda: self.fleet_trace(tail=128))
+                            if self.tracer is not None else None),
+                transport=self._transport_totals)
 
     # -- replica lifecycle -------------------------------------------------
 
@@ -743,12 +808,25 @@ class ServingFleet:
                 telemetry=self.telemetry,
                 timeout_s=self._transport_timeout_s,
                 spawn_timeout_s=self._spawn_timeout_s)
+            if self.tracer is not None:
+                # retransmit/timeout/corrupt verdicts land as instants
+                # on the ROUTER lane — the child can't see them (a lost
+                # reply is invisible to the process that sent it)
+                w.transport.on_event = (
+                    lambda event, op, _r=i: self.tracer.instant(
+                        f"transport_{event}", replica=_r, op=op))
         else:
             eng = self.make_engine(i)
+            wtr = (Tracer(clock=self.clock)
+                   if self.tracer is not None else None)
             sched = ContinuousBatchingScheduler(
                 eng, telemetry=self.telemetry, order=self.order,
-                shed=False, est_tick_s=self.est_tick_s, clock=self.clock)
+                shed=False, est_tick_s=self.est_tick_s, clock=self.clock,
+                tracer=wtr)
             w = ReplicaWorker(i, eng, sched, self.root)
+            if wtr is not None:
+                eng.tracer = wtr
+                w.tracer = wtr
         self.workers.append(w)
         return w
 
@@ -793,6 +871,18 @@ class ServingFleet:
         if emit:
             self._emit(fr.record)
         self._active.pop(fr.rid, None)
+        if self.tracer is not None:
+            # phase "f": the rid's flow ENDS at its terminal record —
+            # whichever path produced it (completion, shed, parked
+            # timeout), every flow closes exactly once
+            self.tracer.complete(
+                "terminal", self.tracer.now_us(), flow_end=fr.rid,
+                rid=fr.rid, reason=fr.record.get("finish_reason"),
+                retries=fr.retries)
+        if self.slo is not None:
+            self.slo.observe(fr.record)
+        if self.anomaly is not None and fr.replica is not None:
+            self.anomaly.observe_serving(fr.replica, fr.record)
 
     def _terminal_record(self, fr: FleetRequest, reason: str, now: float,
                          **extra) -> Dict[str, Any]:
@@ -831,10 +921,22 @@ class ServingFleet:
                           session_id=session_id, submit_ts=now)
         self.requests[fr.rid] = fr
         self._active[fr.rid] = fr
+        t0 = self.tracer.now_us() if self.tracer is not None else None
         dec = self.router.route(
             prompt_len=len(fr.prompt), max_new_tokens=max_new_tokens,
             deadline_s=deadline_s, session_id=session_id,
             submit_ts=now, now=now)
+        if self.tracer is not None:
+            # the rid's flow BEGINS here (phase "s"); every later hop —
+            # replica-side queue_wait/decode, a resubmit, the terminal —
+            # carries the same id, so the merged trace draws one arrow
+            # through every process the request touched
+            outcome = ("shed" if dec.shed else "parked"
+                       if dec.worker is None
+                       else f"replica{dec.worker.replica_id}")
+            self.tracer.complete(
+                "submit", t0, self.tracer.now_us(), flow_start=fr.rid,
+                rid=fr.rid, outcome=outcome)
         if dec.shed:
             self._shed(fr, dec)
             return fr
@@ -852,6 +954,9 @@ class ServingFleet:
     def _deliver(self, fr: FleetRequest, worker: ReplicaWorker) -> None:
         if fr.rid in worker.known:
             self.duplicates_dropped += 1
+            if self.tracer is not None:
+                self.tracer.instant("dup_dropped", rid=fr.rid,
+                                    replica=worker.replica_id)
             return
         fr.replica = worker.replica_id
         fr.attempts.append(worker.replica_id)
@@ -890,6 +995,14 @@ class ServingFleet:
         self.resubmits += 1
         fr.retries += 1
         fr.local, fr.replica = None, None
+        if self.tracer is not None:
+            # phase "t": the SAME flow id continues — the kill-and-
+            # resubmit drill renders as one connected arrow, not two
+            # disjoint request lifetimes
+            self.tracer.complete(
+                "resubmit", now * 1e6, self.tracer.now_us(),
+                flow_step=fr.rid, rid=fr.rid, reason=reason,
+                retry=fr.retries)
         _log.warning("resubmitting rid=%d (%s), retry %d",
                      fr.rid, reason, fr.retries)
         dec = self.router.route(
@@ -1030,6 +1143,24 @@ class ServingFleet:
         for w in self.workers:
             w.tick(now, t)
         self._collect()
+        if self.tracer is not None:
+            for w in self.workers:
+                sp = w.drain_spans()
+                if sp:
+                    self._replica_spans[w.replica_id].extend(sp)
+        if self.anomaly is not None:
+            for w in self.workers:
+                if w.killed or w.state in ("dead", "released"):
+                    continue
+                busy = bool(w.scheduler.running
+                            or w.scheduler.prefilling)
+                self.anomaly.observe_fleet_tick(
+                    w.replica_id, tick=t,
+                    engine_ticks=w.engine.ticks,
+                    queued=len(w.scheduler.queue), busy=busy)
+                ts = w.transport_stats()
+                if ts is not None:
+                    self.anomaly.observe_transport(w.replica_id, ts)
         for w in self.workers:
             if w.state == "draining" and w.idle():
                 w.state = "released"
@@ -1091,6 +1222,66 @@ class ServingFleet:
                            f"({sum(1 for f in self.requests.values() if not f.done)} "
                            f"requests outstanding)")
 
+    # -- fleet observability (ISSUE 17) ------------------------------------
+
+    def fleet_trace(self, tail: Optional[int] = None
+                    ) -> Optional[Dict[str, Any]]:
+        """Merge the router lane and every replica's shipped spans into
+        ONE Chrome/Perfetto trace (``None`` when tracing is off). All
+        lanes share the fleet clock, so a rid's ``s``/``t``/``f`` flow
+        events connect across processes. ``tail`` keeps only the most
+        recent N non-metadata events (the forensic-bundle window)."""
+        if self.tracer is None:
+            return None
+        for w in self.workers:          # sweep spans a tick hasn't yet
+            sp = w.drain_spans()
+            if sp:
+                self._replica_spans[w.replica_id].extend(sp)
+        return merge_fleet_trace(self.tracer.events(),
+                                 dict(self._replica_spans), tail=tail)
+
+    def save_fleet_trace(self, path: str) -> str:
+        """Write the merged fleet trace JSON (open in ui.perfetto.dev).
+        Raises when tracing is off — there is nothing to save."""
+        tr = self.fleet_trace()
+        if tr is None:
+            raise ValueError("tracing is off: construct the fleet with "
+                             "trace=True")
+        return _save_fleet_trace(tr, path)
+
+    def slo_report(self) -> Optional[Dict[str, Any]]:
+        """The streaming SLO monitor's snapshot (rolling percentiles,
+        goodput, burn rate) — ``None`` when SLO monitoring is off."""
+        return self.slo.report() if self.slo is not None else None
+
+    def _transport_totals(self) -> Dict[str, int]:
+        """Fleet-wide transport failure counters summed over process
+        replicas (all zeros for an in-process fleet)."""
+        tot = {"errors": 0, "retransmits": 0, "timeouts": 0,
+               "corrupt_replies": 0}
+        for w in self.workers:
+            ts = w.transport_stats()
+            if ts:
+                for k in tot:
+                    tot[k] += int(ts.get(k) or 0)
+        return tot
+
+    def emit_stats(self) -> Dict[str, Any]:
+        """Emit one ``kind="fleet"`` summary record into the telemetry
+        stream (transport totals, recovery counters, the SLO snapshot
+        when monitoring is on) — the record ``obs.report`` surfaces as
+        the serving transport/SLO blocks. Returns the record."""
+        rec: Dict[str, Any] = {
+            "kind": "fleet", "tick": self.ticks,
+            "resubmits": self.resubmits, "shed": self.shed_count,
+            "duplicates_dropped": self.duplicates_dropped,
+            "stale_completions": self.stale_completions,
+            "transport": self._transport_totals()}
+        if self.slo is not None:
+            rec["slo"] = self.slo.report()
+        self._emit(rec)
+        return rec
+
     # -- reporting ---------------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
@@ -1112,7 +1303,7 @@ class ServingFleet:
                   "desired_replicas": self.autoscaler.desired,
                   "replacements": self.autoscaler.replacements}
                  if self.autoscaler is not None else {})
-        return {
+        out = {
             **scale,
             "submitted": len(self.requests),
             "terminal": sum(1 for fr in self.requests.values()
@@ -1129,8 +1320,16 @@ class ServingFleet:
                 w.engine.cache.prefix_hit_blocks for w in self.workers),
             "cow_forks": sum(
                 w.engine.cache.cow_forks for w in self.workers),
+            "transport": self._transport_totals(),
             "replicas": per_replica,
         }
+        if self.slo is not None:
+            # burn rate and the rolling percentiles ride the stats dict
+            # (ISSUE 17) — the dashboard's one-call snapshot
+            out["slo"] = self.slo.report()
+        if self.anomaly is not None:
+            out["anomalies"] = [v.kind for v in self.anomaly.verdicts]
+        return out
 
     @classmethod
     def from_model(cls, model, variables, n_replicas: int, *,
@@ -1157,7 +1356,8 @@ class ServingFleet:
                 est_tick_s=kw.get("est_tick_s"),
                 warmup=kw.pop("warmup", None),
                 compile_cache_dir=kw.pop("compile_cache_dir", None),
-                autotune_cache_dir=kw.pop("autotune_cache_dir", None))
+                autotune_cache_dir=kw.pop("autotune_cache_dir", None),
+                telemetry_dir=kw.pop("telemetry_dir", None))
             return cls(None, n_replicas, replica_mode="process",
                        proc_spec=spec, root=root, **kw)
 
@@ -1187,7 +1387,8 @@ def build_proc_spec(model, variables, root: str, *,
                     mesh_axes: Optional[Dict[str, int]] = None,
                     warmup: Optional[bool] = None,
                     compile_cache_dir: Optional[str] = None,
-                    autotune_cache_dir: Optional[str] = None
+                    autotune_cache_dir: Optional[str] = None,
+                    telemetry_dir: Optional[str] = None
                     ) -> Dict[str, Any]:
     """The child-process build spec: model constructor kwargs, engine
     kwargs, scheduler policy, and the variables npz (written once under
@@ -1207,7 +1408,14 @@ def build_proc_spec(model, variables, root: str, *,
     programs before its hello reply, against a persistent XLA compile
     cache and kernel-autotune cache shared across spawns, so autoscaler
     cold-spawns and supervisor restarts come up warm. Same
-    schema-stability rule as ``mesh``: each key is ABSENT when unset."""
+    schema-stability rule as ``mesh``: each key is ABSENT when unset.
+
+    ``telemetry_dir`` (ISSUE 17): a directory where each child replica
+    line-flushes its telemetry records to ``replica_<id>.jsonl`` AS
+    WELL AS shipping them on tick replies — a SIGKILLed child's records
+    up to the kill survive for post-mortem forensics, where the
+    reply-shipped copies die with the pipe. ABSENT when unset, like
+    every optional key."""
     from .replica_proc import save_variables_npz
     npz = os.path.join(root, "variables.npz")
     save_variables_npz(npz, variables)
@@ -1223,4 +1431,6 @@ def build_proc_spec(model, variables, root: str, *,
         spec["compile_cache_dir"] = str(compile_cache_dir)
     if autotune_cache_dir:
         spec["autotune_cache_dir"] = str(autotune_cache_dir)
+    if telemetry_dir:
+        spec["telemetry_dir"] = str(telemetry_dir)
     return spec

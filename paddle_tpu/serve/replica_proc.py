@@ -95,13 +95,32 @@ def load_variables_npz(path: str) -> Dict[str, Any]:
 class EventBuffer:
     """Telemetry shim for the child's scheduler: captures emitted
     records so the tick handler can ship them to the parent (which owns
-    the fleet's single telemetry stream)."""
+    the fleet's single telemetry stream).
 
-    def __init__(self):
+    ``jsonl_path`` (ISSUE 17 satellite) additionally appends every
+    record to a local JSONL, flushed per record — the child's
+    decode_tick/request evidence survives a SIGKILL even though the
+    buffered copy dies with the process. Shipping is unchanged
+    (:meth:`drain` still hands the parent everything); the file is the
+    forensic sibling, not a second stream of record."""
+
+    def __init__(self, jsonl_path: Optional[str] = None):
         self.records: List[Dict[str, Any]] = []
+        self._f = None
+        if jsonl_path:
+            d = os.path.dirname(jsonl_path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(jsonl_path, "a")
 
     def emit_event(self, rec: Dict[str, Any]) -> None:
         self.records.append(rec)
+        if self._f is not None:
+            try:
+                self._f.write(json.dumps(rec, default=str) + "\n")
+                self._f.flush()
+            except OSError:
+                pass                     # persistence is best-effort
 
     def drain(self) -> List[Dict[str, Any]]:
         out, self.records = self.records, []
@@ -209,11 +228,23 @@ def _build(spec: Dict[str, Any]):
     else:
         startup.update({"compile": 0.0, "warmup": 0.0,
                         "total": startup["build"]})
-    buf = EventBuffer()
+    buf = EventBuffer(jsonl_path=(
+        os.path.join(spec["telemetry_dir"],
+                     f"replica_{int(spec.get('replica_id', 0))}.jsonl")
+        if spec.get("telemetry_dir") else None))
     clock = SettableClock()
+    tracer = None
+    if spec.get("trace"):
+        # distributed tracing (ISSUE 17): the child's spans are stamped
+        # with the SettableClock — i.e. the message-carried fleet clock
+        # — so the parent's merge puts every process on one time base
+        from ..obs.trace import Tracer
+        tracer = Tracer(clock=clock)
+        engine.tracer = tracer
     sched = ContinuousBatchingScheduler(
         engine, telemetry=buf, order=spec.get("order", "fcfs"),
-        shed=False, est_tick_s=spec.get("est_tick_s"), clock=clock)
+        shed=False, est_tick_s=spec.get("est_tick_s"), clock=clock,
+        tracer=tracer)
     return engine, sched, buf, clock, startup
 
 
@@ -229,6 +260,7 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
     from . import transport as tp
 
     reader = tp.FrameReader(read_file)
+    tracer = getattr(sched, "tracer", None)
     reply_cache: "collections.OrderedDict[int, bytes]" = \
         collections.OrderedDict()
     known = set()                      # delivered rids (idempotency)
@@ -282,6 +314,8 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
         if op == "submit":
             rid = int(msg["rid"])
             if rid in known:
+                if tracer is not None:
+                    tracer.instant("dup_submit", rid=rid)
                 return {"ok": True, "rid": rid, "duplicate": True}
             if draining:
                 # the drain contract: admit nothing new; the fleet's
@@ -306,9 +340,15 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
                 known.discard(req.rid)
                 completed.append({"record": req.record(),
                                   "tokens": list(req.tokens)})
-            return {"ok": True, "tick": msg.get("tick"),
-                    "completed": completed, "events": buf.drain(),
-                    "load": load_report()}
+            reply = {"ok": True, "tick": msg.get("tick"),
+                     "completed": completed, "events": buf.drain(),
+                     "load": load_report()}
+            if tracer is not None:
+                # span-batch shipping: spans ride the tick reply the
+                # work already uses (no side-channel files; a SIGKILL
+                # loses at most one tick's worth)
+                reply["spans"] = tracer.drain_events()
+            return reply
         if op == "drain":
             draining = True
             rids = []

@@ -67,7 +67,8 @@ class FleetRouter:
     def __init__(self, workers: List[Any], root: str, *,
                  heartbeat_timeout_s: float = 3.0,
                  clock=time.perf_counter, affinity: bool = True,
-                 shed: bool = True, max_sessions: int = 4096):
+                 shed: bool = True, max_sessions: int = 4096,
+                 tracer=None):
         # held BY REFERENCE, not copied: the autoscaler (ISSUE 13)
         # appends newly spawned replicas to the fleet's worker list and
         # the router must see them become placeable immediately
@@ -83,6 +84,9 @@ class FleetRouter:
         # — never unbounded growth on a long-lived fleet
         self.max_sessions = int(max_sessions)
         self.sessions: Dict[int, int] = {}
+        # optional fleet Tracer (ISSUE 17): death verdicts become
+        # timeline instants on the router lane
+        self.tracer = tracer
 
     # -- health ------------------------------------------------------------
 
@@ -104,6 +108,9 @@ class FleetRouter:
             if w.replica_id in stale and w.state in ("live", "draining"):
                 w.state = "dead"
                 newly.append(w)
+                if self.tracer is not None:
+                    self.tracer.instant("replica_dead",
+                                        replica=w.replica_id)
                 # unpin this replica's sessions: they re-pin wherever
                 # their next request lands
                 for sid in [s for s, r in self.sessions.items()

@@ -160,7 +160,7 @@ class ContinuousBatchingScheduler:
     def __init__(self, engine, telemetry=None, policy: str = "continuous",
                  order: str = "fcfs", shed: bool = False,
                  est_tick_s: Optional[float] = None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, tracer=None):
         if policy not in ("continuous", "static"):
             raise ValueError(f"policy must be 'continuous'|'static', "
                              f"got {policy!r}")
@@ -169,6 +169,12 @@ class ContinuousBatchingScheduler:
         self.engine = engine
         self.telemetry = (telemetry if telemetry is not None
                           else engine.telemetry)
+        # distributed request tracing (ISSUE 17): a Tracer sharing the
+        # scheduler's clock. Spans carry the GLOBAL rid as their flow
+        # id, so the fleet merge links a request's queue wait, prefill
+        # chunks, and decode ticks across replicas. None = zero
+        # overhead (every call site guards on it).
+        self.tracer = tracer
         self.policy = policy
         self.order = order
         self.shed = shed
@@ -294,6 +300,11 @@ class ContinuousBatchingScheduler:
             self.prefilling.pop(slot, None)
             self.engine.evict(slot)            # blocks back to the pool
         self.completed.append(req)
+        if self.tracer is not None:
+            self.tracer.complete("finish", req.finish_ts * 1e6,
+                                 flow_step=req.rid, rid=req.rid,
+                                 reason=reason,
+                                 new_tokens=len(req.tokens))
         if self.telemetry is not None:
             self.telemetry.emit_event(req.record())
 
@@ -369,8 +380,18 @@ class ContinuousBatchingScheduler:
                 # pool backpressure: stop in strict policy order (no
                 # smaller-request bypass — bypass would starve the head)
                 self.last_backpressure = probe.reason
+                if self.tracer is not None:
+                    self.tracer.instant("backpressure", rid=req.rid,
+                                        reason=probe.reason,
+                                        queued=len(self.queue))
                 break
             self.queue.remove(req)
+            if self.tracer is not None:
+                # retroactive queue-wait span: submit_ts -> now, in the
+                # shared clock's time base
+                self.tracer.complete("queue_wait", req.submit_ts * 1e6,
+                                     self.tracer.now_us(),
+                                     flow_step=req.rid, rid=req.rid)
             slot = free.pop(0)
             self.engine.begin_prefill(slot, req.prompt,
                                       reserve_len=target,
@@ -387,7 +408,12 @@ class ContinuousBatchingScheduler:
         """One compiled prefill call for a reserved slot; promotes the
         request to running when its first token lands."""
         req = self.prefilling[slot]
-        tok = self.engine.prefill_step(slot)
+        if self.tracer is not None:
+            with self.tracer.span("prefill_chunk", rid=req.rid,
+                                  slot=slot):
+                tok = self.engine.prefill_step(slot)
+        else:
+            tok = self.engine.prefill_step(slot)
         if tok is None:
             return
         del self.prefilling[slot]
@@ -429,18 +455,27 @@ class ContinuousBatchingScheduler:
             self._advance_prefill(slot)
         self._admit()
         if self.running:
+            active = len(self.running)
+            t0 = (self.tracer.now_us()
+                  if self.tracer is not None else None)
             self.engine.decode_tick()
             # the tick may retire several tokens per slot (speculative
             # accepts); feed them through the same finish rules one at
             # a time so eos/length semantics match the sequential
             # engine exactly
             accepted = self.engine.last_accepted
+            n_tok = 0
             for slot, req in list(self.running.items()):
                 for tok in accepted.get(slot, ()):
                     req.tokens.append(tok)
+                    n_tok += 1
                     self._maybe_finish(slot, tok)
                     if req.done:
                         break
+            if t0 is not None:
+                self.tracer.complete("decode_tick", t0,
+                                     self.tracer.now_us(),
+                                     active=active, tokens=n_tok)
         self._was_busy = bool(self.queue or self.running
                               or self.prefilling)
         return self._was_busy

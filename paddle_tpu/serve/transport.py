@@ -180,7 +180,8 @@ class ReplicaTransport:
     into ``ServingFleet.stats()``)."""
 
     def __init__(self, read_file, write_file, *, proc=None,
-                 timeout_s: float = 2.0, max_attempts: int = 3):
+                 timeout_s: float = 2.0, max_attempts: int = 3,
+                 on_event=None):
         self._reader = FrameReader(read_file)
         self._w = write_file
         self.proc = proc
@@ -191,6 +192,19 @@ class ReplicaTransport:
         self.timeouts = 0
         self.corrupt_replies = 0
         self.closed = False
+        # optional observer hook (ISSUE 17): called as
+        # on_event(event, op) for "retransmit"/"timeout"/"corrupt" —
+        # the fleet pins these onto the merged trace as instants.
+        # Best-effort by contract: an observer bug must never turn
+        # into a transport failure.
+        self.on_event = on_event
+
+    def _notify(self, event: str, op: str) -> None:
+        if self.on_event is not None:
+            try:
+                self.on_event(event, op)
+            except Exception:
+                _log.exception("transport on_event observer failed")
 
     @property
     def pid(self) -> Optional[int]:
@@ -216,6 +230,7 @@ class ReplicaTransport:
         for attempt in range(max(1, attempts)):
             if attempt:
                 self.retransmits += 1
+                self._notify("retransmit", op)
                 # the injected fault was the DELIVERY, not the work:
                 # the retransmit asks for the cached reply, clean
                 msg = {k: v for k, v in msg.items()
@@ -227,9 +242,11 @@ class ReplicaTransport:
                 return self._recv_matching(seq, wait)
             except TransportTimeout as e:
                 self.timeouts += 1
+                self._notify("timeout", op)
                 last_err = e
             except TransportCorrupt as e:
                 self.corrupt_replies += 1
+                self._notify("corrupt", op)
                 last_err = e
             except TransportClosed:
                 self.closed = True

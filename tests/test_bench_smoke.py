@@ -224,6 +224,28 @@ def test_bench_smoke_cpu_green_and_equal():
     assert {"sigkill_replica_at_tick", "transport_hang_at",
             "corrupt_reply_at"} <= set(pr["faults_fired"])
     assert any(e["action"] == "replace" for e in pr["scale_events"])
+    # ISSUE 17: the observability leg — the SIGKILL-resubmit drill run
+    # instrumented (tracing + SLO + serving anomaly forensics + child
+    # JSONL sinks) and dark. The merged fleet trace Chrome-parses with
+    # the router lane plus >= 2 replica lanes, the killed-and-
+    # resubmitted rid is ONE connected s->t->f flow across processes,
+    # the streaming SLO report has finite p99s and publishes a burn
+    # rate through stats(), the injected stall fires tick_stall with a
+    # forensic bundle, the SIGKILLed child's line-flushed JSONL
+    # outlives its process, and the instrumented run's tokens/finish
+    # reasons are identical to the dark run's (zero observer effect)
+    tg = fl["tracing"]
+    assert tg["ok"] is True, tg
+    assert 0 in tg["lanes"] and len([p for p in tg["lanes"] if p > 0]) >= 2
+    assert tg["resubmitted_rids"] and tg["resubmit_flow_connected"] is True
+    assert tg["lane_monotonic"] is True
+    assert tg["trace_events"] > 0
+    assert tg["slo"]["wall_ms_p99"] is not None
+    assert tg["slo"]["burn_rate"] is not None
+    assert tg["tick_stall_fired"] is True
+    assert tg["anomaly_bundle"] is True
+    assert tg["killed_child_jsonl_survives"] is True
+    assert tg["identical_to_uninstrumented"] is True
     # ISSUE 16: the cold-vs-warm spawn gate ran — two fresh replica
     # children against one cache root. The cold child pays >= 1 autotune
     # trial and misses both persistent caches; the warm child runs ZERO
