@@ -1711,7 +1711,7 @@ def run_faults_child():
 # ---------------------------------------------------------------------------
 
 def run_fleet_child():
-    """The serving fleet's CI gate, three legs on a SimClock —
+    """The serving fleet's CI gate, five legs on a SimClock —
 
     - **fault drill**: a seeded bursty loadgen trace (sessions with
       shared prefixes, ragged lengths, deadlines) over 3 replicas; a
@@ -1748,6 +1748,15 @@ def run_fleet_child():
       the killed child's JSONL telemetry survives its SIGKILL; and the
       instrumented run's tokens and finish reasons are IDENTICAL to
       the dark run's — observability changes nothing it observes.
+    - **disaggregation drill** (ISSUE 18): 1 prefill + 2 decode
+      replicas as SOCKET children on loopback — every request prefills
+      on the prefill replica, streams its KV pages over TCP and decodes
+      the greedy oracle's exact tokens, with the handoff wire bytes
+      matching the analytic blocks x bytes-per-block accounting; then
+      in-process role fleets measure the disaggregation CLAIM (decode
+      tokens/tick within 25% when heavy prefill-only load is added) and
+      the int8 path (identical tokens to colocated int8, ~2.7x fewer
+      wire bytes per block than f32).
 
     Prints the verdict as one JSON line."""
     import collections
@@ -2009,10 +2018,144 @@ def run_fleet_child():
         "identical_to_uninstrumented": bool(dark_identical),
     }
 
+    # -- leg 5: prefill/decode disaggregation (ISSUE 18) — sockets on
+    # loopback for the real cross-host shape, in-process fleets for the
+    # cheap differential measurements.
+    #
+    # 5a: 1 prefill + 2 decode replicas as socket children. Every
+    # request must prefill on the prefill replica, stream its KV pages
+    # over TCP, and decode to the greedy oracle's EXACT tokens; the
+    # wire bytes must equal blocks x the analytic per-block size.
+    f32_block = 2 * 2 * 4 * 4 * 8 * 4       # 2(kv) L H BS hd f32
+    int8_block = 2 * 2 * 4 * 4 * (8 + 4)    # int8 values + f32 scales
+    sock_fleet = ServingFleet.from_model(
+        model, vs, 3, engine_kwargs=dict(max_slots=2, block_size=4),
+        replica_mode="socket", roles=["prefill", "decode", "decode"],
+        clock=SimClock(), heartbeat_timeout_s=0.25, est_tick_s=0.1,
+        transport_timeout_s=10.0,
+        root=tempfile.mkdtemp(prefix="paddle_tpu_fleet_sock_"))
+    rng5 = np.random.RandomState(5)
+    try:
+        frs5 = [sock_fleet.submit(list(rng5.randint(1, V, int(p))), 5)
+                for p in rng5.randint(2, 8, 6)]
+        for _ in range(300):
+            if not sock_fleet.outstanding():
+                break
+            sock_fleet.tick()
+            sock_fleet.clock.advance(0.1)
+        stats5 = sock_fleet.stats()
+        sock_terminal = all(fr.record is not None for fr in frs5)
+        sock_oracle = all(
+            fr.finish_reason == "length"
+            and fr.tokens == greedy_oracle(fr.prompt, fr.max_new_tokens)
+            for fr in frs5)
+        sock_roles = all(fr.attempts[0] == 0 and fr.replica in (1, 2)
+                         for fr in frs5)
+        sock_wire_exact = (
+            stats5["handoffs"] == len(frs5)
+            and stats5["handoff_wire_bytes"]
+            == stats5["handoff_blocks"] * f32_block)
+    finally:
+        sock_fleet.shutdown()
+
+    # 5b: decode isolation under prefill load — the disaggregation
+    # claim, measured. The same decode jobs run twice on in-process
+    # role fleets; run B adds heavy prefill-only jobs (long prompts,
+    # max_new=1 finishes at prefill, no handoff). Decode throughput —
+    # ticks until the decode jobs all finish — must hold within 25%.
+    def run_disagg(extra_prefill, kv_dtype=None):
+        ek = dict(max_slots=2, block_size=4)
+        if kv_dtype:
+            ek["kv_dtype"] = kv_dtype
+        f5 = ServingFleet.from_model(
+            model, vs, 3, engine_kwargs=ek,
+            roles=["prefill", "decode", "decode"], clock=SimClock(),
+            heartbeat_timeout_s=0.25, est_tick_s=0.1,
+            root=tempfile.mkdtemp(prefix="paddle_tpu_fleet_disagg_"))
+        r = np.random.RandomState(9)
+        decode_jobs = [f5.submit(list(r.randint(1, V, 4)), 6)
+                       for _ in range(6)]
+        if extra_prefill:
+            for _ in range(8):
+                f5.submit(list(r.randint(1, V, 20)), 1)
+        done_at = None
+        for _ in range(400):
+            if done_at is None and all(fr.record is not None
+                                       for fr in decode_jobs):
+                done_at = f5.ticks
+            if not f5.outstanding():
+                break
+            f5.tick()
+            f5.clock.advance(0.1)
+        if done_at is None and all(fr.record is not None
+                                   for fr in decode_jobs):
+            done_at = f5.ticks
+        st = f5.stats()
+        toks = sum(len(fr.tokens) for fr in decode_jobs)
+        return {"fleet": f5, "stats": st, "decode_jobs": decode_jobs,
+                "decode_done_tick": done_at,
+                "decode_tok_per_tick": (toks / done_at
+                                        if done_at else None)}
+
+    base = run_disagg(extra_prefill=False)
+    loaded = run_disagg(extra_prefill=True)
+    iso_ratio = (loaded["decode_tok_per_tick"]
+                 / base["decode_tok_per_tick"]
+                 if base["decode_tok_per_tick"]
+                 and loaded["decode_tok_per_tick"] else None)
+    iso_ok = (iso_ratio is not None and iso_ratio >= 0.75
+              and all(fr.tokens == base["decode_jobs"][i].tokens
+                      for i, fr in enumerate(loaded["decode_jobs"])))
+
+    # 5c: int8 KV crosses the wire quantized — identical tokens to the
+    # colocated int8 fleet, ~2.7x fewer bytes per block than f32
+    q5 = run_disagg(extra_prefill=False, kv_dtype="int8")
+    colo5 = ServingFleet.from_model(
+        model, vs, 2,
+        engine_kwargs=dict(max_slots=2, block_size=4, kv_dtype="int8"),
+        clock=SimClock(), heartbeat_timeout_s=0.25, est_tick_s=0.1,
+        root=tempfile.mkdtemp(prefix="paddle_tpu_fleet_colo8_"))
+    rq = np.random.RandomState(9)
+    colo_jobs = [colo5.submit(list(rq.randint(1, V, 4)), 6)
+                 for _ in range(6)]
+    for _ in range(400):
+        if not colo5.outstanding():
+            break
+        colo5.tick()
+        colo5.clock.advance(0.1)
+    q_stats = q5["stats"]
+    quant_identical = all(
+        a.tokens == b.tokens and a.finish_reason == b.finish_reason
+        for a, b in zip(colo_jobs, q5["decode_jobs"]))
+    q_wire_exact = (q_stats["handoffs"] >= 6
+                    and q_stats["handoff_wire_bytes"]
+                    == q_stats["handoff_blocks"] * int8_block)
+    quant_wire_ratio = f32_block / int8_block    # 2.67x for hd=8
+    disagg = {
+        "ok": bool(sock_terminal and sock_oracle and sock_roles
+                   and sock_wire_exact and iso_ok and quant_identical
+                   and q_wire_exact
+                   and stats5["router_ms"]["total"] > 0.0),
+        "socket_all_terminal": bool(sock_terminal),
+        "socket_oracle_tokens": bool(sock_oracle),
+        "socket_role_placement": bool(sock_roles),
+        "socket_wire_bytes_exact": bool(sock_wire_exact),
+        "socket_handoffs": stats5["handoffs"],
+        "socket_wire_bytes": stats5["handoff_wire_bytes"],
+        "router_ms": stats5["router_ms"],
+        "decode_tok_per_tick_base": base["decode_tok_per_tick"],
+        "decode_tok_per_tick_loaded": loaded["decode_tok_per_tick"],
+        "decode_isolation_ratio": iso_ratio,
+        "decode_isolated_under_prefill_load": bool(iso_ok),
+        "int8_tokens_identical_to_colocated": bool(quant_identical),
+        "int8_wire_bytes_exact": bool(q_wire_exact),
+        "int8_wire_ratio_vs_f32": quant_wire_ratio,
+    }
+
     ok = (all_terminal and lineage_ok and no_leak and no_retrace
           and p99_finite and shed_bounded and stats["resubmits"] >= 1
           and stats["stale_completions"] == 0 and sjf_wins
-          and proc["ok"] and tracing["ok"])
+          and proc["ok"] and tracing["ok"] and disagg["ok"])
     print(json.dumps({
         "child": "fleet", "ok": bool(ok),
         "workload": workload_stats(wl),
@@ -2029,6 +2172,7 @@ def run_fleet_child():
         "faults_fired": [p for p, _ in faults.fired],
         "process": proc,
         "tracing": tracing,
+        "disagg": disagg,
         "device": jax.devices()[0].device_kind,
     }))
     return 0 if ok else 1

@@ -69,7 +69,8 @@ from .hloprof import (DCN_BYTES_PER_S, HBM_BANDWIDTH, ICI_BANDWIDTH,
 from .health import (HEALTH_KEYS, health_scalars, tree_l2_norm,
                      tree_nonfinite_count)
 from .percentiles import (GOODPUT_REASONS, P2Quantile, percentile,
-                          summarize_requests, summarize_scale)
+                          summarize_handoffs, summarize_requests,
+                          summarize_scale)
 from .sinks import InMemorySink, JsonlSink, LoggingSink, Sink
 from .slo import SLOMonitor, SLOTargets
 from .telemetry import (PEAK_FLOPS, Telemetry, device_memory_stats,
@@ -89,7 +90,7 @@ __all__ = [
     "build_report", "format_report", "parse_profile_trace",
     "ICI_BANDWIDTH", "DCN_BYTES_PER_S", "HBM_BANDWIDTH",
     "percentile", "P2Quantile", "summarize_requests", "summarize_scale",
-    "GOODPUT_REASONS",
+    "summarize_handoffs", "GOODPUT_REASONS",
     "SLOMonitor", "SLOTargets",
     "merge_fleet_trace", "save_fleet_trace", "flow_summary",
     "flow_connected", "lane_monotonic",

@@ -27,7 +27,7 @@ import math
 from typing import Any, Dict, Iterable, List, Optional
 
 __all__ = ["percentile", "P2Quantile", "summarize_requests",
-           "summarize_scale", "GOODPUT_REASONS"]
+           "summarize_scale", "summarize_handoffs", "GOODPUT_REASONS"]
 
 # finish reasons that count as useful completed work
 GOODPUT_REASONS = ("length", "eos")
@@ -238,4 +238,33 @@ def summarize_scale(records: List[Dict[str, Any]]
         "final_replicas": evs[-1].get("replicas_after"),
         "max_replicas_seen": max((r.get("replicas_after") or 0)
                                  for r in evs),
+    }
+
+
+def summarize_handoffs(records: List[Dict[str, Any]]
+                       ) -> Optional[Dict[str, Any]]:
+    """Aggregate the fleet's ``kind="kv_handoff"`` events (ISSUE 18):
+    how many finished prefills streamed their KV pages to a decode
+    replica, how much hit the wire, and at which quantization — the
+    disaggregation run's cost ledger next to its latency percentiles.
+    None when the stream has no handoffs (colocated fleets don't grow
+    the block)."""
+    evs = [r for r in records if r.get("kind") == "kv_handoff"]
+    if not evs:
+        return None
+    wire = [int(r.get("wire_bytes") or 0) for r in evs]
+    blocks = [int(r.get("blocks") or 0) for r in evs]
+    ms = [float(r["transfer_ms"]) for r in evs
+          if r.get("transfer_ms") is not None]
+    return {
+        "handoffs": len(evs),
+        "blocks": sum(blocks),
+        "wire_bytes": sum(wire),
+        "mean_blocks": round(sum(blocks) / len(evs), 2),
+        "mean_wire_bytes": round(sum(wire) / len(evs), 1),
+        "transfer_ms_mean": (round(sum(ms) / len(ms), 3)
+                             if ms else None),
+        "transfer_ms_p95": percentile(ms, 95) if ms else None,
+        "by_quant": dict(collections.Counter(
+            r.get("quant") or "?" for r in evs)),
     }

@@ -29,7 +29,8 @@ import os
 import sys
 from typing import Any, Dict, List, Optional
 
-from .percentiles import summarize_requests, summarize_scale
+from .percentiles import (summarize_handoffs, summarize_requests,
+                          summarize_scale)
 
 __all__ = ["load_records", "summarize", "format_summary", "main"]
 
@@ -142,6 +143,11 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     scale = summarize_scale(records)
     if scale is not None:
         out.setdefault("serving", {})["scale"] = scale
+    # prefill→decode KV handoffs (ISSUE 18): kind="kv_handoff" events
+    # aggregate into the serving block (count, wire bytes, quant mix)
+    handoffs = summarize_handoffs(records)
+    if handoffs is not None:
+        out.setdefault("serving", {})["handoffs"] = handoffs
     # transport-fault counters (ISSUE 17 satellite): the fleet counts
     # retransmits/timeouts/corrupt replies in `fleet.stats()` but the
     # report rendered none of it. Prefer the fleet's own aggregate
@@ -275,6 +281,23 @@ def format_summary(s: Dict[str, Any]) -> str:
             lines.append(f"  {'tensor-parallel mesh':<28}"
                          f"tp={sv['tp_degree']} "
                          f"(head-sharded KV, per-shard bytes)")
+    # prefill→decode KV handoffs (ISSUE 18) — rendered whenever the
+    # disaggregated fleet actually streamed pages
+    ho = (sv or {}).get("handoffs")
+    if ho:
+        lines.append("kv handoffs")
+        lines.append(f"  {'handoffs (blocks / bytes)':<28}"
+                     f"{ho['handoffs']} ({ho['blocks']} / "
+                     f"{ho['wire_bytes']})")
+        if ho.get("transfer_ms_mean") is not None:
+            lines.append(f"  {'transfer ms mean/p95':<28}"
+                         f"{ho['transfer_ms_mean']} / "
+                         f"{ho.get('transfer_ms_p95')}")
+        quants = ho.get("by_quant") or {}
+        if quants:
+            lines.append(f"  {'quant mix':<28}"
+                         + ", ".join(f"{k}={v}" for k, v in
+                                     sorted(quants.items())))
     # autoscaler decisions (ISSUE 13) — rendered whenever scale events
     # exist, even for a stream with no request records
     sc = (sv or {}).get("scale")

@@ -34,18 +34,32 @@ doctrine):
 - :mod:`.loadgen` — seeded traffic shapes (Poisson/bursty arrivals,
   ragged lengths, shareable-prefix sessions, deadlines/priorities) and
   the :class:`SimClock` that makes fleet fault drills deterministic.
-- :mod:`.transport` / :mod:`.replica_proc` (ISSUE 13) — the
-  length-prefixed submit/complete IPC frames (per-message timeout,
-  seq-numbered at-least-once delivery, classified corruption) and the
+- :mod:`.transport` / :mod:`.replica_proc` (ISSUE 13, sockets +
+  binary frames in ISSUE 18) — the length-prefixed submit/complete
+  frame protocol (per-message timeout, seq-numbered at-least-once
+  delivery, classified corruption), now carried over pipes OR TCP
+  sockets (``listen``/``connect``/``SocketFrameReader`` — the SAME
+  retry/dedupe brain either way), with CRC-checked binary frames for
+  raw payloads (KV pages cross the wire as bytes, not JSON), and the
   child-process replica entrypoint behind
-  ``ServingFleet(replica_mode="process")``: a SIGKILL, hang, or corrupt
-  reply is contained in one process, observed via heartbeat staleness,
-  and healed by the same reconcile path.
+  ``ServingFleet(replica_mode="process"|"socket")``: a SIGKILL, hang,
+  or corrupt reply is contained in one process, observed via heartbeat
+  staleness, and healed by the same reconcile path.
+- **Prefill/decode disaggregation** (ISSUE 18): give
+  ``ServingFleet(roles=[...])`` per-replica roles and prefill-role
+  replicas run the prompt pass against their LOCAL paged pool, then
+  stream the finished KV pages block-by-block to a decode-role replica
+  (``export_pages``/``import_pages`` → framed binary payloads → an
+  ``adopt`` op), which continues from the first generated token —
+  bit-identical to colocated serving, with the handoff rid-keyed
+  through the reconcile ledger so mid-transfer death resubmits cleanly.
 - :mod:`.autoscaler` — the supervised elastic-capacity policy loop on
-  top of ``drain()`` and ``spawn_replica()``: scale up on
-  predicted-delay breach, down on sustained idle, hysteresis against
-  flapping, cold-spawn replacement of dead replicas under a loud
-  restart budget.
+  top of ``drain()`` and ``spawn_replica()``, an M/M/c queueing-model
+  controller per role (ISSUE 18): Erlang-C predicted delay from an
+  arrival-rate EMA + tick-time EMA + role capacity, scale up on
+  predicted-delay breach gated on the delay derivative, down on
+  sustained idle, hysteresis against flapping, cold-spawn replacement
+  of dead replicas under a loud restart budget.
 """
 
 from .kv_cache import (BlockAllocator, PagedKVCache, PrefixCache,
@@ -53,17 +67,22 @@ from .kv_cache import (BlockAllocator, PagedKVCache, PrefixCache,
                        scatter_token, scatter_span,
                        scatter_prefill_pages, scatter_token_pages,
                        scatter_span_pages, quantize_rows,
-                       dequantize_rows)
+                       dequantize_rows, pages_to_blobs, blobs_to_pages)
 from .engine import AdmitProbe, DecodeEngine, SamplingConfig
 from .scheduler import ContinuousBatchingScheduler, Request
 from .router import FleetRouter, RouteDecision
 from .fleet import (FleetRequest, ProcReplicaWorker, ReplicaWorker,
                     ServingFleet, build_proc_spec)
-from .loadgen import GenRequest, SimClock, make_workload, workload_stats
-from .autoscaler import Autoscaler, AutoscalerGaveUp
-from .transport import (ReplicaTransport, TransportClosed,
-                        TransportCorrupt, TransportError,
-                        TransportTimeout)
+from .loadgen import (GenRequest, SimClock, hostile_workload,
+                      make_workload, workload_stats)
+from .autoscaler import Autoscaler, AutoscalerGaveUp, erlang_c_wait
+from .transport import (BINARY_FLAG, ReplicaTransport,
+                        SocketFrameReader, SocketWriter,
+                        TransportClosed, TransportCorrupt,
+                        TransportError, TransportTimeout,
+                        accept_connection, connect,
+                        encode_binary_frame, listen,
+                        write_binary_frame)
 
 __all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "PrefixMatch",
            "DecodeEngine", "AdmitProbe", "SamplingConfig",
@@ -74,7 +93,12 @@ __all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "PrefixMatch",
            "FleetRouter", "RouteDecision", "ServingFleet",
            "ReplicaWorker", "ProcReplicaWorker", "FleetRequest",
            "build_proc_spec",
-           "Autoscaler", "AutoscalerGaveUp",
+           "pages_to_blobs", "blobs_to_pages",
+           "Autoscaler", "AutoscalerGaveUp", "erlang_c_wait",
            "ReplicaTransport", "TransportError", "TransportTimeout",
            "TransportCorrupt", "TransportClosed",
-           "GenRequest", "SimClock", "make_workload", "workload_stats"]
+           "SocketFrameReader", "SocketWriter", "listen", "connect",
+           "accept_connection", "encode_binary_frame",
+           "write_binary_frame", "BINARY_FLAG",
+           "GenRequest", "SimClock", "make_workload",
+           "hostile_workload", "workload_stats"]

@@ -1,5 +1,6 @@
-"""Supervised autoscaler for the serving fleet (ISSUE 13): elastic
-capacity as a POLICY LOOP over evidence the fleet already publishes.
+"""Supervised autoscaler for the serving fleet (ISSUE 13, upgraded to
+a per-role queueing-model controller in ISSUE 18): elastic capacity as
+a POLICY LOOP over evidence the fleet already publishes.
 
 The mechanics existed before this module: ``drain()`` is lossless
 scale-down (PR 11), :meth:`~paddle_tpu.serve.fleet.ServingFleet.
@@ -8,30 +9,39 @@ heartbeat file. The autoscaler adds only the decisions, and the
 discipline that keeps decisions from flapping:
 
 - **Sense from the files.** Load is read from the heartbeat payloads
-  (``pending_new_tokens`` per live replica, the child-reported tick-time
-  EMA) — the same evidence a watchdog on another host would have, not a
-  private pointer into a scheduler. The predicted queue delay is the
-  PR-11 shed model fleet-wide: ``backlog / (live · max_slots)`` ticks at
-  the observed tick time.
-- **Scale up on predicted-delay breach** (``up_delay_s``): capacity is
-  added when the backlog's predicted delay says requests queued NOW will
-  wait too long — before deadlines start shedding, not after.
+  (``pending_new_tokens`` / ``prefill_backlog`` per live replica, the
+  child-reported tick-time EMA) — the same evidence a watchdog on
+  another host would have, not a private pointer into a scheduler.
+- **Model the queue, don't threshold it.** Each ROLE GROUP (a
+  disaggregated fleet has separate prefill and decode groups; a plain
+  fleet is one ``"both"`` group) is modeled as an M/M/c queue: c =
+  live replicas × slots (the decode lanes), service rate μ = 1 /
+  tick-time EMA, arrival rate λ = an EMA over the fleet's monotone
+  arrival-work counters (prompt tokens for prefill, new tokens for
+  decode) diffed per step. Predicted delay is the MAX of the Erlang-C
+  expected wait Wq and the PR-11 deterministic backlog model
+  (``backlog / lanes × tick_s``) — the queueing term sees load that
+  hasn't queued yet (λ near saturation), the backlog term sees load
+  that already has.
+- **Scale up on predicted-delay breach** (``up_delay_s``), gated on
+  the delay DERIVATIVE: capacity is added only while the breach is not
+  already improving — a just-spawned replica gets one cooldown to bend
+  the curve before the policy piles on. The spawned replica takes the
+  breaching group's role.
 - **Scale down on sustained idle** (``idle_grace_ticks`` consecutive
-  ticks with zero backlog AND zero in-flight requests): one idle instant
-  is a gap between bursts; only a sustained lull pays back a replica.
-  Scale-down always routes through ``drain()`` — zero lost requests, by
-  the PR-11 contract.
+  ticks with zero backlog AND zero in-flight requests), always through
+  ``drain()`` — zero lost requests — and never below one live replica
+  per role (a prefill group with no decode peer would strand every
+  handoff).
 - **Hysteresis** (``cooldown_ticks``): after ANY up/down decision the
-  policy holds still, so bursty traffic that would flap a naive
-  threshold policy produces a BOUNDED number of scale events (the CI
-  test pins this). The grace counter resets on any load.
+  policy holds still, so bursty traffic produces a BOUNDED number of
+  scale events (the CI test pins this).
 - **Cold-spawn replacement under a restart budget**: a replica the
-  router declared dead is replaced (``action="replace"``) outside the
-  up/down cooldown — healing is not scaling — but under
+  router declared dead is replaced (``action="replace"``, same role)
+  outside the up/down cooldown — healing is not scaling — but under
   ``max_replacements``; when the budget is exhausted the autoscaler
-  GIVES UP LOUD (:class:`AutoscalerGaveUp` with the full event ledger,
-  the PR-10 supervisor rule: a fleet whose replicas keep dying has a
-  bug, and respawning forever would hide it).
+  GIVES UP LOUD (:class:`AutoscalerGaveUp` with the full event
+  ledger).
 
 Every decision emits a ``kind="scale"`` telemetry event (action,
 reason, replica counts before/after, the evidence) — aggregated by
@@ -42,11 +52,12 @@ reason, replica counts before/after, the evidence) — aggregated by
 from __future__ import annotations
 
 import logging
+import math
 from typing import Any, Dict, List, Optional
 
 from ..parallel import multihost
 
-__all__ = ["Autoscaler", "AutoscalerGaveUp"]
+__all__ = ["Autoscaler", "AutoscalerGaveUp", "erlang_c_wait"]
 
 _log = logging.getLogger("paddle_tpu.serve.autoscaler")
 
@@ -61,16 +72,36 @@ class AutoscalerGaveUp(RuntimeError):
         self.events = list(events)
 
 
+def erlang_c_wait(lam: float, mu: float, c: int) -> float:
+    """Expected M/M/c queue wait Wq (seconds): λ arrivals/s, μ per-
+    server service rate, c servers. Uses the numerically stable
+    Erlang-B recurrence ``B_k = a·B_{k-1} / (k + a·B_{k-1})`` then
+    ``C = B_c / (1 − ρ + ρ·B_c)`` and ``Wq = C / (cμ − λ)``. Returns
+    0 for an empty or degenerate system and ``inf`` at or past
+    saturation (ρ ≥ 1) — an unstable queue's wait is unbounded."""
+    if lam <= 0.0 or mu <= 0.0 or c < 1:
+        return 0.0
+    a = lam / mu                       # offered load (erlangs)
+    rho = a / c
+    if rho >= 1.0:
+        return float("inf")
+    b = 1.0
+    for k in range(1, int(c) + 1):
+        b = a * b / (k + a * b)
+    cq = b / (1.0 - rho + rho * b)     # P(wait) — Erlang C
+    return cq / (c * mu - lam)
+
+
 class Autoscaler:
     """The policy loop (see module docstring). Construct with policy
     knobs, pass to ``ServingFleet(autoscaler=...)`` (or call
     :meth:`bind` yourself); :meth:`step` runs inside every fleet tick.
 
     Args:
-      min_replicas / max_replicas: the live-capacity envelope. Scale
-        down never goes below ``min_replicas`` (and ``drain()`` itself
-        refuses below 1); scale up and replacement never exceed
-        ``max_replicas``.
+      min_replicas / max_replicas: the live-capacity envelope (fleet
+        TOTAL — roles share it). Scale down never goes below
+        ``min_replicas`` (and ``drain()`` itself refuses below 1);
+        scale up and replacement never exceed ``max_replicas``.
       up_delay_s: predicted-queue-delay breach that triggers scale-up.
         Needs tick-time evidence (heartbeat-reported EMA or the fleet's
         ``est_tick_s`` prior); with neither, ``up_pending_per_slot``
@@ -100,19 +131,32 @@ class Autoscaler:
         self.cooldown_ticks = int(cooldown_ticks)
         self.max_replacements = int(max_replacements)
         self.fleet = None
-        self.desired: Optional[int] = None
+        self.desired: Optional[int] = None   # fleet total (legacy API)
+        self.desired_by_role: Dict[str, int] = {}
         self.events: List[Dict[str, Any]] = []
         self.replacements = 0
         self._idle_ticks = 0
         self._last_scale_tick: Optional[int] = None
+        # queueing-model state (ISSUE 18): per-role arrival-rate EMA
+        # over the fleet's monotone work counters, and the previous
+        # step's predicted delay (the derivative gate's memory)
+        self._arrival_ema: Dict[str, float] = {}
+        self._prev_delay: Dict[str, Optional[float]] = {}
+        self._prev_lam: Dict[str, float] = {}
+        self._prev_now: Optional[float] = None
+        self._prev_work: Dict[str, int] = {}
 
     # -- wiring ------------------------------------------------------------
 
     def bind(self, fleet) -> "Autoscaler":
         self.fleet = fleet
-        live = sum(1 for w in fleet.workers if w.state == "live")
+        live = [w for w in fleet.workers if w.state == "live"]
         self.desired = min(self.max_replicas,
-                           max(self.min_replicas, live))
+                           max(self.min_replicas, len(live)))
+        self.desired_by_role = {}
+        for w in live:
+            r = getattr(w, "role", "both")
+            self.desired_by_role[r] = self.desired_by_role.get(r, 0) + 1
         return self
 
     def _emit(self, action: str, reason: str, before: int, after: int,
@@ -129,29 +173,99 @@ class Autoscaler:
 
     # -- sensing -----------------------------------------------------------
 
-    def _sense(self, live) -> Dict[str, Any]:
-        """Load evidence from the heartbeat FILES (the cross-process
-        sensor), with the in-flight ledger deciding idleness — a parked
-        request with zero backlog still means the fleet is not idle."""
-        beats = multihost.read_heartbeats(self.fleet.root)
+    def _arrival_work(self, role: str) -> int:
+        """The fleet's cumulative arrival work for one role's unit of
+        service: prompt tokens feed prefill groups, new tokens feed
+        decode (and colocated "both") groups."""
+        if role == "prefill":
+            return int(getattr(self.fleet, "arrived_prompt_tokens", 0))
+        return int(getattr(self.fleet, "arrived_new_tokens", 0))
+
+    def _update_arrivals(self, roles, now: Optional[float]) -> None:
+        """Diff the monotone work counters since the previous step into
+        per-role arrival-rate EMAs (0.7/0.3 — the repo's tick-time
+        smoothing). dt ≤ 0 (SimClock not advanced, first step) leaves
+        the EMA untouched rather than dividing by zero."""
+        if now is None:
+            self._prev_now = None
+            return
+        prev_now = self._prev_now
+        self._prev_now = now
+        work = {r: self._arrival_work(r) for r in roles}
+        prev = self._prev_work
+        self._prev_work = dict(prev, **work)
+        if prev_now is None:
+            return
+        dt = now - prev_now
+        if dt <= 0:
+            return
+        for r in roles:
+            if r not in prev:
+                continue
+            rate = max(0.0, (work[r] - prev[r]) / dt)
+            old = self._arrival_ema.get(r)
+            self._arrival_ema[r] = (rate if old is None
+                                    else 0.7 * old + 0.3 * rate)
+
+    def _sense_role(self, role: str, group, beats) -> Dict[str, Any]:
+        """One role group's load evidence from the heartbeat FILES (the
+        cross-process sensor): backlog in the role's work unit, the
+        slowest member's tick-time EMA, and the M/M/c predicted delay
+        = max(Erlang-C Wq, the deterministic backlog model)."""
+        backlog_key = ("prefill_backlog" if role == "prefill"
+                       else "pending_new_tokens")
         pending = 0
         est = None
-        for w in live:
+        for w in group:
             b = beats.get(w.replica_id) or {}
-            pending += int(b.get("pending_new_tokens") or 0)
+            pending += int(b.get(backlog_key) or 0)
             if b.get("est_tick_s") is not None:
                 e = float(b["est_tick_s"])
                 est = e if est is None else max(est, e)
         if est is None:
             est = self.fleet.est_tick_s
         max_slots = max((getattr(w.engine, "max_slots", 1)
-                         for w in live), default=1)
-        lanes = max(1, len(live) * max_slots)
-        delay = (pending / lanes) * est if est is not None else None
-        return {"pending_new_tokens": pending,
-                "predicted_delay_s": delay,
+                         for w in group), default=1)
+        lanes = max(1, len(group) * max_slots)
+        lam = self._arrival_ema.get(role) or 0.0
+        if est is not None:
+            mu = 1.0 / est if est > 0 else 0.0
+            wq = erlang_c_wait(lam, mu, lanes)
+            backlog_delay = (pending / lanes) * est
+            delay: Optional[float] = max(wq, backlog_delay)
+        else:
+            delay = None
+        return {"role": role,
+                "pending": pending,
                 "pending_per_slot": pending / lanes,
-                "in_flight": len(self.fleet._active)}
+                "lanes": lanes,
+                "arrival_rate": lam,
+                "predicted_delay_s": delay}
+
+    def _sense(self, live, now: Optional[float] = None
+               ) -> Dict[str, Any]:
+        """Fleet-wide evidence: per-role queue models plus the
+        in-flight ledger (a parked request with zero backlog still
+        means the fleet is not idle)."""
+        groups: Dict[str, list] = {}
+        for w in live:
+            groups.setdefault(getattr(w, "role", "both"), []).append(w)
+        if not groups:
+            groups = {"both": []}
+        self._update_arrivals(
+            sorted(groups), self.fleet.clock() if now is None else now)
+        beats = multihost.read_heartbeats(self.fleet.root)
+        by_role = {r: self._sense_role(r, g, beats)
+                   for r, g in sorted(groups.items())}
+        pending = sum(s["pending"] for s in by_role.values())
+        delays = [s["predicted_delay_s"] for s in by_role.values()
+                  if s["predicted_delay_s"] is not None]
+        return {"pending_new_tokens": pending,
+                "predicted_delay_s": max(delays) if delays else None,
+                "pending_per_slot": max(s["pending_per_slot"]
+                                        for s in by_role.values()),
+                "in_flight": len(self.fleet._active),
+                "by_role": by_role}
 
     # -- the policy step ---------------------------------------------------
 
@@ -168,13 +282,18 @@ class Autoscaler:
         tick = fleet.ticks
         live = [w for w in fleet.workers if w.state == "live"]
         draining = [w for w in fleet.workers if w.state == "draining"]
-        sense = self._sense(live)
+        sense = self._sense(live, now)
+        evidence = {k: v for k, v in sense.items() if k != "by_role"}
 
         # 1) replacement: heal the envelope before judging load. Healing
         # is not scaling — it ignores the up/down cooldown but pays from
-        # its own bounded budget, loud when exhausted.
-        if (len(live) + len(draining) < self.desired
-                and len(live) < self.max_replicas):
+        # its own bounded budget, loud when exhausted. A dead replica
+        # is replaced IN ITS ROLE — a disaggregated fleet that lost its
+        # prefill replica needs a prefill replica back, not a spare
+        # decoder.
+        deficit_role = self._role_deficit(live, draining)
+        if (deficit_role is not None
+                and len(live) + len(draining) < self.max_replicas):
             if self.replacements >= self.max_replacements:
                 raise AutoscalerGaveUp(
                     f"replacement budget exhausted "
@@ -184,10 +303,11 @@ class Autoscaler:
                     self.events)
             self.replacements += 1
             before = len(live)
-            rid = fleet.spawn_replica()
+            rid = fleet.spawn_replica(
+                deficit_role if deficit_role != "both" else None)
             self._emit("replace", "replica-dead", before, before + 1,
-                       replica=rid,
-                       replacements=self.replacements, **sense)
+                       replica=rid, role=deficit_role,
+                       replacements=self.replacements, **evidence)
             return
 
         # 2) idle bookkeeping for the scale-down grace window
@@ -195,40 +315,103 @@ class Autoscaler:
                 and sense["in_flight"] == 0)
         self._idle_ticks = self._idle_ticks + 1 if idle else 0
 
+        # the derivative gate's memory updates EVERY step (cooldown
+        # included) — a stale previous delay would misread a cooling
+        # queue as a fresh breach the moment the cooldown lifts
+        prev_delay = dict(self._prev_delay)
+        prev_lam = dict(self._prev_lam)
+        for r, s in sense["by_role"].items():
+            self._prev_delay[r] = s["predicted_delay_s"]
+            self._prev_lam[r] = s["arrival_rate"]
+
         if not self._cooled_down(tick):
             return
 
-        # 3) scale up on predicted-delay breach (fallback: raw
-        # backlog-per-lane when no tick-time evidence exists yet). The
+        # 3) scale up on the first role whose predicted delay breaches
+        # (fallback: raw backlog-per-lane when no tick-time evidence
+        # exists yet), gated on the delay derivative: a breach that is
+        # already IMPROVING (previous step's delay was higher) gets no
+        # more capacity — the last spawn is still absorbing it. The
         # capacity envelope counts DRAINING replicas too — their
-        # engines still hold memory/processes until released, and the
-        # replacement branch already counts them.
-        delay = sense["predicted_delay_s"]
-        if delay is not None and self.up_delay_s is not None:
-            breach = delay > self.up_delay_s
-            up_reason = "predicted-delay-breach"
-        else:
-            breach = sense["pending_per_slot"] > self.up_pending_per_slot
-            up_reason = "backlog-threshold"
-        if breach and len(live) + len(draining) < self.max_replicas:
-            before = len(live)
-            self.desired = min(self.max_replicas, self.desired + 1)
-            rid = fleet.spawn_replica()
-            self._last_scale_tick = tick
-            self._emit("up", up_reason, before, before + 1,
-                       replica=rid, **sense)
-            return
+        # engines still hold memory/processes until released.
+        if len(live) + len(draining) < self.max_replicas:
+            for role, s in sense["by_role"].items():
+                delay = s["predicted_delay_s"]
+                if delay is not None and self.up_delay_s is not None:
+                    breach = delay > self.up_delay_s
+                    up_reason = "predicted-delay-breach"
+                else:
+                    breach = (s["pending_per_slot"]
+                              > self.up_pending_per_slot)
+                    up_reason = "backlog-threshold"
+                if not breach:
+                    continue
+                pd = prev_delay.get(role)
+                if delay is not None and pd is not None:
+                    if delay < pd:
+                        continue        # improving: let it drain
+                    if (math.isinf(delay) and math.isinf(pd)
+                            and s["arrival_rate"]
+                            < prev_lam.get(role, math.inf)):
+                        # both reads saturated (inf < inf is useless)
+                        # — judge the breach by the arrival-rate
+                        # derivative instead: a decaying λ EMA means
+                        # the burst has passed and the last spawn is
+                        # still absorbing it
+                        continue
+                before = len(live)
+                self.desired = min(self.max_replicas, self.desired + 1)
+                self.desired_by_role[role] = \
+                    self.desired_by_role.get(role, 0) + 1
+                rid = fleet.spawn_replica(
+                    role if role != "both" else None)
+                self._last_scale_tick = tick
+                self._emit("up", up_reason, before, before + 1,
+                           replica=rid, role=role,
+                           predicted_delay_role_s=delay, **evidence)
+                return
 
-        # 4) scale down on sustained idle, through drain() — lossless
+        # 4) scale down on sustained idle, through drain() — lossless.
+        # Never drain a role's LAST live replica: a prefill group with
+        # no decode peer (or vice versa) deadlocks the handoff path.
         if (self._idle_ticks >= self.idle_grace_ticks
                 and len(live) > self.min_replicas
                 and self.desired > self.min_replicas):
-            victim = min(live, key=lambda w: (
+            role_counts: Dict[str, int] = {}
+            for w in live:
+                r = getattr(w, "role", "both")
+                role_counts[r] = role_counts.get(r, 0) + 1
+            cands = [w for w in live
+                     if role_counts[getattr(w, "role", "both")] > 1]
+            if not cands:
+                return
+            victim = min(cands, key=lambda w: (
                 w.scheduler.pending_new_tokens(), -w.replica_id))
+            vrole = getattr(victim, "role", "both")
             before = len(live)
             self.desired -= 1
+            if self.desired_by_role.get(vrole, 0) > 0:
+                self.desired_by_role[vrole] -= 1
             fleet.drain(victim.replica_id)
             self._last_scale_tick = tick
             self._idle_ticks = 0
             self._emit("down", "sustained-idle", before, before - 1,
-                       replica=victim.replica_id, **sense)
+                       replica=victim.replica_id, role=vrole,
+                       **evidence)
+
+    def _role_deficit(self, live, draining) -> Optional[str]:
+        """The first role short of its desired count (None = envelope
+        healthy). Draining replicas still count — the replacement
+        branch must not double-heal a scale-down in progress."""
+        have: Dict[str, int] = {}
+        for w in list(live) + list(draining):
+            r = getattr(w, "role", "both")
+            have[r] = have.get(r, 0) + 1
+        for r in sorted(self.desired_by_role):
+            if have.get(r, 0) < self.desired_by_role[r]:
+                return r
+        # legacy guard: totals disagree without a per-role deficit
+        # (e.g. desired bumped externally) — heal with a "both" spawn
+        if len(live) + len(draining) < (self.desired or 0):
+            return "both"
+        return None

@@ -867,6 +867,58 @@ class DecodeEngine:
         self._unigram_idx[slot] = {}
         self._prefilling.pop(slot, None)
 
+    # -- prefill/decode disaggregation (ISSUE 18) --------------------------
+
+    def export_slot(self, slot: int):
+        """Package a live slot's state for a prefill→decode handoff:
+        ``(meta, kpages, vpages)`` where meta carries the KV length and
+        block count and the pages are host numpy in table order (see
+        :meth:`PagedKVCache.export_pages`). Exported at the moment the
+        first token exists but no decode tick has run, the pages cover
+        exactly the prompt — the pending first token's KV is written by
+        the ADOPTING replica's first tick, so nothing transient is
+        lost in flight."""
+        assert self.active[slot], f"slot {slot} is not live"
+        P = int(self.cache.lengths[slot])
+        ids, kpages, vpages = self.cache.export_pages(slot)
+        meta = {"length": P, "blocks": len(ids),
+                "quant": self.cache.quant_dtype}
+        return meta, kpages, vpages
+
+    def adopt_slot(self, slot: int, prompt: List[int], first_token: int,
+                   kpages, vpages,
+                   reserve_len: Optional[int] = None) -> bool:
+        """Adopt a handed-off sequence into a free slot: import the
+        streamed pages at this pool's own block ids, then rebuild the
+        host lane state exactly as :meth:`prefill_step`'s completion
+        would have — pending token, history (prompt + first token),
+        drafter indices, prefix registration — so the first decode tick
+        here is bit-identical to the tick a colocated replica would
+        have run. Returns False on pool backpressure (nothing
+        changed)."""
+        assert not self.active[slot], f"slot {slot} is occupied"
+        assert slot not in self._prefilling, f"slot {slot} is prefilling"
+        P = len(prompt)
+        if not 0 < P <= self._W:
+            raise ValueError(f"prompt length {P} not in [1, {self._W}]")
+        if not self.cache.import_pages(slot, kpages, vpages, P,
+                                       reserve_len=reserve_len):
+            return False
+        tok = int(first_token)
+        self.active[slot] = True
+        self.tokens[slot] = tok
+        self.history[slot] = []
+        self._bigram_idx[slot] = {}
+        self._unigram_idx[slot] = {}
+        self._history_append(slot, list(prompt) + [tok])
+        self.cache.register_prefix(slot, prompt)
+        self.slot_stats[slot] = {
+            "prefix_hit_blocks": 0, "shared_len": 0,
+            "blocks_reserved": self.cache.owned_count(slot),
+            "cow_forks": 0, "prefill_chunks": 0,
+            "draft_proposed": 0, "draft_accepted": 0}
+        return True
+
     # -- speculation -------------------------------------------------------
 
     def _history_append(self, slot: int, toks: List[int]) -> None:

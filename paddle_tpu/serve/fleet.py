@@ -83,6 +83,7 @@ from ..obs.trace import Tracer
 from ..parallel import multihost
 from . import transport as transport_lib
 from .engine import AdmitProbe
+from .kv_cache import blobs_to_pages, pages_to_blobs
 from .router import FleetRouter
 from .scheduler import ContinuousBatchingScheduler, Request
 
@@ -102,11 +103,16 @@ class ReplicaWorker:
     replica *does* (nothing), not what the fleet *knows* (that takes a
     stale heartbeat)."""
 
-    def __init__(self, replica_id: int, engine, scheduler, root: str):
+    def __init__(self, replica_id: int, engine, scheduler, root: str,
+                 role: str = "both"):
         self.replica_id = int(replica_id)
         self.engine = engine
         self.scheduler = scheduler
         self.root = root
+        # disaggregation role (ISSUE 18): "prefill"|"decode"|"both".
+        # The router filters placement on it; "both" is the colocated
+        # default and serves everything.
+        self.role = role
         self.state = "live"
         self.killed = False
         self._stall_until: Optional[int] = None
@@ -197,6 +203,29 @@ class ReplicaWorker:
     def transport_stats(self) -> Optional[Dict[str, int]]:
         return None
 
+    def pop_handoffs(self) -> List[Dict[str, Any]]:
+        """Drain finished prefills awaiting transfer, SERIALIZED to the
+        wire format even in-process — the wire-byte accounting (and the
+        bit-identity claim: decode adopts exactly the bytes that would
+        cross a socket) must not depend on replica mode."""
+        out = []
+        for req, meta, kpages, vpages in self.scheduler.pop_handoffs():
+            blobs = pages_to_blobs(kpages, vpages)
+            out.append({"rid": req.rid, "meta": meta, "blobs": blobs})
+        return out
+
+    def adopt(self, fr: "FleetRequest", pkg: Dict[str, Any],
+              now: float) -> Optional[Request]:
+        """Decode-side adoption of a streamed prefill package; None =
+        can't take it yet (no slot / pool backpressure)."""
+        cache = self.engine.cache
+        kpages, vpages = blobs_to_pages(
+            pkg["blobs"], num_layers=cache.num_layers,
+            block_size=cache.block_size, num_heads=cache.num_heads,
+            head_dim=cache.head_dim, quantized=cache.quantized,
+            dtype=cache.dtype)
+        return self.scheduler.adopt(pkg["meta"], kpages, vpages)
+
     def drain_spans(self) -> List[Dict[str, Any]]:
         """Pop this replica's buffered trace events for the fleet-level
         merge (empty when tracing is off)."""
@@ -234,6 +263,7 @@ class ReplicaWorker:
         self.scheduler.running.clear()
         self.scheduler.prefilling.clear()
         self.scheduler.queue.clear()
+        self.scheduler.handoffs.clear()
         self.known.clear()
 
     def tick(self, now: float, tick_idx: int) -> None:
@@ -315,6 +345,7 @@ class _RemoteSchedulerView:
         self.max_slots = 1
         self.est_tick_s: Optional[float] = None
         self._pending = 0
+        self._prefill_backlog = 0
         self.queue: List[int] = []          # rids, as last reported
         self.running: List[int] = []
         self.prefilling: List[int] = []
@@ -323,6 +354,7 @@ class _RemoteSchedulerView:
 
     def update(self, load: Dict[str, Any]) -> None:
         self._pending = int(load.get("pending_new_tokens") or 0)
+        self._prefill_backlog = int(load.get("prefill_backlog") or 0)
         self.queue = list(load.get("queued_rids") or ())
         self.running = list(load.get("running_rids") or ())
         self.prefilling = list(load.get("prefilling_rids") or ())
@@ -331,6 +363,9 @@ class _RemoteSchedulerView:
 
     def pending_new_tokens(self) -> int:
         return self._pending
+
+    def prefill_backlog(self) -> int:
+        return self._prefill_backlog
 
     def predicted_completion_s(self, max_new_tokens: int
                                ) -> Optional[float]:
@@ -431,11 +466,13 @@ class ProcReplicaWorker:
 
     def __init__(self, replica_id: int, spec: Dict[str, Any], root: str,
                  *, faults=None, telemetry=None, timeout_s: float = 2.0,
-                 spawn_timeout_s: float = 300.0, stderr=None):
+                 spawn_timeout_s: float = 300.0, stderr=None,
+                 mode: str = "process", role: str = "both"):
         self.replica_id = int(replica_id)
         self.root = root
         self.state = "live"
         self.killed = False
+        self.role = role
         self._stall_until: Optional[int] = None
         self.known: set = set()
         self._collected = 0
@@ -448,11 +485,39 @@ class ProcReplicaWorker:
         # trace events shipped piggybacked on tick replies (ISSUE 17),
         # buffered here until the fleet's per-tick span drain
         self._spans: List[Dict[str, Any]] = []
+        # KV-page handoff packages shipped on tick replies (ISSUE 18),
+        # buffered until the fleet's per-tick handoff sweep
+        self._handoffs: List[Dict[str, Any]] = []
         self._spawn_timeout_s = float(spawn_timeout_s)
         spec = dict(spec, replica_id=self.replica_id, root=root)
-        proc = transport_lib.spawn_replica_process(spec, stderr=stderr)
-        self.transport = transport_lib.ReplicaTransport(
-            proc.stdout, proc.stdin, proc=proc, timeout_s=timeout_s)
+        if role != "both":
+            spec["role"] = role
+        if mode == "socket":
+            # socket transport (ISSUE 18): listen first, THEN spawn —
+            # the child dials on startup. Loopback here; a remote host
+            # runs the same child by hand against a routable listener.
+            srv = transport_lib.listen()
+            host, port = srv.getsockname()
+            proc = transport_lib.spawn_replica_process(
+                spec, stderr=stderr, connect=f"{host}:{port}")
+            try:
+                sock, _ = transport_lib.accept_connection(
+                    srv, timeout_s=self._spawn_timeout_s)
+            except transport_lib.TransportError:
+                if proc.poll() is None:
+                    proc.kill()
+                raise
+            finally:
+                srv.close()
+            self.transport = transport_lib.ReplicaTransport(
+                transport_lib.SocketFrameReader(sock),
+                transport_lib.SocketWriter(sock), proc=proc,
+                timeout_s=timeout_s)
+        else:
+            proc = transport_lib.spawn_replica_process(spec,
+                                                       stderr=stderr)
+            self.transport = transport_lib.ReplicaTransport(
+                proc.stdout, proc.stdin, proc=proc, timeout_s=timeout_s)
 
     @property
     def pid(self) -> Optional[int]:
@@ -608,6 +673,25 @@ class ProcReplicaWorker:
             req.finish_reason = rec.get("finish_reason")
             req.finish_ts = req.submit_ts   # done marker; truth in rec
             self.scheduler.completed.append(req)
+        # KV handoff packages (ISSUE 18): the framed binary payloads
+        # landed in reply["blobs"]; each handoff header says how many
+        # belong to it. A package only exists here because the WHOLE
+        # reply (header + every blob) was absorbed — a child killed
+        # mid-transfer never surfaces a partial handoff.
+        hoffs = reply.get("handoffs") or ()
+        if hoffs:
+            blobs = reply.get("blobs") or []
+            off = 0
+            for h in hoffs:
+                nb = int(h.get("nblobs") or 0)
+                rid = int(h["rid"])
+                self._handoffs.append({
+                    "rid": rid, "meta": h["meta"],
+                    "blobs": blobs[off:off + nb]})
+                off += nb
+                # the request now lives between replicas; the child
+                # forgot it too, so a later re-delivery must not dedupe
+                self.scheduler.by_rid.pop(rid, None)
 
     def begin_drain(self, now: float) -> List[int]:
         try:
@@ -631,6 +715,37 @@ class ProcReplicaWorker:
             self.transport.request("resume")
         except transport_lib.TransportError as e:
             self._transport_error("resume", e)
+
+    def pop_handoffs(self) -> List[Dict[str, Any]]:
+        out, self._handoffs = self._handoffs, []
+        return out
+
+    def adopt(self, fr: "FleetRequest", pkg: Dict[str, Any],
+              now: float) -> Optional[Request]:
+        """Ship a finished-prefill package to this (decode) child: one
+        "adopt" round with the KV pages as framed binary payloads."""
+        if self.transport_down:
+            return None
+        try:
+            reply = self.transport.request(
+                "adopt", rid=fr.rid, meta=pkg["meta"],
+                blobs=pkg["blobs"], now=now)
+        except transport_lib.TransportError as e:
+            self._transport_error("adopt", e)
+            return None
+        if not reply.get("ok"):
+            return None                 # refused (capacity/draining)
+        meta = pkg["meta"]
+        req = RemoteRequest(
+            rid=fr.rid, prompt=list(meta["prompt"]),
+            max_new_tokens=int(meta["max_new_tokens"]),
+            eos_id=meta.get("eos_id"),
+            deadline_s=meta.get("deadline_s"),
+            priority=int(meta.get("priority") or 0),
+            retries=int(meta.get("retries") or 0),
+            submit_ts=meta.get("submit_ts"))
+        self.scheduler.by_rid[fr.rid] = req
+        return req
 
     def idle(self) -> bool:
         return not (self.scheduler.running or self.scheduler.prefilling
@@ -727,17 +842,38 @@ class ServingFleet:
                  transport_timeout_s: float = 2.0,
                  spawn_timeout_s: float = 300.0,
                  autoscaler=None, trace: bool = False, slo=None,
-                 anomaly=None):
+                 anomaly=None, roles: Optional[List[str]] = None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
-        if replica_mode not in ("inprocess", "process"):
-            raise ValueError(f"replica_mode must be "
-                             f"'inprocess'|'process', got {replica_mode!r}")
-        if replica_mode == "process" and proc_spec is None:
+        if replica_mode not in ("inprocess", "process", "socket"):
             raise ValueError(
-                "replica_mode='process' needs proc_spec — use "
-                "ServingFleet.from_model(..., replica_mode='process') "
-                "or build_proc_spec()")
+                f"replica_mode must be 'inprocess'|'process'|'socket', "
+                f"got {replica_mode!r}")
+        if replica_mode in ("process", "socket") and proc_spec is None:
+            raise ValueError(
+                f"replica_mode={replica_mode!r} needs proc_spec — use "
+                "ServingFleet.from_model(...) or build_proc_spec()")
+        # prefill/decode disaggregation (ISSUE 18): roles[i] is replica
+        # i's role; None = every replica serves "both" (the byte-
+        # identical colocated fleet). A mixed fleet needs at least one
+        # decode-capable replica or handoffs would have nowhere to land.
+        if roles is not None:
+            roles = list(roles)
+            if len(roles) != n_replicas:
+                raise ValueError(
+                    f"roles has {len(roles)} entries for "
+                    f"{n_replicas} replicas")
+            bad = [r for r in roles
+                   if r not in ("both", "prefill", "decode")]
+            if bad:
+                raise ValueError(f"invalid role(s) {bad!r}: must be "
+                                 f"'both'|'prefill'|'decode'")
+            if (any(r == "prefill" for r in roles)
+                    and not any(r in ("decode", "both") for r in roles)):
+                raise ValueError("a fleet with prefill replicas needs "
+                                 "at least one decode-capable replica")
+        self._roles = roles
+        self.disagg = bool(roles) and any(r == "prefill" for r in roles)
         self.replica_mode = replica_mode
         self.telemetry = telemetry
         self.clock = clock if clock is not None else time.perf_counter
@@ -755,13 +891,14 @@ class ServingFleet:
         self.tracer = Tracer(clock=self.clock) if trace else None
         self._replica_spans: Dict[int, List[Dict[str, Any]]] = \
             collections.defaultdict(list)
-        if self.tracer is not None and replica_mode == "process":
+        if self.tracer is not None and replica_mode in ("process",
+                                                        "socket"):
             self._proc_spec["trace"] = True
         self.slo = SLOMonitor() if slo is True else (slo or None)
         self.anomaly = anomaly
         self.workers: List[Any] = []
-        for _ in range(n_replicas):       # Popen-spawn (or build) all…
-            self._spawn_worker()
+        for i in range(n_replicas):       # Popen-spawn (or build) all…
+            self._spawn_worker(roles[i] if roles else "both")
         self.router = FleetRouter(
             self.workers, self.root,
             heartbeat_timeout_s=heartbeat_timeout_s, clock=self.clock,
@@ -785,6 +922,23 @@ class ServingFleet:
         self.shed_count = 0
         self.duplicates_dropped = 0
         self.stale_completions = 0
+        self.arrived_prompt_tokens = 0
+        self.arrived_new_tokens = 0
+        # handoff ledger (ISSUE 18): rid -> in-flight KV package. A rid
+        # here is owned by the FLEET — no replica holds it, so the
+        # reconcile sweep must not resubmit it (fr.replica is None).
+        self._pending_handoffs: Dict[int, Dict[str, Any]] = {}
+        self.handoff_count = 0
+        self.handoff_wire_bytes = 0
+        self.handoff_blocks = 0
+        self.stale_handoffs = 0
+        # host-side router/reconcile cost (satellite 1): wall seconds
+        # (perf_counter, NEVER the injectable clock — SimClock would
+        # report zero) accumulated around placement work, bucketed per
+        # fleet tick. Submit-path routing lands in the next tick's
+        # bucket.
+        self._router_cur_s = 0.0
+        self._router_tick_s: List[float] = []
         if self.anomaly is not None:
             # bundles capture fleet-level evidence at trigger time:
             # live heartbeats, the merged-trace tail, transport totals
@@ -797,17 +951,18 @@ class ServingFleet:
 
     # -- replica lifecycle -------------------------------------------------
 
-    def _spawn_worker(self):
+    def _spawn_worker(self, role: str = "both"):
         """Construct (but do not yet join) replica ``len(workers)`` in
         the active mode. Ids are append-only — a dead/released worker
         stays as a tombstone — so replica id == list index forever."""
         i = len(self.workers)
-        if self.replica_mode == "process":
+        if self.replica_mode in ("process", "socket"):
             w = ProcReplicaWorker(
                 i, self._proc_spec, self.root, faults=self.faults,
                 telemetry=self.telemetry,
                 timeout_s=self._transport_timeout_s,
-                spawn_timeout_s=self._spawn_timeout_s)
+                spawn_timeout_s=self._spawn_timeout_s,
+                mode=self.replica_mode, role=role)
             if self.tracer is not None:
                 # retransmit/timeout/corrupt verdicts land as instants
                 # on the ROUTER lane — the child can't see them (a lost
@@ -822,21 +977,21 @@ class ServingFleet:
             sched = ContinuousBatchingScheduler(
                 eng, telemetry=self.telemetry, order=self.order,
                 shed=False, est_tick_s=self.est_tick_s, clock=self.clock,
-                tracer=wtr)
-            w = ReplicaWorker(i, eng, sched, self.root)
+                tracer=wtr, role=role)
+            w = ReplicaWorker(i, eng, sched, self.root, role=role)
             if wtr is not None:
                 eng.tracer = wtr
                 w.tracer = wtr
         self.workers.append(w)
         return w
 
-    def spawn_replica(self) -> int:
+    def spawn_replica(self, role: Optional[str] = None) -> int:
         """Add one replica to the live fleet — the autoscaler's
         scale-up / cold-replacement primitive. Blocks until the
         newcomer is serving and has beaten once (a process replica pays
         its jax bring-up here); the router (shared worker list) can
         place onto it immediately. Returns the new replica id."""
-        w = self._spawn_worker()
+        w = self._spawn_worker(role or "both")
         w.join(self.clock())
         self._replica_event("spawned", w)
         return w.replica_id
@@ -921,11 +1076,19 @@ class ServingFleet:
                           session_id=session_id, submit_ts=now)
         self.requests[fr.rid] = fr
         self._active[fr.rid] = fr
+        # monotone arrival-work counters (never pruned): the
+        # autoscaler's M/M/c arrival-rate estimator diffs these per
+        # step — prompt tokens are prefill work, new tokens decode work
+        self.arrived_prompt_tokens += len(fr.prompt)
+        self.arrived_new_tokens += max_new_tokens
         t0 = self.tracer.now_us() if self.tracer is not None else None
+        _w0 = time.perf_counter()
         dec = self.router.route(
             prompt_len=len(fr.prompt), max_new_tokens=max_new_tokens,
             deadline_s=deadline_s, session_id=session_id,
-            submit_ts=now, now=now)
+            submit_ts=now, now=now,
+            role="prefill" if self.disagg else None)
+        self._router_cur_s += time.perf_counter() - _w0
         if self.tracer is not None:
             # the rid's flow BEGINS here (phase "s"); every later hop —
             # replica-side queue_wait/decode, a resubmit, the terminal —
@@ -1009,7 +1172,8 @@ class ServingFleet:
             prompt_len=len(fr.prompt),
             max_new_tokens=fr.max_new_tokens, deadline_s=fr.deadline_s,
             session_id=fr.session_id, submit_ts=fr.submit_ts, now=now,
-            allow_shed=False)
+            allow_shed=False,
+            role="prefill" if self.disagg else None)
         if dec.worker is None:
             self._unplaced.append(fr)
         else:
@@ -1060,7 +1224,8 @@ class ServingFleet:
                 prompt_len=len(fr.prompt),
                 max_new_tokens=fr.max_new_tokens,
                 deadline_s=fr.deadline_s, session_id=fr.session_id,
-                submit_ts=fr.submit_ts, now=now, allow_shed=False)
+                submit_ts=fr.submit_ts, now=now, allow_shed=False,
+                role="prefill" if self.disagg else None)
             if dec.worker is not None:
                 self._unplaced.remove(fr)
                 self._deliver(fr, dec.worker)
@@ -1086,6 +1251,103 @@ class ServingFleet:
                 fr.record = req.record()
                 self._finalize(fr, emit=False)   # scheduler emitted it
                 w.known.discard(req.rid)
+
+    # -- prefill→decode handoff (ISSUE 18) ---------------------------------
+
+    def _collect_handoffs(self, now: float) -> None:
+        """Sweep finished-prefill KV packages out of the prefill
+        replicas into the fleet's handoff ledger. A package is only
+        visible once its WHOLE tick reply (header + every framed page
+        payload) was absorbed, so a prefill replica killed mid-transfer
+        simply never surfaces it — the request still points at the dead
+        replica and the ordinary reconcile resubmit re-homes it."""
+        for w in self.workers:
+            if w.killed or w.state in ("dead", "released"):
+                continue
+            pop = getattr(w, "pop_handoffs", None)
+            if pop is None:
+                continue
+            for pkg in pop():
+                rid = int(pkg["rid"])
+                fr = self.requests.get(rid)
+                if (fr is None or fr.record is not None
+                        or fr.replica != w.replica_id):
+                    # superseded attempt (the rid was already re-homed
+                    # or went terminal): the package is stale evidence
+                    self.stale_handoffs += 1
+                    continue
+                # the request now lives BETWEEN replicas: fleet-owned.
+                # reconcile skips replica-None rids; the ledger entry
+                # is the liveness obligation instead (deadline-swept
+                # in _place_handoffs).
+                fr.local, fr.replica = None, None
+                w.known.discard(rid)
+                self._pending_handoffs[rid] = {
+                    "pkg": pkg, "src": w.replica_id,
+                    "t0_pc": time.perf_counter(),
+                    "t0_us": (self.tracer.now_us()
+                              if self.tracer is not None else None)}
+
+    def _place_handoffs(self, now: float) -> None:
+        """Adopt every ledgered KV package onto a decode replica:
+        least ``pending_new_tokens`` first, try-each until one admits.
+        All refused → retry next tick (capacity may appear); zero
+        decode-capable replicas → the pages are worthless (their pool
+        is gone), drop the package and resubmit through prefill."""
+        for rid in list(self._pending_handoffs):
+            ho = self._pending_handoffs[rid]
+            fr = self.requests[rid]
+            if (fr.deadline_s is not None
+                    and now - fr.submit_ts > fr.deadline_s):
+                del self._pending_handoffs[rid]
+                fr.record = self._terminal_record(fr, "timeout", now)
+                self._finalize(fr)
+                continue
+            cands = self.router.candidates("decode")
+            if not cands:
+                del self._pending_handoffs[rid]
+                self._resubmit(fr, now, "handoff-lost")
+                continue
+            cands.sort(key=lambda w: self.router.load_key(w, None))
+            placed = False
+            for w in cands:
+                req = w.adopt(fr, ho["pkg"], now)
+                if req is None:
+                    continue
+                fr.local, fr.replica = req, w.replica_id
+                fr.attempts.append(w.replica_id)
+                w.known.add(rid)
+                del self._pending_handoffs[rid]
+                self._emit_handoff(fr, ho, w, now)
+                placed = True
+                break
+            if not placed and rid in self._pending_handoffs:
+                _log.debug("handoff rid=%d found no admitting decode "
+                           "replica this tick; retrying", rid)
+
+    def _emit_handoff(self, fr: FleetRequest, ho: Dict[str, Any],
+                      dst, now: float) -> None:
+        pkg = ho["pkg"]
+        meta = pkg["meta"]
+        wire = sum(len(b) for b in pkg["blobs"])
+        blocks = int(meta.get("blocks") or len(pkg["blobs"]))
+        ms = (time.perf_counter() - ho["t0_pc"]) * 1000.0
+        self.handoff_count += 1
+        self.handoff_wire_bytes += wire
+        self.handoff_blocks += blocks
+        self._emit({"kind": "kv_handoff", "rid": fr.rid,
+                    "blocks": blocks, "wire_bytes": wire,
+                    "quant": meta.get("quant"), "transfer_ms": ms,
+                    "src_replica": ho["src"],
+                    "dst_replica": dst.replica_id, "tick": self.ticks})
+        if self.tracer is not None:
+            # phase "t": the rid's flow steps THROUGH the handoff span
+            # — the merged trace draws prefill-lane → router-lane
+            # handoff → decode-lane as one connected arrow
+            self.tracer.complete(
+                "kv_handoff", ho["t0_us"], self.tracer.now_us(),
+                flow_step=fr.rid, rid=fr.rid, blocks=blocks,
+                wire_bytes=wire, src=ho["src"], dst=dst.replica_id)
 
     # -- elastic scale-down ------------------------------------------------
 
@@ -1139,9 +1401,15 @@ class ServingFleet:
             # policy BEFORE reconcile: a cold-spawned replacement is
             # placeable in the same tick that needs it
             self.autoscaler.step(now)
+        _w0 = time.perf_counter()
         self._reconcile(now)
+        self._router_cur_s += time.perf_counter() - _w0
         for w in self.workers:
             w.tick(now, t)
+        _w0 = time.perf_counter()
+        self._collect_handoffs(now)
+        self._place_handoffs(now)
+        self._router_cur_s += time.perf_counter() - _w0
         self._collect()
         if self.tracer is not None:
             for w in self.workers:
@@ -1169,10 +1437,12 @@ class ServingFleet:
                 self._replica_event(
                     "released", w,
                     free_blocks=w.engine.cache.free_blocks)
+        self._router_tick_s.append(self._router_cur_s)
+        self._router_cur_s = 0.0
         self.ticks += 1
 
     def outstanding(self) -> bool:
-        return (bool(self._active)
+        return (bool(self._active) or bool(self._pending_handoffs)
                 or any(w.state == "draining" for w in self.workers))
 
     def prune_terminal(self) -> int:
@@ -1284,6 +1554,19 @@ class ServingFleet:
 
     # -- reporting ---------------------------------------------------------
 
+    def _router_ms(self) -> Dict[str, Any]:
+        """Host-side placement cost (route + reconcile + handoff
+        sweeps) in wall milliseconds, bucketed per fleet tick — the
+        hostile-scale loadgen's router-overhead evidence."""
+        buckets = self._router_tick_s
+        total = sum(buckets) + self._router_cur_s
+        return {"total": total * 1000.0,
+                "per_tick_mean": ((sum(buckets) / len(buckets)) * 1000.0
+                                  if buckets else 0.0),
+                "per_tick_max": (max(buckets) * 1000.0
+                                 if buckets else 0.0),
+                "ticks": len(buckets)}
+
     def stats(self) -> Dict[str, Any]:
         reasons = collections.Counter(
             fr.record["finish_reason"]
@@ -1291,6 +1574,7 @@ class ServingFleet:
         per_replica = {}
         for w in self.workers:
             row = {"state": w.state, "killed": w.killed,
+                   "role": getattr(w, "role", "both"),
                    "engine_ticks": w.engine.ticks,
                    "free_blocks": w.engine.cache.free_blocks,
                    "prefix_hit_blocks": w.engine.cache.prefix_hit_blocks,
@@ -1322,6 +1606,12 @@ class ServingFleet:
                 w.engine.cache.cow_forks for w in self.workers),
             "transport": self._transport_totals(),
             "replicas": per_replica,
+            "handoffs": self.handoff_count,
+            "handoff_wire_bytes": self.handoff_wire_bytes,
+            "handoff_blocks": self.handoff_blocks,
+            "stale_handoffs": self.stale_handoffs,
+            "pending_handoffs": len(self._pending_handoffs),
+            "router_ms": self._router_ms(),
         }
         if self.slo is not None:
             # burn rate and the rolling percentiles ride the stats dict
@@ -1347,7 +1637,7 @@ class ServingFleet:
         models)."""
         from .engine import DecodeEngine
         ek = dict(engine_kwargs or {})
-        if replica_mode == "process":
+        if replica_mode in ("process", "socket"):
             root = kw.pop("root", None) or tempfile.mkdtemp(
                 prefix="paddle_tpu_fleet_")
             spec = build_proc_spec(
@@ -1358,7 +1648,7 @@ class ServingFleet:
                 compile_cache_dir=kw.pop("compile_cache_dir", None),
                 autotune_cache_dir=kw.pop("autotune_cache_dir", None),
                 telemetry_dir=kw.pop("telemetry_dir", None))
-            return cls(None, n_replicas, replica_mode="process",
+            return cls(None, n_replicas, replica_mode=replica_mode,
                        proc_spec=spec, root=root, **kw)
 
         def mk(_i):

@@ -80,7 +80,7 @@ __all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "PrefixMatch",
            "gather_pages", "scatter_prefill", "scatter_token",
            "scatter_span", "scatter_prefill_pages", "scatter_token_pages",
            "scatter_span_pages", "quantize_rows", "dequantize_rows",
-           "NULL_BLOCK"]
+           "pages_to_blobs", "blobs_to_pages", "NULL_BLOCK"]
 
 # block 0 never holds live data: it is the scatter target for padding rows
 # and the gather source for unallocated table entries (always masked)
@@ -230,6 +230,76 @@ def scatter_span_pages(pages, kv, table, start, n, write_from=None):
                 scatter_span(scales, s, table, start, n, write_from))
     return scatter_span(pages, kv.astype(pages.dtype), table, start, n,
                         write_from)
+
+
+# ---------------------------------------------------------------------------
+# cross-replica handoff serialization (ISSUE 18)
+# ---------------------------------------------------------------------------
+#
+# Prefill/decode disaggregation ships a finished prompt's KV pages to a
+# decode replica BLOCK BY BLOCK: the paged layout already made the block
+# the unit of allocation, sharing and eviction, so it is the natural wire
+# unit too — one binary transport frame per block, raw pool bytes (int8
+# values + f32 scales when quantized — the receiver adopts them verbatim,
+# so dequantization is bit-identical and the quantized wire cost is
+# exactly the ~2.7x-smaller `bytes_per_block`). No base64, no JSON.
+
+def pages_to_blobs(kpages, vpages) -> List[bytes]:
+    """Serialize exported pages (``[L, nb, bs, H, hd]`` arrays, or
+    ``(values, scales)`` tuples when quantized) into one ``bytes`` blob
+    per block: K leaves then V leaves, each C-contiguous. The inverse is
+    :func:`blobs_to_pages`; each blob is exactly ``bytes_per_block``
+    long, which is what the wire-byte accounting audits against."""
+    kleaves = list(kpages) if isinstance(kpages, tuple) else [kpages]
+    vleaves = list(vpages) if isinstance(vpages, tuple) else [vpages]
+    nb = int(np.asarray(kleaves[0]).shape[1])
+    out = []
+    for j in range(nb):
+        parts = [np.ascontiguousarray(np.asarray(a)[:, j]).tobytes()
+                 for a in kleaves + vleaves]
+        out.append(b"".join(parts))
+    return out
+
+
+def blobs_to_pages(blobs: List[bytes], *, num_layers: int,
+                   block_size: int, num_heads: int, head_dim: int,
+                   quantized: bool, dtype="float32"):
+    """Rebuild ``(kpages, vpages)`` pool page arrays from per-block wire
+    blobs. The receiver supplies ITS OWN pool geometry — a blob whose
+    length disagrees means the fleet is not homogeneous, and adopting it
+    would scatter garbage, so that is a hard :class:`ValueError` (the
+    adopt path refuses the handoff; the request resubmits)."""
+    if not blobs:
+        raise ValueError("handoff carries zero page blobs")
+    L, bs, H, hd = num_layers, block_size, num_heads, head_dim
+    if quantized:
+        specs = [((L, bs, H, hd), np.dtype(np.int8)),
+                 ((L, bs, H), np.dtype(np.float32))]
+    else:
+        specs = [((L, bs, H, hd), np.dtype(dtype))]
+    leaf_bytes = [int(np.prod(s)) * d.itemsize for s, d in specs]
+    per_blob = 2 * sum(leaf_bytes)
+    nleaves = len(specs)
+    cols: List[List[np.ndarray]] = [[] for _ in range(2 * nleaves)]
+    for blob in blobs:
+        if len(blob) != per_blob:
+            raise ValueError(
+                f"handoff blob is {len(blob)} bytes but this pool's "
+                f"geometry expects {per_blob} — replica pool shapes "
+                f"disagree")
+        off = 0
+        for i in range(2 * nleaves):
+            shape, d = specs[i % nleaves]
+            cols[i].append(np.frombuffer(
+                blob, dtype=d, count=int(np.prod(shape)),
+                offset=off).reshape(shape))
+            off += leaf_bytes[i % nleaves]
+    kleaves = [np.stack(cols[i], axis=1) for i in range(nleaves)]
+    vleaves = [np.stack(cols[nleaves + i], axis=1)
+               for i in range(nleaves)]
+    k = tuple(kleaves) if quantized else kleaves[0]
+    v = tuple(vleaves) if quantized else vleaves[0]
+    return k, v
 
 
 # ---------------------------------------------------------------------------
@@ -702,6 +772,58 @@ class PagedKVCache:
         if self.prefix_cache is None:
             return 0
         return self.prefix_cache.register(tokens, self._owned[slot])
+
+    # -- cross-replica handoff (ISSUE 18) ----------------------------------
+
+    def export_pages(self, slot: int):
+        """Read out the pool pages covering ``slot``'s current length
+        for a prefill→decode handoff: returns ``(block_ids, kpages,
+        vpages)`` where the page arrays are host numpy ``[L, nb, bs, H,
+        hd]`` (plus ``[L, nb, bs, H]`` scale leaves as ``(values,
+        scales)`` tuples when quantized) in TABLE ORDER — physical block
+        ids don't travel; the receiver re-homes the pages at its own
+        allocations. Shared/adopted blocks export fine (it's a read);
+        only blocks covering the length ship, not the reservation."""
+        nb = self.blocks_needed(int(self.lengths[slot]))
+        ids = [int(b) for b in self.tables[slot, :nb]]
+        sel = jnp.asarray(ids, jnp.int32)
+
+        def take(pool):
+            if isinstance(pool, tuple):
+                return (np.asarray(pool[0][:, sel]),
+                        np.asarray(pool[1][:, sel]))
+            return np.asarray(pool[:, sel])
+
+        return ids, take(self.k), take(self.v)
+
+    def import_pages(self, slot: int, kpages, vpages, length: int,
+                     reserve_len: Optional[int] = None) -> bool:
+        """Adopt handed-off pages into an EMPTY slot: allocate blocks to
+        cover ``max(length, reserve_len)`` (the full decode reservation,
+        so adoption can never strand mid-sequence on a dry pool), write
+        the page bytes at this pool's own block ids, and set the length.
+        Returns False (nothing changed) when the pool can't supply the
+        blocks — the decode side's backpressure; the fleet retries or
+        re-routes the handoff."""
+        assert not self._owned[slot], "import_pages on a non-empty slot"
+        target = max(int(length), int(reserve_len or 0))
+        if not self.ensure_capacity(slot, target):
+            return False
+        nb = self.blocks_needed(int(length))
+        sel = jnp.asarray(self._owned[slot][:nb], jnp.int32)
+
+        def put(pool, pages):
+            if isinstance(pool, tuple):
+                return (pool[0].at[:, sel].set(
+                            jnp.asarray(pages[0], pool[0].dtype)),
+                        pool[1].at[:, sel].set(
+                            jnp.asarray(pages[1], pool[1].dtype)))
+            return pool.at[:, sel].set(jnp.asarray(pages, pool.dtype))
+
+        self.k = put(self.k, kpages)
+        self.v = put(self.v, vpages)
+        self.lengths[slot] = int(length)
+        return True
 
     def cow_targets(self, slot: int, lo: int, hi: int) -> List[int]:
         """Table indices of ``slot``'s ADOPTED, still multiply-owned

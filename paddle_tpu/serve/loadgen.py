@@ -39,7 +39,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["GenRequest", "SimClock", "make_workload", "workload_stats"]
+__all__ = ["GenRequest", "SimClock", "make_workload",
+           "hostile_workload", "workload_stats"]
 
 
 class SimClock:
@@ -144,26 +145,46 @@ def make_workload(n_requests: int, vocab: int, *, seed: int = 0,
     return out
 
 
+def hostile_workload(n_requests: int, vocab: int, *, seed: int = 0,
+                     rate_rps: float = 12000.0,
+                     burst_factor: float = 10.0,
+                     **kw) -> List[GenRequest]:
+    """The hostile-scale preset (ISSUE 18): a 10k+ rps bursty trace —
+    thundering-herd bursts an order of magnitude over the base rate —
+    meant to be replayed on :class:`SimClock` against a fleet whose
+    router/reconcile HOST cost per tick is the measurement
+    (``fleet.stats()["router_ms"]``). Every :func:`make_workload` knob
+    passes through; only the arrival process is pinned hostile."""
+    return make_workload(n_requests, vocab, seed=seed,
+                         rate_rps=rate_rps, arrival="bursty",
+                         burst_factor=burst_factor, **kw)
+
+
 def _shareable_prefix_tokens(workload: List[GenRequest]) -> int:
     """Tokens a perfect prefix cache could avoid re-storing: for each
     request, the longest common prefix with the BEST earlier request in
     the trace (first occurrences share nothing — someone must pay for
     the prefix once). Session traces make this essentially
     ``session_prefix_len`` per repeat visit; the fleet gate sizes its
-    expected prefix-cache hits from exactly this number (ISSUE 12)."""
-    seen: List[List[int]] = []
+    expected prefix-cache hits from exactly this number (ISSUE 12).
+
+    The best-LCP-with-any-earlier-prompt is computed against a set of
+    every prefix of every earlier prompt (a request's best LCP is k iff
+    its own length-k prefix IS some earlier prompt's prefix), scanned
+    longest-first — O(total tokens), where the pairwise scan the
+    hostile-scale traces (ISSUE 18) replaced was O(n²·L)."""
+    seen: set = set()
     total = 0
     for g in workload:
+        p = tuple(g.prompt)
         best = 0
-        for prev in seen:
-            lcp = 0
-            for a, b in zip(prev, g.prompt):
-                if a != b:
-                    break
-                lcp += 1
-            best = max(best, lcp)
+        for k in range(len(p), 0, -1):
+            if p[:k] in seen:
+                best = k
+                break
         total += best
-        seen.append(g.prompt)
+        for k in range(1, len(p) + 1):
+            seen.add(p[:k])
     return total
 
 

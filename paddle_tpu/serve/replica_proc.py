@@ -244,7 +244,7 @@ def _build(spec: Dict[str, Any]):
     sched = ContinuousBatchingScheduler(
         engine, telemetry=buf, order=spec.get("order", "fcfs"),
         shed=False, est_tick_s=spec.get("est_tick_s"), clock=clock,
-        tracer=tracer)
+        tracer=tracer, role=spec.get("role", "both"))
     return engine, sched, buf, clock, startup
 
 
@@ -259,7 +259,10 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
     from ..parallel import multihost
     from . import transport as tp
 
-    reader = tp.FrameReader(read_file)
+    # a pre-built reader (SocketFrameReader in --connect mode) passes
+    # through; a file/fd gets the stock FrameReader
+    reader = (read_file if isinstance(read_file, tp.FrameReader)
+              else tp.FrameReader(read_file))
     tracer = getattr(sched, "tracer", None)
     reply_cache: "collections.OrderedDict[int, bytes]" = \
         collections.OrderedDict()
@@ -329,6 +332,38 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
                          retries=int(msg.get("retries") or 0))
             known.add(rid)
             return {"ok": True, "rid": rid, "duplicate": False}
+        if op == "adopt":
+            # prefill→decode handoff (ISSUE 18): the KV pages arrived
+            # as framed binary payloads riding this message
+            rid = int(msg["rid"])
+            if rid in known:
+                return {"ok": True, "rid": rid, "duplicate": True}
+            if draining:
+                return {"ok": False, "rid": rid, "reason": "draining"}
+            blobs = msg.get("blobs") or []
+            if msg.get("_corrupt_blobs") or any(b is None for b in blobs):
+                # a payload failed its CRC: frame sync survived (the
+                # whole frame was consumed), so refuse cleanly — the
+                # fleet retries or re-homes
+                return {"ok": False, "rid": rid,
+                        "reason": "corrupt-payload"}
+            from .kv_cache import blobs_to_pages
+            cache = engine.cache
+            try:
+                kpages, vpages = blobs_to_pages(
+                    blobs, num_layers=cache.num_layers,
+                    block_size=cache.block_size,
+                    num_heads=cache.num_heads, head_dim=cache.head_dim,
+                    quantized=cache.quantized, dtype=cache.dtype)
+            except ValueError as e:
+                return {"ok": False, "rid": rid,
+                        "reason": f"corrupt-payload: {e}"}
+            req = sched.adopt(msg["meta"], kpages, vpages)
+            if req is None:
+                return {"ok": False, "rid": rid,
+                        "reason": sched.last_backpressure or "capacity"}
+            known.add(rid)
+            return {"ok": True, "rid": rid, "duplicate": False}
         if op == "tick":
             sched.step()
             beat(msg.get("now"))
@@ -343,6 +378,23 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
             reply = {"ok": True, "tick": msg.get("tick"),
                      "completed": completed, "events": buf.drain(),
                      "load": load_report()}
+            # finished-prefill KV packages ship on the tick reply as
+            # framed binary payloads (a prefill-role replica only;
+            # getattr: transport tests drive serve_loop with fakes)
+            pop = getattr(sched, "pop_handoffs", None)
+            if pop is not None:
+                handoffs, out_blobs = [], []
+                from .kv_cache import pages_to_blobs
+                for req, hmeta, kpages, vpages in pop():
+                    hb = pages_to_blobs(kpages, vpages)
+                    known.discard(req.rid)   # fleet-owned now: a later
+                    # re-delivery (decode death) must not dedupe here
+                    handoffs.append({"rid": req.rid, "meta": hmeta,
+                                     "nblobs": len(hb)})
+                    out_blobs.extend(hb)
+                if handoffs:
+                    reply["handoffs"] = handoffs
+                    reply["_blobs"] = out_blobs
             if tracer is not None:
                 # span-batch shipping: spans ride the tick reply the
                 # work already uses (no side-channel files; a SIGKILL
@@ -379,6 +431,27 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
             msg = reader.read_frame()
         except tp.TransportClosed:
             return 0                    # parent went away: clean exit
+        # the blob channel: a message declaring nblobs is followed by
+        # that many binary frames — consumed UNCONDITIONALLY (a cached-
+        # seq retransmit resends its blobs too; skipping them would
+        # desync the stream). A CRC-failed payload keeps frame sync
+        # (the whole frame was consumed) and is classified, not fatal.
+        nblobs = int(msg.get("nblobs") or 0)
+        if nblobs:
+            blobs: List[Optional[bytes]] = []
+            corrupt = False
+            try:
+                for _ in range(nblobs):
+                    try:
+                        blobs.append(reader.read_binary_frame())
+                    except tp.TransportCorrupt:
+                        blobs.append(None)
+                        corrupt = True
+            except tp.TransportClosed:
+                return 0
+            msg["blobs"] = blobs
+            if corrupt:
+                msg["_corrupt_blobs"] = True
         seq = msg.get("seq", 0)
         if seq in reply_cache:
             # at-least-once retransmit: replay the cached bytes, never
@@ -396,7 +469,14 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
             reply = {"ok": False,
                      "error": f"{type(e).__name__}: {e}"}
         reply["seq"] = seq
+        out_blobs = reply.pop("_blobs", None) or []
+        if out_blobs:
+            reply["nblobs"] = len(out_blobs)
         data = tp.encode_frame(reply)
+        for b in out_blobs:
+            # cached as ONE byte string with the reply: a retransmit
+            # replays message + payloads exactly as first sent
+            data += tp.encode_binary_frame(b)
         reply_cache[seq] = data
         while len(reply_cache) > reply_cache_size:
             reply_cache.popitem(last=False)
@@ -427,14 +507,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="JSON spec (or @path to a JSON file): model "
                         "config, engine kwargs, variables npz, root, "
                         "replica_id")
+    p.add_argument("--connect", default=None, metavar="HOST:PORT",
+                   help="dial the fleet's TCP listener and speak the "
+                        "frame protocol over the socket instead of "
+                        "stdin/stdout (cross-host serving; loopback "
+                        "in CI)")
     args = p.parse_args(argv)
 
-    # claim the transport BEFORE anything can print: dup the real
-    # stdout for frames, then point fd 1 at stderr so stray prints
-    # (library warnings, user code) can never tear a frame
-    out = os.fdopen(os.dup(1), "wb")
-    os.dup2(2, 1)
-    sys.stdout = sys.stderr
+    from . import transport as tp
+
+    if args.connect:
+        # socket transport: stdout was already pointed at stderr by the
+        # spawner; still shield fd 1 so stray prints go to the log
+        os.dup2(2, 1)
+        sys.stdout = sys.stderr
+        host, _, port = args.connect.rpartition(":")
+        sock = tp.connect(host or "127.0.0.1", int(port))
+        read_file: Any = tp.SocketFrameReader(sock)
+        out: Any = tp.SocketWriter(sock)
+    else:
+        # claim the transport BEFORE anything can print: dup the real
+        # stdout for frames, then point fd 1 at stderr so stray prints
+        # (library warnings, user code) can never tear a frame
+        out = os.fdopen(os.dup(1), "wb")
+        os.dup2(2, 1)
+        sys.stdout = sys.stderr
+        read_file = sys.stdin.buffer
 
     raw = args.spec
     if raw.startswith("@"):
@@ -443,7 +541,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     spec = json.loads(raw)
     engine, sched, buf, clock, startup = _build(spec)
     return serve_loop(
-        sys.stdin.buffer, out, engine=engine, sched=sched, buf=buf,
+        read_file, out, engine=engine, sched=sched, buf=buf,
         clock=clock, root=spec["root"],
         replica_id=int(spec["replica_id"]), startup=startup)
 

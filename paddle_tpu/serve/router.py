@@ -118,10 +118,30 @@ class FleetRouter:
                     del self.sessions[sid]
         return newly
 
-    def candidates(self) -> List[Any]:
+    def candidates(self, role: Optional[str] = None) -> List[Any]:
         """Placeable replicas: live state (draining replicas finish what
-        they have but admit nothing new — the drain contract)."""
-        return [w for w in self.workers if w.state == "live"]
+        they have but admit nothing new — the drain contract). With
+        ``role``, only replicas serving that role — a "both" replica
+        serves either (the colocated fallback in a mixed fleet)."""
+        out = [w for w in self.workers if w.state == "live"]
+        if role is not None:
+            out = [w for w in out
+                   if getattr(w, "role", "both") in (role, "both")]
+        return out
+
+    @staticmethod
+    def load_key(worker, role: Optional[str] = None):
+        """The role-aware placement key (ISSUE 18): prefill placement
+        balances on ``prefill_backlog`` (prompt tokens not yet
+        prefilled — the work a prefill replica actually does; decode
+        debt would be noise there), everything else on
+        ``pending_new_tokens`` (the tick-denominated decode backlog).
+        Replica id breaks ties deterministically."""
+        if role == "prefill":
+            return (worker.scheduler.prefill_backlog(),
+                    worker.replica_id)
+        return (worker.scheduler.pending_new_tokens(),
+                worker.replica_id)
 
     # -- placement ---------------------------------------------------------
 
@@ -130,12 +150,12 @@ class FleetRouter:
               session_id: Optional[int] = None,
               submit_ts: Optional[float] = None,
               now: Optional[float] = None,
-              allow_shed: bool = True) -> RouteDecision:
-        cands = self.candidates()
+              allow_shed: bool = True,
+              role: Optional[str] = None) -> RouteDecision:
+        cands = self.candidates(role)
         if not cands:
             return RouteDecision(worker=None)
-        least = min(cands, key=lambda w: (
-            w.scheduler.pending_new_tokens(), w.replica_id))
+        least = min(cands, key=lambda w: self.load_key(w, role))
         chosen, hit = None, False
         if self.affinity and session_id is not None:
             pinned = self.sessions.get(session_id)

@@ -160,12 +160,16 @@ class ContinuousBatchingScheduler:
     def __init__(self, engine, telemetry=None, policy: str = "continuous",
                  order: str = "fcfs", shed: bool = False,
                  est_tick_s: Optional[float] = None,
-                 clock=time.perf_counter, tracer=None):
+                 clock=time.perf_counter, tracer=None,
+                 role: str = "both"):
         if policy not in ("continuous", "static"):
             raise ValueError(f"policy must be 'continuous'|'static', "
                              f"got {policy!r}")
         if order not in ORDERS:
             raise ValueError(f"order must be one of {ORDERS}, got {order!r}")
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be 'both'|'prefill'|'decode', "
+                             f"got {role!r}")
         self.engine = engine
         self.telemetry = (telemetry if telemetry is not None
                           else engine.telemetry)
@@ -177,6 +181,14 @@ class ContinuousBatchingScheduler:
         self.tracer = tracer
         self.policy = policy
         self.order = order
+        # prefill/decode disaggregation (ISSUE 18): a "prefill"-role
+        # scheduler runs admission + prefill only — the moment a
+        # request's first token lands it exports the slot's KV pages
+        # into `handoffs` (the fleet streams them to a decode replica)
+        # instead of decoding. A "decode" scheduler additionally accepts
+        # `adopt()`ed sequences. "both" (default) is the colocated
+        # baseline, byte-identical to pre-disagg behavior.
+        self.role = role
         self.shed = shed
         self.est_tick_s = est_tick_s
         # injectable wall clock: deadlines are tested deterministically
@@ -191,6 +203,9 @@ class ContinuousBatchingScheduler:
         # the last refusal's structured reason ("blocks"|"width"), for
         # router placement/shedding — None while admission is flowing
         self.last_backpressure: Optional[str] = None
+        # finished prefills awaiting transfer: (request, meta, kpages,
+        # vpages) tuples the fleet drains via pop_handoffs() each tick
+        self.handoffs: List[tuple] = []
         self._rid = itertools.count()
         self._seq = itertools.count()
         self._last_step_ts: Optional[float] = None
@@ -217,7 +232,17 @@ class ContinuousBatchingScheduler:
         return {"pending_new_tokens": self.pending_new_tokens(),
                 "running": len(self.running),
                 "queued": len(self.queue),
-                "prefilling": len(self.prefilling)}
+                "prefilling": len(self.prefilling),
+                "prefill_backlog": self.prefill_backlog(),
+                "role": self.role}
+
+    def prefill_backlog(self) -> int:
+        """Prompt tokens not yet prefilled — the PREFILL-role load
+        number (pending_new_tokens is decode-denominated and would
+        misplace prefill work onto a replica that never decodes).
+        Role-aware routing places prefill on the least of this."""
+        return (sum(len(r.prompt) for r in self.queue)
+                + sum(len(r.prompt) for r in self.prefilling.values()))
 
     def predicted_completion_s(self, max_new_tokens: int
                                ) -> Optional[float]:
@@ -421,6 +446,100 @@ class ContinuousBatchingScheduler:
         req.first_token_ts = self._clock()
         self.running[slot] = req
         self._maybe_finish(slot, tok)
+        if self.role == "prefill" and not req.done:
+            # disaggregation: the prompt's KV and the pending first
+            # token leave for a decode replica. A request TERMINAL at
+            # its first token (eos, max_new=1) finished above on this
+            # replica — shipping zero decode work would be pure wire
+            # cost.
+            self._hand_off(slot, req)
+
+    def _hand_off(self, slot: int, req: Request) -> None:
+        """Export a just-prefilled slot for transfer and release it
+        locally. The request leaves this scheduler WITHOUT a completed
+        record — the adopting decode replica authors the terminal
+        record, carrying the ORIGINAL submit/first-token stamps so
+        TTFT/wall stay end-to-end truth. Prefill-side attribution
+        (prefix hits, chunks) rides the meta and is merged into the
+        decode slot's stats."""
+        meta, kpages, vpages = self.engine.export_slot(slot)
+        st = self.engine.slot_stats[slot]
+        meta.update({
+            "rid": req.rid, "prompt": list(req.prompt),
+            "max_new_tokens": req.max_new_tokens,
+            "eos_id": req.eos_id, "deadline_s": req.deadline_s,
+            "priority": req.priority, "retries": req.retries,
+            "submit_ts": req.submit_ts,
+            "first_token": req.tokens[0],
+            "first_token_ts": req.first_token_ts,
+            "prefill_stats": {
+                "prefix_hit_blocks": st.get("prefix_hit_blocks", 0),
+                "shared_len": st.get("shared_len", 0),
+                "cow_forks": st.get("cow_forks", 0),
+                "prefill_chunks": st.get("prefill_chunks", 0)}})
+        del self.running[slot]
+        self.engine.evict(slot)
+        req.slot = None
+        self.handoffs.append((req, meta, kpages, vpages))
+        if self.tracer is not None:
+            now_us = self._clock() * 1e6
+            self.tracer.complete("handoff_out", now_us,
+                                 flow_step=req.rid, rid=req.rid,
+                                 blocks=meta["blocks"])
+
+    def pop_handoffs(self) -> List[tuple]:
+        """Drain finished prefills awaiting transfer (fleet-facing)."""
+        out, self.handoffs = self.handoffs, []
+        return out
+
+    def adopt(self, meta: Dict[str, Any], kpages,
+              vpages) -> Optional[Request]:
+        """Decode-side admission of a streamed prefill: capacity-check,
+        import the pages into a free slot, and enter the request
+        directly in ``running`` with its first token already generated.
+        Returns None (nothing changed) when this replica can't take it
+        yet — no free slot or pool backpressure; the fleet retries or
+        re-routes."""
+        free = self.engine.free_slots()
+        if not free:
+            self.last_backpressure = "slots"
+            return None
+        prompt = [int(t) for t in meta["prompt"]]
+        max_new = int(meta["max_new_tokens"])
+        target = max(len(prompt) + max_new - 1, len(prompt))
+        probe = self.engine.admit_probe(target, include_slots=False)
+        if not probe.ok:
+            self.last_backpressure = probe.reason
+            return None
+        req = Request(
+            rid=int(meta["rid"]), prompt=prompt,
+            max_new_tokens=max_new, eos_id=meta.get("eos_id"),
+            deadline_s=meta.get("deadline_s"),
+            priority=int(meta.get("priority") or 0),
+            retries=int(meta.get("retries") or 0),
+            seq=next(self._seq), submit_ts=meta["submit_ts"])
+        slot = free[0]
+        if not self.engine.adopt_slot(slot, prompt,
+                                      int(meta["first_token"]),
+                                      kpages, vpages,
+                                      reserve_len=target):
+            self.last_backpressure = "blocks"
+            return None
+        req.slot = slot
+        req.tokens = [int(meta["first_token"])]
+        req.first_token_ts = meta.get("first_token_ts")
+        self.running[slot] = req
+        # end-to-end attribution: the decode slot's stats START from
+        # the prefill side's (prefix hits happened over there); _finish
+        # copies them into the terminal record as usual
+        self.engine.slot_stats[slot].update(
+            meta.get("prefill_stats") or {})
+        self.last_backpressure = None
+        if self.tracer is not None:
+            self.tracer.complete("adopt", self._clock() * 1e6,
+                                 flow_step=req.rid, rid=req.rid,
+                                 slot=slot, blocks=meta.get("blocks"))
+        return req
 
     def _maybe_finish(self, slot: int, tok: int) -> None:
         req = self.running[slot]

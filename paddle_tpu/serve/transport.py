@@ -35,6 +35,30 @@ Fault injection rides the messages themselves: the fleet (which owns the
 them — processing the work, then losing or garbling the reply — so the
 drill exercises the real timeout/corrupt/retransmit/dedupe path end to
 end, not a parent-side simulation of it.
+
+**Sockets (ISSUE 18).** The protocol never assumed a pipe — framing,
+timeouts, seq retransmission and the reply cache are all byte-stream
+semantics — so the cross-host promotion is three SEAMS, not a second
+protocol: :func:`listen` / :func:`connect` (plus
+:class:`SocketFrameReader` and the partial-write-safe
+:class:`SocketWriter`) put the SAME frame bytes on a TCP connection,
+``spawn_replica_process(connect=...)`` tells the child to dial the
+parent's listener instead of inheriting pipes, and everything above —
+:class:`ReplicaTransport`, the child's serve loop, heartbeat-file
+health, SIGKILL drills, the fleet's reconcile path — runs unchanged.
+``ServingFleet(replica_mode="socket")`` exercises it over loopback in
+CI; a remote host runs the same child against a reachable address.
+
+**Binary frames (ISSUE 18).** KV-page handoffs must not round-trip
+through base64/JSON, so a second frame kind rides the same stream: the
+length prefix's HIGH BIT marks a raw-bytes payload guarded by a CRC32
+(JSON frames get corruption detection from the parse; raw bytes need
+the checksum to classify a garbled payload as
+:class:`TransportCorrupt` instead of silently adopting garbage KV). A
+JSON message (request OR reply) carrying ``nblobs: k`` is immediately
+followed by ``k`` binary frames — one logical exchange, so the seq
+cache replays reply+blobs together and a retransmitted request resends
+its payload frames with it.
 """
 
 from __future__ import annotations
@@ -44,21 +68,32 @@ import json
 import logging
 import os
 import select
+import socket as socket_lib
 import struct
 import subprocess
 import sys
 import time
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 __all__ = ["TransportError", "TransportTimeout", "TransportCorrupt",
-           "TransportClosed", "encode_frame", "write_frame", "FrameReader",
-           "ReplicaTransport", "spawn_replica_process", "MAX_FRAME_BYTES"]
+           "TransportClosed", "encode_frame", "write_frame",
+           "encode_binary_frame", "write_binary_frame", "FrameReader",
+           "SocketFrameReader", "SocketWriter", "listen", "connect",
+           "accept_connection", "ReplicaTransport",
+           "spawn_replica_process", "MAX_FRAME_BYTES", "BINARY_FLAG"]
 
 _log = logging.getLogger("paddle_tpu.serve.transport")
 
 # a frame longer than this is garbage, not a message (the biggest real
-# frame is a tick reply carrying a few hundred request records)
+# frame is a KV-page handoff blob — a few MB of pages for the mini
+# models; a length beyond this means the stream is desynchronized)
 MAX_FRAME_BYTES = 1 << 24
+
+# the length prefix's high bit marks a BINARY frame: u32 crc32 + raw
+# payload bytes instead of UTF-8 JSON. MAX_FRAME_BYTES (2^24) leaves
+# the bit unambiguous — a JSON frame can never legally set it.
+BINARY_FLAG = 1 << 31
 
 _HEADER = struct.Struct(">I")
 
@@ -116,6 +151,30 @@ def write_frame(fobj, obj: Dict[str, Any]) -> None:
         raise TransportClosed(f"write failed: {e}") from e
 
 
+def encode_binary_frame(payload: bytes) -> bytes:
+    """One raw-bytes message as wire bytes: ``u32 (len+4)|BINARY_FLAG``
+    then ``u32 crc32(payload)`` then the payload verbatim. The checksum
+    is what lets the reader CLASSIFY a garbled payload — JSON frames get
+    that for free from the parse; raw KV pages would otherwise be
+    silently adopted corrupt."""
+    payload = bytes(payload)
+    if len(payload) > MAX_FRAME_BYTES:
+        raise ValueError(f"binary frame too large: {len(payload)} bytes")
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return (_HEADER.pack((len(payload) + 4) | BINARY_FLAG)
+            + _HEADER.pack(crc) + payload)
+
+
+def write_binary_frame(fobj, payload: bytes) -> None:
+    """Write one binary frame and flush. Pipe failures raise
+    :class:`TransportClosed`."""
+    try:
+        fobj.write(encode_binary_frame(payload))
+        fobj.flush()
+    except (BrokenPipeError, OSError, ValueError) as e:
+        raise TransportClosed(f"write failed: {e}") from e
+
+
 class FrameReader:
     """Incremental frame reader over a pipe/socket file object, with an
     optional per-read timeout (``select`` on the fd — a blocking
@@ -126,29 +185,78 @@ class FrameReader:
         self._fd = fobj.fileno()
         self._buf = bytearray()
 
-    def read_frame(self, timeout_s: Optional[float] = None
-                   ) -> Dict[str, Any]:
+    def read_frame(self, timeout_s: Optional[float] = None,
+                   allow_binary: bool = False
+                   ) -> Union[Dict[str, Any], bytes]:
         """Read one frame. Raises :class:`TransportTimeout` when no
         complete frame arrives in ``timeout_s`` (partial bytes stay
         buffered for the next call), :class:`TransportCorrupt` on a
-        garbage length or unparseable body, :class:`TransportClosed` on
-        EOF."""
+        garbage length, unparseable JSON body, or a binary payload
+        failing its CRC, :class:`TransportClosed` on EOF.
+
+        With ``allow_binary`` a frame whose length prefix carries
+        :data:`BINARY_FLAG` is returned as raw ``bytes`` (checksum
+        verified); without it a binary frame is a protocol violation
+        (the caller expected a message) and classifies as corrupt."""
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
-        header = self._read_exact(_HEADER.size, deadline)
-        (n,) = _HEADER.unpack(header)
+        # peek-then-consume: nothing leaves the buffer until the WHOLE
+        # frame is present, so a timeout mid-body is resumable — the
+        # next call re-parses the same header instead of mistaking
+        # body bytes for a length prefix
+        self._fill(_HEADER.size, deadline)
+        (n,) = _HEADER.unpack(bytes(self._buf[:_HEADER.size]))
+        if n & BINARY_FLAG:
+            n &= ~BINARY_FLAG & 0xFFFFFFFF
+            if n < 4 or n - 4 > MAX_FRAME_BYTES:
+                del self._buf[:_HEADER.size]
+                raise TransportCorrupt(f"binary frame length {n} exceeds "
+                                       f"{MAX_FRAME_BYTES}")
+            self._fill(_HEADER.size + n, deadline)
+            body = bytes(self._buf[_HEADER.size:_HEADER.size + n])
+            del self._buf[:_HEADER.size + n]
+            (want,) = _HEADER.unpack(body[:4])
+            payload = body[4:]
+            got = zlib.crc32(payload) & 0xFFFFFFFF
+            if got != want:
+                # a garbled raw payload parses as nothing — the CRC is
+                # the only line between "classified corrupt" and
+                # adopting garbage KV pages into a live pool
+                raise TransportCorrupt(
+                    f"binary frame checksum mismatch "
+                    f"(crc32 {got:#010x} != {want:#010x})")
+            if not allow_binary:
+                raise TransportCorrupt(
+                    "unexpected binary frame (message expected)")
+            return payload
         if n > MAX_FRAME_BYTES:
             # the stream is desynchronized beyond repair once the length
             # field is garbage; classify rather than read 4GB
+            del self._buf[:_HEADER.size]
             raise TransportCorrupt(f"frame length {n} exceeds "
                                    f"{MAX_FRAME_BYTES}")
-        body = self._read_exact(n, deadline)
+        self._fill(_HEADER.size + n, deadline)
+        body = bytes(self._buf[_HEADER.size:_HEADER.size + n])
+        del self._buf[:_HEADER.size + n]
         try:
             return json.loads(body.decode("utf-8"))
         except (UnicodeDecodeError, ValueError) as e:
             raise TransportCorrupt(f"unparseable frame body: {e}") from e
 
-    def _read_exact(self, n: int, deadline: Optional[float]) -> bytes:
+    def read_binary_frame(self, timeout_s: Optional[float] = None
+                          ) -> bytes:
+        """Read one frame that MUST be binary (a declared blob). A JSON
+        frame arriving in a blob slot means the peer and reader disagree
+        about ``nblobs`` — the stream is desynchronized, so classify as
+        corrupt rather than mis-deliver a message as payload."""
+        out = self.read_frame(timeout_s=timeout_s, allow_binary=True)
+        if not isinstance(out, (bytes, bytearray)):
+            raise TransportCorrupt("expected binary frame, got message")
+        return bytes(out)
+
+    def _fill(self, n: int, deadline: Optional[float]) -> None:
+        """Grow the buffer to at least ``n`` bytes WITHOUT consuming —
+        a timeout leaves every byte in place for the next attempt."""
         while len(self._buf) < n:
             if deadline is not None:
                 remaining = deadline - time.monotonic()
@@ -168,9 +276,94 @@ class FrameReader:
             if not chunk:
                 raise TransportClosed("EOF")
             self._buf += chunk
-        out = bytes(self._buf[:n])
-        del self._buf[:n]
-        return out
+
+
+class SocketFrameReader(FrameReader):
+    """:class:`FrameReader` over a connected TCP socket. The base class
+    only needs a ``fileno()`` (``select`` + ``os.read`` work on socket
+    fds on POSIX), so the protocol — framing, timeouts, corruption
+    classification — is inherited byte-for-byte; this subclass exists to
+    name the seam and keep a typed handle on the socket."""
+
+    def __init__(self, sock: socket_lib.socket):
+        self.sock = sock
+        super().__init__(sock)
+
+
+class SocketWriter:
+    """Write half of a socket transport: ``sendall`` under the hood
+    (file-object wrappers over sockets may short-write; a torn frame
+    header desynchronizes the stream permanently). Duck-types the
+    ``write``/``flush``/``close`` surface :func:`write_frame` expects,
+    so the frame writers are shared with the pipe path."""
+
+    def __init__(self, sock: socket_lib.socket):
+        self.sock = sock
+
+    def write(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def listen(host: str = "127.0.0.1", port: int = 0,
+           backlog: int = 16) -> socket_lib.socket:
+    """Open a listening TCP socket for replica connections. Port 0 picks
+    an ephemeral port — read it back via ``getsockname()`` and hand it
+    to the child on argv (``--connect host:port``). Loopback by default
+    (the CI shape); bind a routable address to accept remote hosts."""
+    srv = socket_lib.socket(socket_lib.AF_INET, socket_lib.SOCK_STREAM)
+    srv.setsockopt(socket_lib.SOL_SOCKET, socket_lib.SO_REUSEADDR, 1)
+    srv.bind((host, int(port)))
+    srv.listen(backlog)
+    return srv
+
+
+def accept_connection(srv: socket_lib.socket,
+                      timeout_s: Optional[float] = None
+                      ) -> Tuple[socket_lib.socket, Any]:
+    """Accept one replica connection, bounded by ``timeout_s`` (a child
+    that dies before dialing must not hang the fleet's spawn path —
+    classify as :class:`TransportTimeout` and let the caller reap it).
+    Disables Nagle: frames are latency-sensitive request/reply, and the
+    big KV-page blobs saturate writes on their own."""
+    if timeout_s is not None:
+        ready, _, _ = select.select([srv.fileno()], [], [], timeout_s)
+        if not ready:
+            raise TransportTimeout(
+                f"no replica connection within {timeout_s:.1f}s")
+    sock, addr = srv.accept()
+    sock.setsockopt(socket_lib.IPPROTO_TCP, socket_lib.TCP_NODELAY, 1)
+    return sock, addr
+
+
+def connect(host: str, port: int, timeout_s: float = 30.0,
+            retry_interval_s: float = 0.05) -> socket_lib.socket:
+    """Dial the fleet's listener (child side), retrying connection
+    refusals until ``timeout_s`` — the parent always listens before
+    spawning, but a remote-host child may race a slow accept loop."""
+    deadline = time.monotonic() + float(timeout_s)
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            sock = socket_lib.create_connection(
+                (host, int(port)),
+                timeout=max(0.1, deadline - time.monotonic()))
+            sock.settimeout(None)
+            sock.setsockopt(socket_lib.IPPROTO_TCP,
+                            socket_lib.TCP_NODELAY, 1)
+            return sock
+        except OSError as e:
+            last = e
+            time.sleep(retry_interval_s)
+    raise TransportClosed(f"could not connect to {host}:{port}: {last}")
 
 
 class ReplicaTransport:
@@ -182,7 +375,10 @@ class ReplicaTransport:
     def __init__(self, read_file, write_file, *, proc=None,
                  timeout_s: float = 2.0, max_attempts: int = 3,
                  on_event=None):
-        self._reader = FrameReader(read_file)
+        # a pre-built FrameReader (e.g. SocketFrameReader) passes
+        # through; anything else is assumed to be a readable file/fd
+        self._reader = (read_file if isinstance(read_file, FrameReader)
+                        else FrameReader(read_file))
         self._w = write_file
         self.proc = proc
         self.timeout_s = float(timeout_s)
@@ -212,17 +408,27 @@ class ReplicaTransport:
 
     def request(self, op: str, *, timeout_s: Optional[float] = None,
                 max_attempts: Optional[int] = None,
+                blobs: Optional[List[bytes]] = None,
                 **payload) -> Dict[str, Any]:
         """Send ``{op, seq, **payload}`` and return the matching reply.
         Timeouts and corrupt replies retransmit the same seq up to
         ``max_attempts`` total tries (the child's seq cache makes the
         retry safe); the last classified error raises if every attempt
         fails. A closed pipe raises immediately — retransmitting into a
-        dead process is noise."""
+        dead process is noise.
+
+        ``blobs`` ride as binary frames immediately after the message
+        (which declares them via ``nblobs``); a retransmit resends the
+        message AND its blobs — the exchange is atomic by seq, and the
+        child consumes declared blobs even on a dedupe-replay hit. A
+        reply declaring ``nblobs`` gets its payloads attached as
+        ``reply["blobs"]`` (a list of ``bytes``)."""
         if self.closed:
             raise TransportClosed("transport already closed")
         seq = next(self._seq)
         msg = {"op": op, "seq": seq, **payload}
+        if blobs:
+            msg["nblobs"] = len(blobs)
         attempts = self.max_attempts if max_attempts is None \
             else int(max_attempts)
         wait = self.timeout_s if timeout_s is None else float(timeout_s)
@@ -239,6 +445,8 @@ class ReplicaTransport:
                              op, seq, attempt + 1, last_err)
             try:
                 write_frame(self._w, msg)
+                for b in blobs or ():
+                    write_binary_frame(self._w, b)
                 return self._recv_matching(seq, wait)
             except TransportTimeout as e:
                 self.timeouts += 1
@@ -256,14 +464,27 @@ class ReplicaTransport:
 
     def _recv_matching(self, seq: int, timeout_s: float) -> Dict[str, Any]:
         """Read frames until one carries ``seq`` (stale replies from an
-        earlier timed-out exchange are drained and dropped), bounded by
-        one shared deadline."""
+        earlier timed-out exchange are drained and dropped — including
+        any blobs they declared, which would otherwise desynchronize the
+        stream), bounded by one shared deadline."""
         deadline = time.monotonic() + timeout_s
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TransportTimeout(f"no reply for seq={seq}")
             reply = self._reader.read_frame(timeout_s=remaining)
+            declared = int(reply.get("nblobs") or 0)
+            if declared:
+                reply_blobs = []
+                for _ in range(declared):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TransportTimeout(
+                            f"reply blobs incomplete for seq={seq}")
+                    reply_blobs.append(
+                        self._reader.read_binary_frame(
+                            timeout_s=remaining))
+                reply["blobs"] = reply_blobs
             if reply.get("seq") == seq:
                 return reply
             _log.warning("dropping stale reply seq=%s (awaiting %d)",
@@ -282,13 +503,18 @@ class ReplicaTransport:
 
 
 def spawn_replica_process(spec: Dict[str, Any], *, stderr=None,
-                          env: Optional[Dict[str, str]] = None
+                          env: Optional[Dict[str, str]] = None,
+                          connect: Optional[str] = None
                           ) -> subprocess.Popen:
     """Launch ``python -m paddle_tpu.serve.replica_proc`` with ``spec``
-    on argv, wired for framing: stdin/stdout are the transport (the
+    on argv. In pipe mode (default) stdin/stdout are the transport (the
     child re-points its fd 1 at stderr before any library can print to
-    it). Returns the Popen; wrap its pipes in a
-    :class:`ReplicaTransport`."""
+    it); wrap the Popen's pipes in a :class:`ReplicaTransport`. With
+    ``connect="host:port"`` the child dials the fleet's :func:`listen`
+    socket instead — stdin is devnull and stdout is left for logs, and
+    the caller pairs the Popen with its :func:`accept_connection`
+    arrival. The same child binary serves both; a REMOTE host simply
+    runs it by hand against a routable address."""
     repo_root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     child_env = dict(os.environ if env is None else env)
@@ -296,6 +522,11 @@ def spawn_replica_process(spec: Dict[str, Any], *, stderr=None,
         child_env.get("PYTHONPATH", "")
     cmd = [sys.executable, "-m", "paddle_tpu.serve.replica_proc",
            "--spec", json.dumps(spec)]
+    if connect is not None:
+        cmd += ["--connect", connect]
+        return subprocess.Popen(cmd, stdin=subprocess.DEVNULL,
+                                stdout=stderr, stderr=stderr,
+                                env=child_env)
     return subprocess.Popen(cmd, stdin=subprocess.PIPE,
                             stdout=subprocess.PIPE, stderr=stderr,
                             env=child_env)

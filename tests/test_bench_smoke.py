@@ -246,6 +246,28 @@ def test_bench_smoke_cpu_green_and_equal():
     assert tg["anomaly_bundle"] is True
     assert tg["killed_child_jsonl_survives"] is True
     assert tg["identical_to_uninstrumented"] is True
+    # ISSUE 18: the disaggregation leg — 1 prefill + 2 decode replicas
+    # as SOCKET children on loopback: every request prefills on the
+    # prefill replica, streams its KV pages over TCP as CRC-checked
+    # binary frames, and decodes the greedy oracle's exact tokens; the
+    # wire bytes equal blocks x the analytic per-block size. The
+    # in-process differentials pin the claim: decode tokens/tick holds
+    # within 25% when heavy prefill-only load is added, and int8 KV
+    # crosses the wire quantized (identical tokens to colocated int8,
+    # ~2.7x fewer bytes per block than f32)
+    dg = fl["disagg"]
+    assert dg["ok"] is True, dg
+    assert dg["socket_all_terminal"] is True
+    assert dg["socket_oracle_tokens"] is True
+    assert dg["socket_role_placement"] is True
+    assert dg["socket_wire_bytes_exact"] is True
+    assert dg["socket_handoffs"] >= 6 and dg["socket_wire_bytes"] > 0
+    assert dg["router_ms"]["total"] > 0.0
+    assert dg["decode_isolated_under_prefill_load"] is True
+    assert dg["decode_isolation_ratio"] >= 0.75
+    assert dg["int8_tokens_identical_to_colocated"] is True
+    assert dg["int8_wire_bytes_exact"] is True
+    assert dg["int8_wire_ratio_vs_f32"] == pytest.approx(8 / 3)
     # ISSUE 16: the cold-vs-warm spawn gate ran — two fresh replica
     # children against one cache root. The cold child pays >= 1 autotune
     # trial and misses both persistent caches; the warm child runs ZERO

@@ -228,6 +228,169 @@ def test_transport_gives_up_after_max_attempts(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# binary frames + the blob channel (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def test_binary_frame_roundtrip_and_interleaving():
+    rf, wf = _pipe_pair()
+    payload = bytes(range(256)) * 5
+    tp.write_frame(wf, {"seq": 1, "nblobs": 1})
+    tp.write_binary_frame(wf, payload)
+    tp.write_frame(wf, {"seq": 2})
+    reader = tp.FrameReader(rf)
+    assert reader.read_frame(timeout_s=1.0) == {"seq": 1, "nblobs": 1}
+    assert reader.read_binary_frame(timeout_s=1.0) == payload
+    # the stream stays in sync: the next JSON frame parses normally
+    assert reader.read_frame(timeout_s=1.0) == {"seq": 2}
+    # empty payload is legal (a zero-block handoff edge)
+    tp.write_binary_frame(wf, b"")
+    assert reader.read_binary_frame(timeout_s=1.0) == b""
+    rf.close(), wf.close()
+
+
+def test_binary_frame_corruption_classified_not_desynced():
+    import struct
+    # CRC mismatch: flip a payload byte after encoding
+    rf, wf = _pipe_pair()
+    frame = bytearray(tp.encode_binary_frame(b"hello-kv-pages"))
+    frame[-1] ^= 0xFF
+    wf.write(bytes(frame))
+    tp.write_frame(wf, {"seq": 9})
+    wf.flush()
+    reader = tp.FrameReader(rf)
+    with pytest.raises(tp.TransportCorrupt, match="checksum"):
+        reader.read_binary_frame(timeout_s=1.0)
+    # the WHOLE corrupt frame was consumed — sync survives
+    assert reader.read_frame(timeout_s=1.0) == {"seq": 9}
+    rf.close(), wf.close()
+    # a binary frame where a message was expected is corruption, not
+    # a crash (and vice versa)
+    rf, wf = _pipe_pair()
+    tp.write_binary_frame(wf, b"pages")
+    with pytest.raises(tp.TransportCorrupt, match="unexpected binary"):
+        tp.FrameReader(rf).read_frame(timeout_s=1.0)
+    rf.close(), wf.close()
+    rf, wf = _pipe_pair()
+    tp.write_frame(wf, {"seq": 1})
+    with pytest.raises(tp.TransportCorrupt, match="expected binary"):
+        tp.FrameReader(rf).read_binary_frame(timeout_s=1.0)
+    rf.close(), wf.close()
+    # absurd binary length (flag set, body over the cap)
+    rf, wf = _pipe_pair()
+    wf.write(struct.pack(">I", (tp.MAX_FRAME_BYTES + 5)
+                         | tp.BINARY_FLAG))
+    wf.flush()
+    with pytest.raises(tp.TransportCorrupt):
+        tp.FrameReader(rf).read_binary_frame(timeout_s=1.0)
+    rf.close(), wf.close()
+
+
+def test_binary_frame_truncation_is_timeout_then_closed():
+    # truncated payload, writer still alive: a TIMEOUT (the bytes may
+    # still come) with the partial data buffered — completing the
+    # frame later succeeds
+    rf, wf = _pipe_pair()
+    frame = tp.encode_binary_frame(b"0123456789abcdef")
+    wf.write(frame[:10])
+    wf.flush()
+    reader = tp.FrameReader(rf)
+    with pytest.raises(tp.TransportTimeout):
+        reader.read_binary_frame(timeout_s=0.05)
+    wf.write(frame[10:])
+    wf.flush()
+    assert reader.read_binary_frame(timeout_s=1.0) == b"0123456789abcdef"
+    # truncated payload then EOF: CLOSED (the bytes can never come)
+    rf2, wf2 = _pipe_pair()
+    wf2.write(frame[:10])
+    wf2.flush()
+    wf2.close()
+    with pytest.raises(tp.TransportClosed):
+        tp.FrameReader(rf2).read_binary_frame(timeout_s=1.0)
+    rf.close(), wf.close(), rf2.close()
+
+
+def test_serve_loop_blobs_ride_requests_and_dedupe_replay(tmp_path):
+    """The blob channel end-to-end over the loopback fakes: payloads
+    ride a request (consumed even by ops that refuse), a retransmit
+    resends message + payloads and the child's cached-reply replay
+    still consumes them — the stream NEVER desyncs."""
+    tr, eng, sched, t = _loopback(str(tmp_path))
+    tr.request("hello", now=0.0)
+    # an op the fakes cannot adopt: the rid-unknown adopt path refuses
+    # via the handler-exception classifier, but the blobs were consumed
+    # (next request round-trips cleanly)
+    r = tr.request("adopt", rid=1, meta={"rid": 1}, now=0.1,
+                   blobs=[b"\x00" * 64, b"\x11" * 64])
+    assert r["ok"] is False
+    assert tr.request("tick", now=0.2, tick=0)["ok"]
+    assert sched.steps == 1
+    # lost reply on a blob-carrying request: the retransmit resends the
+    # payloads; the child replays the cached reply and consumes them —
+    # the follow-up tick still parses (sync proof) and no double-work
+    r = tr.request("adopt", rid=2, meta={"rid": 2}, now=0.3,
+                   blobs=[b"\x22" * 32], inject_drop_reply=True)
+    assert r["ok"] is False and tr.retransmits >= 1
+    assert tr.request("tick", now=0.4, tick=1)["ok"]
+    assert sched.steps == 2
+    tr.request("stop")
+    t.join(timeout=5.0)
+    tr.close()
+
+
+def test_socket_transport_same_protocol_over_tcp(tmp_path):
+    """The socket seams (ISSUE 18): listen/connect/accept on loopback,
+    the SAME serve_loop + ReplicaTransport protocol over TCP — dedupe,
+    injected reply loss, and blob payloads all behave exactly as over
+    pipes."""
+    srv = tp.listen()
+    host, port = srv.getsockname()
+    client = tp.connect(host, port, timeout_s=5.0)
+    server_sock, _ = tp.accept_connection(srv, timeout_s=5.0)
+    srv.close()
+    eng, sched = _FakeEngine(), _FakeScheduler()
+    t = threading.Thread(
+        target=serve_loop,
+        args=(tp.SocketFrameReader(server_sock),
+              tp.SocketWriter(server_sock)),
+        kwargs=dict(engine=eng, sched=sched, buf=EventBuffer(),
+                    clock=SettableClock(), root=str(tmp_path),
+                    replica_id=0),
+        daemon=True)
+    t.start()
+    tr = tp.ReplicaTransport(tp.SocketFrameReader(client),
+                             tp.SocketWriter(client), timeout_s=0.5)
+    hello = tr.request("hello", now=0.0)
+    assert hello["ok"] and hello["context_width"] == W
+    reply = tr.request("tick", now=0.1, tick=0, inject_drop_reply=True)
+    assert reply["ok"] and sched.steps == 1
+    assert tr.timeouts == 1 and tr.retransmits == 1
+    a = tr.request("submit", rid=5, prompt=[1, 2], max_new_tokens=3,
+                   now=0.2)
+    b = tr.request("submit", rid=5, prompt=[1, 2], max_new_tokens=3,
+                   now=0.2)
+    assert a["ok"] and not a["duplicate"] and b["duplicate"]
+    r = tr.request("adopt", rid=7, meta={"rid": 7}, now=0.3,
+                   blobs=[b"\x33" * 48])
+    assert r["ok"] is False                 # fakes can't adopt; sync ok
+    assert tr.request("tick", now=0.4, tick=1)["ok"]
+    tr.request("stop")
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    client.close(), server_sock.close()
+
+
+def test_connect_refused_then_accept_timeout_classified():
+    # nobody listening: bounded retry then TransportClosed
+    with pytest.raises(tp.TransportClosed):
+        tp.connect("127.0.0.1", 1, timeout_s=0.2, retry_interval_s=0.05)
+    # nobody dialing: accept classified as TransportTimeout
+    srv = tp.listen()
+    with pytest.raises(tp.TransportTimeout):
+        tp.accept_connection(srv, timeout_s=0.1)
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
 # variables npz round-trip
 # ---------------------------------------------------------------------------
 
