@@ -51,6 +51,7 @@ ratio against the reference's strongest published number where one exists
 import json
 import math
 import os
+import statistics
 import subprocess
 import sys
 import time
@@ -1051,6 +1052,46 @@ def run_smoke(K=4, M=2, timing_passes=3):
     spawn = run_gate_child("--spawn-child")
     spawn_ok = spawn.get("ok") is True
 
+    # perf-regression sentinel self-check (ISSUE 19): a 2-entry
+    # synthetic ledger must pass an in-family NEW record and fail one
+    # with injected regressions in BOTH directions (ms metric up, rate
+    # metric down) — the --compare-history gate, exercised end to end
+    # without a real bench run.
+    hdir = tempfile.mkdtemp(prefix="bench_hist_")
+    ledger = os.path.join(hdir, "LEDGER.jsonl")
+    for ms, rate in ((10.0, 90.0), (10.4, 88.0)):
+        append_history(ledger, {"all_metrics": {
+            "step": {"metric": "step", "value": ms, "unit": "ms/step"},
+            "tput": {"metric": "tput", "value": rate,
+                     "unit": "steps/s"}}})
+    good_p = os.path.join(hdir, "good.json")
+    bad_p = os.path.join(hdir, "bad.json")
+    with open(good_p, "w") as f:
+        json.dump({"all_metrics": {
+            "step": {"metric": "step", "value": 10.3, "unit": "ms/step"},
+            "tput": {"metric": "tput", "value": 89.5,
+                     "unit": "steps/s"}}}, f)
+    with open(bad_p, "w") as f:
+        json.dump({"all_metrics": {
+            "step": {"metric": "step", "value": 13.0, "unit": "ms/step"},
+            "tput": {"metric": "tput", "value": 70.0,
+                     "unit": "steps/s"}}}, f)
+    try:
+        gate_good = compare_history(ledger, good_p, 5.0, window=5)
+        gate_bad = compare_history(ledger, bad_p, 5.0, window=5)
+        history = {
+            "ok": bool(gate_good["ok"] and not gate_bad["ok"]
+                       and set(gate_bad["regressions"])
+                       == {"step", "tput"}
+                       and gate_good["baseline_entries"] == 2),
+            "good_passes": bool(gate_good["ok"]),
+            "bad_regressions": gate_bad["regressions"],
+            "baseline_entries": gate_good["baseline_entries"],
+        }
+    except (OSError, ValueError, KeyError) as e:
+        history = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    history_ok = history.get("ok") is True
+
     out = {
         "metric": "fused_vs_plain_smoke",
         "equal": bool(eq_params and eq_losses),
@@ -1070,6 +1111,7 @@ def run_smoke(K=4, M=2, timing_passes=3):
         "faults": faults,
         "fleet": fleet,
         "spawn": spawn,
+        "history": history,
     }
     print(json.dumps(out))
     ok = (out["equal"] and jsonl_ok
@@ -1077,7 +1119,7 @@ def run_smoke(K=4, M=2, timing_passes=3):
           and pipeline["losses_equal"] and pipeline["overlap_keys_ok"]
           and trace_ok and trace["losses_equal_with_tracer"]
           and attribution_ok and overlap_ok and serving_ok and faults_ok
-          and fleet_ok and spawn_ok)
+          and fleet_ok and spawn_ok and history_ok)
     return 0 if ok else 1
 
 
@@ -1951,23 +1993,30 @@ def run_fleet_child():
         # survivor once replica 0 is SIGKILLed
         f = ServingFleet.from_model(
             model, vs, 2, engine_kwargs=dict(max_slots=2, block_size=4),
-            replica_mode="process", telemetry=Telemetry(sinks=[mem4]),
+            replica_mode="socket", telemetry=Telemetry(sinks=[mem4]),
             clock=clock4, heartbeat_timeout_s=0.55, est_tick_s=0.1,
             faults=faults4, transport_timeout_s=5.0, root=root4,
             trace=instrumented, slo=instrumented, anomaly=anom,
+            metrics=instrumented,
             telemetry_dir=(os.path.join(root4, "child_telemetry")
                            if instrumented else None))
         wl4 = make_workload(8, V, seed=7, rate_rps=30.0,
                             prompt_len=(2, 6), max_new=(3, 8),
                             max_total=W)
+        scrape = None
         try:
             frs4 = f.play(wl4, dt_s=0.1)
+            if instrumented:
+                # remote scrape over the live socket: the survivor
+                # (replica 0 was SIGKILLed) serves its own registry as
+                # text exposition via the `metrics` transport op
+                scrape = f.workers[1].scrape_metrics(clock4())
         finally:
             f.shutdown()
-        return f, frs4, anom, root4
+        return f, frs4, anom, root4, scrape
 
-    fleet_tr, frs_tr, anom4, root_tr = run_obs_drill(True)
-    fleet_dk, frs_dk, _, _ = run_obs_drill(False)
+    fleet_tr, frs_tr, anom4, root_tr, scrape4 = run_obs_drill(True)
+    fleet_dk, frs_dk, _, _, _ = run_obs_drill(False)
 
     trace4 = fleet_tr.fleet_trace()
     trace4 = json.loads(json.dumps(trace4))      # Chrome-parseable
@@ -2000,10 +2049,54 @@ def run_fleet_child():
     tok_dk = {fr.rid: (fr.finish_reason, list(fr.tokens))
               for fr in frs_dk}
     dark_identical = tok_tr == tok_dk
+    # metrics backbone (ISSUE 19): the instrumented socket drill's
+    # merged registry must hold per-link RTT histograms with nonzero
+    # counts for every link (parent-side wire health), per-replica
+    # engine tick histograms absorbed from the children's piggybacked
+    # deltas, and a parseable Prometheus exposition; the dark twin must
+    # carry no registry and — beyond the slo/anomaly blocks the
+    # instrumented run opts into — no new stats keys.
+    from paddle_tpu.obs.metrics import parse_exposition
+    snapm = fleet_tr.metrics.snapshot()
+
+    def _hist_count(name, lkey, lval):
+        return sum(r.get("count") or 0 for r in snapm
+                   if r["name"] == name
+                   and r["labels"].get(lkey) == lval)
+
+    links_ok = all(_hist_count("transport_rtt_ms", "link", l) > 0
+                   for l in ("0", "1"))
+    ticks_ok = all(_hist_count("engine_tick_ms", "replica", r) > 0
+                   for r in ("0", "1"))
+    expo4 = parse_exposition(fleet_tr.metrics.render())
+    expo_ok = (len(expo4["samples"]) > 0
+               and expo4["types"].get("transport_rtt_ms") == "histogram"
+               and expo4["types"].get("fleet_ticks") == "counter")
+    scraped = parse_exposition(scrape4 or "")
+    scrape_ok = (len(scraped["samples"]) > 0
+                 and scraped["types"].get("engine_ticks") == "counter")
+    new_keys = set(stats4) - set(fleet_dk.stats())
+    keys_ok = (new_keys == {"slo", "anomalies"}
+               and fleet_dk.metrics is None)
+    metrics4 = {
+        "ok": bool(links_ok and ticks_ok and expo_ok and scrape_ok
+                   and keys_ok),
+        "remote_scrape_samples": len(scraped["samples"]),
+        "per_link_rtt_counts": {
+            l: _hist_count("transport_rtt_ms", "link", l)
+            for l in ("0", "1")},
+        "per_replica_tick_counts": {
+            r: _hist_count("engine_tick_ms", "replica", r)
+            for r in ("0", "1")},
+        "exposition_samples": len(expo4["samples"]),
+        "new_stats_keys": sorted(new_keys),
+        "registry_rows": len(snapm),
+    }
     tracing = {
         "ok": bool(lanes_ok and resub_flow_ok and slo_ok and bundle_ok
-                   and jsonl_ok and dark_identical
+                   and jsonl_ok and dark_identical and metrics4["ok"]
                    and lane_monotonic(trace4)),
+        "metrics": metrics4,
         "lanes": lanes,
         "resubmitted_rids": retried4,
         "resubmit_flow_connected": bool(resub_flow_ok),
@@ -2636,7 +2729,17 @@ def compare_bench(old_path, new_path, threshold_pct=5.0):
         old = json.load(f)
     with open(new_path) as f:
         new = json.load(f)
-    o_rows, n_rows = _bench_rows(old), _bench_rows(new)
+    rows, regressions = _compare_rows(_bench_rows(old), _bench_rows(new),
+                                      threshold_pct)
+    return {"metric": "bench_compare", "threshold_pct": threshold_pct,
+            "old": old_path, "new": new_path, "rows": rows,
+            "regressions": regressions, "ok": not regressions}
+
+
+def _compare_rows(o_rows, n_rows, threshold_pct=5.0):
+    """The shared old-vs-new diff behind ``--compare`` (two records)
+    and ``--compare-history`` (rolling-median baseline vs one record):
+    unit-derived direction, vanished-metric-is-a-regression."""
     rows, regressions = {}, []
     for m in sorted(set(o_rows) | set(n_rows)):
         o, n = o_rows.get(m), n_rows.get(m)
@@ -2666,8 +2769,70 @@ def compare_bench(old_path, new_path, threshold_pct=5.0):
                               else "improved" if improved else "ok")}
         if worsened:
             regressions.append(m)
-    return {"metric": "bench_compare", "threshold_pct": threshold_pct,
-            "old": old_path, "new": new_path, "rows": rows,
+    return rows, regressions
+
+
+def append_history(ledger_path, doc):
+    """Append one bench record's metric rows to the JSONL perf ledger
+    (``bench.py ... --history LEDGER.jsonl``) — the rolling baseline
+    ``--compare-history`` gates against. One line per run: timestamp +
+    ``{metric: {v, u}}``; any record shape ``_bench_rows`` reads works
+    (full, compact, driver wrapper)."""
+    rows = _bench_rows(doc)
+    rec = {"ts": time.time(),
+           "metrics": {m: {"v": r.get("value"), "u": r.get("unit")}
+                       for m, r in rows.items()
+                       if r.get("value") is not None}}
+    with open(ledger_path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def history_baseline(ledger_path, window=5):
+    """The ledger's rolling baseline: per-metric MEDIAN of the last
+    ``window`` entries (median, not mean — one noisy CI run must not
+    drag the gate), with each metric's most recent unit."""
+    entries = []
+    with open(ledger_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                entries.append(json.loads(line))
+    if not entries:
+        raise ValueError(f"empty perf ledger {ledger_path!r}")
+    tail = entries[-int(window):]
+    rows = {}
+    names = sorted({m for e in tail for m in (e.get("metrics") or {})})
+    for m in names:
+        vals = [e["metrics"][m].get("v") for e in tail
+                if m in (e.get("metrics") or {})
+                and e["metrics"][m].get("v") is not None]
+        if not vals:
+            continue
+        unit = next((e["metrics"][m].get("u") for e in reversed(tail)
+                     if m in (e.get("metrics") or {})), "")
+        rows[m] = {"value": float(statistics.median(vals)),
+                   "unit": unit, "mfu_pct": None}
+    return rows, len(tail)
+
+
+def compare_history(ledger_path, new_path, threshold_pct=5.0, window=5):
+    """The perf-regression sentinel (``bench.py --compare-history
+    LEDGER.jsonl NEW.json``): gate NEW against the ledger's rolling
+    median-of-last-``window`` baseline with the same direction logic as
+    ``--compare``. Nonzero exit on any regression; pass ``--history
+    LEDGER.jsonl`` on the same invocation to append NEW to the ledger
+    after the verdict (gate first, so a regressing run never pollutes
+    its own baseline)."""
+    base_rows, n_hist = history_baseline(ledger_path, window=window)
+    with open(new_path) as f:
+        new = json.load(f)
+    rows, regressions = _compare_rows(base_rows, _bench_rows(new),
+                                      threshold_pct)
+    return {"metric": "bench_compare_history",
+            "threshold_pct": threshold_pct, "window": int(window),
+            "baseline_entries": n_hist, "ledger": ledger_path,
+            "new": new_path, "rows": rows,
             "regressions": regressions, "ok": not regressions}
 
 
@@ -2941,7 +3106,8 @@ _KNOWN_FLAGS = ("--metric", "--child", "--probe", "--n", "--k",
                 "--serving-child", "--faults-child", "--fleet-child",
                 "--spawn-child",
                 "--compare",
-                "--threshold")
+                "--threshold",
+                "--history", "--compare-history", "--window")
 
 
 def main():
@@ -2963,6 +3129,16 @@ def main():
                                    f"known: {list(_KNOWN_FLAGS)}"}))
         sys.exit(2)
 
+    def maybe_append_history(doc):
+        # --history LEDGER.jsonl on any measuring run: append this
+        # run's metric rows to the rolling perf ledger (ISSUE 19)
+        hist = flag("--history")
+        if hist:
+            try:
+                append_history(hist, doc)
+            except OSError as e:
+                sys.stderr.write(f"history append failed: {e}\n")
+
     if "--compare" in args:
         # bench.py --compare OLD.json NEW.json [--threshold PCT]
         i = args.index("--compare")
@@ -2975,6 +3151,34 @@ def main():
                                 flag("--threshold", 5.0, float))
         except (OSError, ValueError) as e:
             print(json.dumps({"metric": "bench_compare",
+                              "error": f"{type(e).__name__}: {e}"}))
+            sys.exit(2)
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
+
+    if "--compare-history" in args:
+        # bench.py --compare-history LEDGER.jsonl NEW.json
+        #          [--threshold PCT] [--window K] [--history LEDGER]
+        # the perf-regression sentinel: NEW vs the ledger's rolling
+        # median-of-last-K baseline; exit 1 on regression. --history
+        # appends NEW to the ledger AFTER the verdict (a regressing run
+        # never pollutes its own baseline).
+        i = args.index("--compare-history")
+        if len(args) < i + 3 or args[i + 1].startswith("--") \
+                or args[i + 2].startswith("--"):
+            print(json.dumps({"error": "--compare-history needs "
+                                       "LEDGER.jsonl NEW.json"}))
+            sys.exit(2)
+        try:
+            out = compare_history(args[i + 1], args[i + 2],
+                                  flag("--threshold", 5.0, float),
+                                  flag("--window", 5, int))
+            hist = flag("--history")
+            if hist:
+                with open(args[i + 2]) as f:
+                    append_history(hist, json.load(f))
+        except (OSError, ValueError, KeyError) as e:
+            print(json.dumps({"metric": "bench_compare_history",
                               "error": f"{type(e).__name__}: {e}"}))
             sys.exit(2)
         print(json.dumps(out))
@@ -3062,6 +3266,7 @@ def main():
             sys.exit(1)
         out["environment"] = probe_environment()
         print(json.dumps(out))
+        maybe_append_history(out)
         return
     if metric is not None and metric not in PREPS:
         print(json.dumps(
@@ -3081,6 +3286,7 @@ def main():
             sys.exit(1)
         out["environment"] = probe_environment()
         print(json.dumps(out))
+        maybe_append_history(out)
         return
 
     # Full driver run: health probe first, then every metric, each via the
@@ -3149,6 +3355,7 @@ def main():
     print(json.dumps(full))
     print(json.dumps(compact_record(results, errors, environment,
                                     sidecar_ok=sidecar_ok)))
+    maybe_append_history(full)
 
 
 SIDECAR_NAME = "BENCH_FULL_r05.json"

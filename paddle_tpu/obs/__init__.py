@@ -68,6 +68,8 @@ from .hloprof import (DCN_BYTES_PER_S, HBM_BANDWIDTH, ICI_BANDWIDTH,
                       collective_inventory, parse_collectives, parse_module)
 from .health import (HEALTH_KEYS, health_scalars, tree_l2_norm,
                      tree_nonfinite_count)
+from .metrics import (Counter, Gauge, Histogram, MetricsHub,
+                      log_buckets, parse_exposition)
 from .percentiles import (GOODPUT_REASONS, P2Quantile, percentile,
                           summarize_handoffs, summarize_requests,
                           summarize_scale)
@@ -92,6 +94,8 @@ __all__ = [
     "percentile", "P2Quantile", "summarize_requests", "summarize_scale",
     "summarize_handoffs", "GOODPUT_REASONS",
     "SLOMonitor", "SLOTargets",
+    "MetricsHub", "Counter", "Gauge", "Histogram", "log_buckets",
+    "parse_exposition",
     "merge_fleet_trace", "save_fleet_trace", "flow_summary",
     "flow_connected", "lane_monotonic",
 ]

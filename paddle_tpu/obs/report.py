@@ -158,9 +158,31 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                       if r.get("kind") == "fleet"), None)
     tev = [r for r in records if r.get("kind") == "transport"]
     transport: Optional[Dict[str, Any]] = None
-    if fleet_rec is not None and fleet_rec.get("transport") is not None:
+    # registry read-through (ISSUE 19 satellite): when the stream
+    # carries a `kind="metrics"` snapshot, the per-link transport_*
+    # counters ARE the totals (incremented at the same sites as the
+    # attribute counters) — sum them across links. The fleet-record /
+    # classified-event paths stay the dark-mode fallbacks.
+    met_rec = next((r for r in reversed(records)
+                    if r.get("kind") == "metrics"), None)
+    if met_rec is not None:
+        tot: Dict[str, int] = {}
+        for row in met_rec.get("metrics") or ():
+            name = row.get("name") or ""
+            if (name.startswith("transport_")
+                    and row.get("type") == "counter"
+                    and name[len("transport_"):] in (
+                        "errors", "retransmits", "timeouts",
+                        "corrupt_replies")):
+                k = name[len("transport_"):]
+                tot[k] = tot.get(k, 0) + int(row.get("value") or 0)
+        if tot:
+            transport = {"errors": 0, "retransmits": 0, "timeouts": 0,
+                         "corrupt_replies": 0, **tot}
+    if transport is None and fleet_rec is not None \
+            and fleet_rec.get("transport") is not None:
         transport = dict(fleet_rec["transport"])
-    elif tev:
+    elif transport is None and tev:
         transport = dict(collections.Counter(
             r.get("event") or "?" for r in tev))
     if transport is not None:

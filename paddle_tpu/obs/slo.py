@@ -77,9 +77,14 @@ class SLOMonitor:
     """
 
     def __init__(self, targets: Optional[SLOTargets] = None,
-                 window: int = 256):
+                 window: int = 256, metrics=None):
         self.targets = targets or SLOTargets()
         self.window = int(window)
+        # optional metrics registry (ISSUE 19, satellite 3): when set
+        # (a MetricsHub or scoped view), every observe() publishes the
+        # rolling percentiles and burn rate as gauges — report() stays
+        # byte-identical either way
+        self.metrics = metrics
         self._est = {m: {p: P2Quantile(p) for p in _PERCENTILES}
                      for m in _METRICS}
         self._recent: collections.deque = collections.deque(
@@ -133,6 +138,17 @@ class SLOMonitor:
             self.good += 1
             self.good_tokens += int(rec.get("new_tokens") or 0)
         self._recent.append(good)
+        if self.metrics is not None:
+            for m in _METRICS:
+                for p in _PERCENTILES:
+                    v = self._est[m][p].value()
+                    if v is not None:
+                        self.metrics.gauge(
+                            f"slo_{m}_p{p}",
+                            f"rolling p{p} {m} (P2 estimate)").set(v)
+            self.metrics.gauge(
+                "slo_burn_rate",
+                "windowed error-budget burn rate").set(self.burn_rate())
 
     # -- readout -----------------------------------------------------------
 

@@ -17,6 +17,13 @@ mode; also handy for cron/CI snapshots)::
 
     python -m paddle_tpu.obs.top --root /tmp/fleet --jsonl tel.jsonl
     python -m paddle_tpu.obs.top --root /tmp/fleet --once
+
+The metrics block (ISSUE 19): when the record stream carries
+``kind="metrics"`` registry snapshots (``fleet.emit_stats()`` with
+``metrics=True``) — or a live :class:`~paddle_tpu.obs.metrics.
+MetricsHub` is passed to :func:`render` — each metric gets one line
+with a unicode sparkline: counters render their per-snapshot RATE,
+gauges their value history, histograms their bucket distribution.
 """
 
 from __future__ import annotations
@@ -24,16 +31,18 @@ from __future__ import annotations
 import argparse
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..parallel import multihost
 from .report import load_records
 from .slo import SLOMonitor, SLOTargets
 
-__all__ = ["render", "main"]
+__all__ = ["render", "main", "sparkline"]
 
 _HB_COLS = ("seq", "queued", "running", "prefilling",
             "pending_new_tokens", "free_blocks", "free_slots")
+
+_SPARK = "▁▂▃▄▅▆▇█"
 
 
 def _fmt(v: Any) -> str:
@@ -44,11 +53,99 @@ def _fmt(v: Any) -> str:
     return str(v)
 
 
+def sparkline(values, width: int = 24) -> str:
+    """Render a numeric series as a unicode sparkline, downsampled to
+    ``width`` points (evenly strided). Constant series render flat at
+    the lowest glyph; empty input renders empty."""
+    vals = [float(v) for v in values if v is not None]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        step = len(vals) / width
+        vals = [vals[int(i * step)] for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[0] * len(vals)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / (hi - lo) * len(_SPARK)))]
+        for v in vals)
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}"
+                          for k, v in sorted(labels.items())) + "}"
+
+
+def _metrics_lines(hub=None,
+                   snapshots: Optional[List[List[Dict[str, Any]]]] = None,
+                   limit: int = 48) -> List[str]:
+    """The metrics block: one ``name{labels}  <spark>  current`` line
+    per labeled child. A live hub supplies ring-buffer history; a list
+    of ``kind="metrics"`` snapshot payloads supplies per-snapshot
+    history (counter lines show successive-difference rates either
+    way; histogram lines show the bucket distribution + count/mean)."""
+    series: "Dict[Tuple[str, Tuple], Dict[str, Any]]" = {}
+    if hub is not None:
+        snapshots = (snapshots or []) + [hub.snapshot()]
+    for snap in snapshots or []:
+        for row in snap:
+            key = (row["name"],
+                   tuple(sorted((row.get("labels") or {}).items())))
+            ent = series.setdefault(
+                key, {"type": row["type"],
+                      "labels": dict(row.get("labels") or {}),
+                      "values": [], "last": None})
+            ent["last"] = row
+            if row["type"] == "histogram":
+                continue
+            ent["values"].append(row.get("value"))
+    if hub is not None:
+        # ring-buffer history beats per-snapshot history when live
+        for (name, lkey), ent in series.items():
+            for q in hub.query(name, **dict(lkey)):
+                if tuple(sorted(q["labels"].items())) == lkey:
+                    vals = [v for _, v in q["samples"]]
+                    if vals:
+                        ent["values"] = vals
+    lines: List[str] = []
+    for (name, _), ent in sorted(series.items()):
+        row = ent["last"]
+        label = name + _label_str(ent["labels"])
+        if ent["type"] == "histogram":
+            counts = row.get("counts") or []
+            cnt = row.get("count") or 0
+            mean = (row["sum"] / cnt) if cnt else None
+            lines.append(f"  {label:<44} {sparkline(counts):<24} "
+                         f"n={cnt} mean={_fmt(mean)}")
+            continue
+        vals = [v for v in ent["values"] if v is not None]
+        if ent["type"] == "counter":
+            # rate: successive differences of the cumulative value
+            deltas = [b - a for a, b in zip(vals, vals[1:])] or vals
+            cur = vals[-1] if vals else None
+            lines.append(f"  {label:<44} {sparkline(deltas):<24} "
+                         f"total={_fmt(cur)}")
+        else:
+            cur = vals[-1] if vals else None
+            lines.append(f"  {label:<44} {sparkline(vals):<24} "
+                         f"now={_fmt(cur)}")
+        if len(lines) >= limit:
+            lines.append(f"  ... ({len(series) - limit} more)")
+            break
+    return lines
+
+
 def render(root: Optional[str] = None, jsonl: Optional[str] = None, *,
            now: Optional[float] = None, window: int = 256,
-           targets: Optional[SLOTargets] = None) -> str:
+           targets: Optional[SLOTargets] = None, hub=None) -> str:
     """One dashboard frame as a string (pure function of the files —
-    what ``--once`` prints and what the test asserts on)."""
+    what ``--once`` prints and what the test asserts on). ``hub``: an
+    optional live :class:`~paddle_tpu.obs.metrics.MetricsHub` to render
+    the metrics block from directly (in-process dashboards/tests); the
+    JSONL's ``kind="metrics"`` snapshots feed it otherwise."""
     now = time.time() if now is None else float(now)
     lines: List[str] = ["== paddle_tpu fleet top =="]
     beats: Dict[int, Dict] = (
@@ -69,6 +166,7 @@ def render(root: Optional[str] = None, jsonl: Optional[str] = None, *,
     else:
         lines.append("  (no heartbeats under "
                      f"{root!r})" if root else "  (no --root given)")
+    snapshots: List[List[Dict[str, Any]]] = []
     if jsonl:
         mon = SLOMonitor(targets=targets, window=window)
         transport = 0
@@ -80,6 +178,9 @@ def render(root: Optional[str] = None, jsonl: Optional[str] = None, *,
             mon.observe(rec)
             if rec.get("kind") == "transport":
                 transport += 1
+            elif (rec.get("kind") == "metrics"
+                    and rec.get("metrics") is not None):
+                snapshots.append(rec["metrics"])
         rep = mon.report()
         lines.append("-- slo (streaming) --")
         lines.append(
@@ -99,6 +200,11 @@ def render(root: Optional[str] = None, jsonl: Optional[str] = None, *,
             reasons = " ".join(f"{k}={v}" for k, v in
                                sorted(rep["finish_reasons"].items()))
             lines.append(f"  finish: {reasons}")
+    if hub is not None or snapshots:
+        mlines = _metrics_lines(hub=hub, snapshots=snapshots)
+        if mlines:
+            lines.append("-- metrics (registry) --")
+            lines.extend(mlines)
     return "\n".join(lines)
 
 

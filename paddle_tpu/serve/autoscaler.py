@@ -284,6 +284,27 @@ class Autoscaler:
         draining = [w for w in fleet.workers if w.state == "draining"]
         sense = self._sense(live, now)
         evidence = {k: v for k, v in sense.items() if k != "by_role"}
+        m = getattr(fleet, "metrics", None)
+        if m is not None:
+            # the controller's sensor readings, published per role
+            # (ISSUE 19): what the policy SAW when it decided — the
+            # dashboard's answer to "why did it scale"
+            for role, s in sense["by_role"].items():
+                sm = m.scoped(role=role)
+                sm.gauge("autoscaler_arrival_rate",
+                         "per-role arrival-work EMA (units/s)"
+                         ).set(s["arrival_rate"])
+                sm.gauge("autoscaler_pending_per_slot",
+                         "backlog per decode lane"
+                         ).set(s["pending_per_slot"])
+                if (s["predicted_delay_s"] is not None
+                        and math.isfinite(s["predicted_delay_s"])):
+                    sm.gauge("autoscaler_predicted_delay_s",
+                             "max(Erlang-C Wq, backlog model)"
+                             ).set(s["predicted_delay_s"])
+            m.gauge("autoscaler_desired_replicas",
+                    "policy's desired fleet total"
+                    ).set(self.desired or 0)
 
         # 1) replacement: heal the envelope before judging load. Healing
         # is not scaling — it ignores the up/down cooldown but pays from

@@ -274,6 +274,11 @@ class DecodeEngine:
         # optional Tracer (ISSUE 17): assigned by the fleet/replica when
         # request tracing is on; None costs one attribute test per tick
         self.tracer = None
+        # optional metrics registry handle (ISSUE 19): assigned by the
+        # fleet (replica-scoped facade) or the replica child (its local
+        # hub) — same contract as tracer, None costs one attribute test
+        self.metrics = None
+        self._metrics_tick_counters: Dict[str, int] = {}
         self.attention = _resolve_attention(attention)
         if speculative < 0:
             raise ValueError(f"speculative must be >= 0, "
@@ -1134,6 +1139,38 @@ class DecodeEngine:
                 "tp_degree": self.tp_degree,
                 **delta,
             })
+        if self.metrics is not None:
+            m = self.metrics
+            m.histogram("engine_tick_ms",
+                        "compiled decode tick wall time (ms)").observe(
+                (time.perf_counter() - t0) * 1e3)
+            m.counter("engine_ticks", "decode ticks executed").inc()
+            m.counter("engine_tokens",
+                      "tokens retired across all slots").inc(tokens_tick)
+            m.gauge("engine_active_slots",
+                    "slots decoding this tick").set(n_active)
+            # KV pool occupancy: reserved fraction of the paged pool
+            m.gauge("engine_kv_free_blocks",
+                    "free blocks in the paged KV pool").set(
+                self.cache.free_blocks)
+            m.gauge("engine_kv_occupancy",
+                    "reserved fraction of the paged KV pool").set(
+                1.0 - self.cache.free_blocks / self.cache.num_blocks)
+            # sharing/speculation counters as per-tick increments, via
+            # a snapshot diff SEPARATE from telemetry's (each consumer
+            # owns its own baseline; sharing one would starve whichever
+            # reads second)
+            snap = {"engine_prefix_hit_blocks":
+                    self.cache.prefix_hit_blocks,
+                    "engine_cow_forks": self.cache.cow_forks,
+                    "engine_prefill_chunks": self.prefill_chunks,
+                    "engine_draft_proposed": self.draft_proposed,
+                    "engine_draft_accepted": self.draft_accepted}
+            for key, val in snap.items():
+                d = val - self._metrics_tick_counters.get(key, 0)
+                if d:
+                    m.counter(key, "cumulative engine counter").inc(d)
+            self._metrics_tick_counters = snap
         return self.tokens.copy()
 
     # -- observability -----------------------------------------------------

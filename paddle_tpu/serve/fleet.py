@@ -78,6 +78,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..obs.fleet_trace import merge_fleet_trace
 from ..obs.fleet_trace import save_fleet_trace as _save_fleet_trace
+from ..obs.metrics import MetricsHub
 from ..obs.slo import SLOMonitor
 from ..obs.trace import Tracer
 from ..parallel import multihost
@@ -232,6 +233,12 @@ class ReplicaWorker:
         if self.tracer is None:
             return []
         return self.tracer.drain_events()
+
+    def drain_metrics(self) -> List[Dict[str, Any]]:
+        """Registry deltas to absorb at fleet level — always empty
+        in-process: the engine/scheduler write the parent hub directly
+        through their ``replica=<i>``-scoped handles (ISSUE 19)."""
+        return []
 
     # -- liveness ----------------------------------------------------------
 
@@ -485,6 +492,9 @@ class ProcReplicaWorker:
         # trace events shipped piggybacked on tick replies (ISSUE 17),
         # buffered here until the fleet's per-tick span drain
         self._spans: List[Dict[str, Any]] = []
+        # registry deltas shipped the same way (ISSUE 19), buffered
+        # until the fleet's per-tick absorb sweep
+        self._metrics_deltas: List[Dict[str, Any]] = []
         # KV-page handoff packages shipped on tick replies (ISSUE 18),
         # buffered until the fleet's per-tick handoff sweep
         self._handoffs: List[Dict[str, Any]] = []
@@ -529,6 +539,12 @@ class ProcReplicaWorker:
 
     def _transport_error(self, op: str, err) -> None:
         self.transport_errors += 1
+        m = self.transport.metrics
+        if m is not None:
+            # same site as the attribute counter, so the registry and
+            # fleet.stats() totals agree by construction (satellite 2)
+            m.counter("transport_errors",
+                      "exhausted-retry transport failures").inc()
         kind = getattr(err, "kind", "error")
         _log.warning("replica %d transport %s on %s: %s",
                      self.replica_id, kind, op, err)
@@ -661,6 +677,9 @@ class ProcReplicaWorker:
         sp = reply.get("spans")
         if sp:
             self._spans.extend(sp)
+        md = reply.get("metrics")
+        if md:
+            self._metrics_deltas.extend(md)
         for item in reply.get("completed") or ():
             rec = item.get("record") or {}
             rid = rec.get("rid")
@@ -779,6 +798,30 @@ class ProcReplicaWorker:
         sp, self._spans = self._spans, []
         return sp
 
+    def drain_metrics(self) -> List[Dict[str, Any]]:
+        """Pop the child's shipped registry deltas (no transport round
+        — they already rode the tick replies; deltas a SIGKILL ate
+        simply never land here)."""
+        md, self._metrics_deltas = self._metrics_deltas, []
+        return md
+
+    def scrape_metrics(self, now: float) -> Optional[str]:
+        """One ``metrics`` op round-trip: the child's full registry as
+        Prometheus text exposition. A READ, not a drain — the tick-
+        reply delta watermarks are untouched. None when the link is
+        down or the child has no registry."""
+        if (self.transport_down or self.transport.closed
+                or self.killed or self.state in ("dead", "released")):
+            return None
+        try:
+            reply = self.transport.request("metrics", now=now)
+        except transport_lib.TransportError as e:
+            self._transport_error("metrics", e)
+            return None
+        if not reply.get("ok"):
+            return None
+        return reply.get("exposition")
+
 
 class ServingFleet:
     """N replica workers + a router + the recovery loop (see module
@@ -842,7 +885,8 @@ class ServingFleet:
                  transport_timeout_s: float = 2.0,
                  spawn_timeout_s: float = 300.0,
                  autoscaler=None, trace: bool = False, slo=None,
-                 anomaly=None, roles: Optional[List[str]] = None):
+                 anomaly=None, roles: Optional[List[str]] = None,
+                 metrics=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         if replica_mode not in ("inprocess", "process", "socket"):
@@ -894,7 +938,20 @@ class ServingFleet:
         if self.tracer is not None and replica_mode in ("process",
                                                         "socket"):
             self._proc_spec["trace"] = True
+        # metrics registry (ISSUE 19) — same doctrine as the tracer:
+        # built BEFORE the spawn loop (process replicas read the spec's
+        # "metrics" key at build; in-process workers take scoped
+        # handles at construction), default-off, byte-identical dark.
+        self.metrics = (MetricsHub(clock=self.clock) if metrics is True
+                        else (metrics or None))
+        if (self.metrics is not None
+                and replica_mode in ("process", "socket")):
+            self._proc_spec["metrics"] = True
         self.slo = SLOMonitor() if slo is True else (slo or None)
+        if self.slo is not None and self.metrics is not None:
+            # the SLO monitor publishes its rolling percentiles and
+            # burn rate as gauges into the same registry (satellite 3)
+            self.slo.metrics = self.metrics
         self.anomaly = anomaly
         self.workers: List[Any] = []
         for i in range(n_replicas):       # Popen-spawn (or build) all…
@@ -970,18 +1027,31 @@ class ServingFleet:
                 w.transport.on_event = (
                     lambda event, op, _r=i: self.tracer.instant(
                         f"transport_{event}", replica=_r, op=op))
+            if self.metrics is not None:
+                # per-LINK wire health (bytes/frames/RTT/failures) is a
+                # parent-side property of the connection — the child
+                # can't measure its own reply loss any more than it can
+                # see its own SIGKILL
+                w.transport.metrics = self.metrics.scoped(link=str(i))
         else:
             eng = self.make_engine(i)
             wtr = (Tracer(clock=self.clock)
                    if self.tracer is not None else None)
+            mets = (self.metrics.scoped(replica=str(i))
+                    if self.metrics is not None else None)
             sched = ContinuousBatchingScheduler(
                 eng, telemetry=self.telemetry, order=self.order,
                 shed=False, est_tick_s=self.est_tick_s, clock=self.clock,
-                tracer=wtr, role=role)
+                tracer=wtr, role=role, metrics=mets)
             w = ReplicaWorker(i, eng, sched, self.root, role=role)
             if wtr is not None:
                 eng.tracer = wtr
                 w.tracer = wtr
+            if mets is not None:
+                # in-process replicas write the parent hub directly
+                # through a replica=<i>-scoped view — the same label
+                # namespace absorb_delta gives a process replica
+                eng.metrics = mets
         self.workers.append(w)
         return w
 
@@ -1081,6 +1151,14 @@ class ServingFleet:
         # step — prompt tokens are prefill work, new tokens decode work
         self.arrived_prompt_tokens += len(fr.prompt)
         self.arrived_new_tokens += max_new_tokens
+        if self.metrics is not None:
+            m = self.metrics
+            m.counter("fleet_requests_submitted",
+                      "requests routed into the fleet").inc()
+            m.counter("fleet_arrived_prompt_tokens",
+                      "prefill work arrived").inc(len(fr.prompt))
+            m.counter("fleet_arrived_new_tokens",
+                      "decode work arrived").inc(max_new_tokens)
         t0 = self.tracer.now_us() if self.tracer is not None else None
         _w0 = time.perf_counter()
         dec = self.router.route(
@@ -1139,6 +1217,9 @@ class ServingFleet:
 
     def _shed(self, fr: FleetRequest, dec) -> None:
         self.shed_count += 1
+        if self.metrics is not None:
+            self.metrics.counter("fleet_shed",
+                                 "requests shed at submit").inc()
         fr.record = self._terminal_record(
             fr, "shed", fr.submit_ts,        # shed at submit: wall 0
             shed_reason=dec.shed_reason,
@@ -1156,6 +1237,11 @@ class ServingFleet:
             fr.local.finish_reason = "retried"
             self._emit(fr.local.record())
         self.resubmits += 1
+        if self.metrics is not None:
+            self.metrics.counter(
+                "fleet_resubmits",
+                "orphaned requests re-homed by the reconcile sweep",
+                reason=reason).inc()
         fr.retries += 1
         fr.local, fr.replica = None, None
         if self.tracer is not None:
@@ -1416,6 +1502,16 @@ class ServingFleet:
                 sp = w.drain_spans()
                 if sp:
                     self._replica_spans[w.replica_id].extend(sp)
+        if self.metrics is not None:
+            # absorb the registry deltas that rode this tick's replies,
+            # namespaced per replica — the metrics twin of the span
+            # drain above (in-process workers return [] here; they
+            # already wrote the hub directly)
+            for w in self.workers:
+                d = w.drain_metrics()
+                if d:
+                    self.metrics.absorb_delta(
+                        d, replica=str(w.replica_id))
         if self.anomaly is not None:
             for w in self.workers:
                 if w.killed or w.state in ("dead", "released"):
@@ -1439,6 +1535,21 @@ class ServingFleet:
                     free_blocks=w.engine.cache.free_blocks)
         self._router_tick_s.append(self._router_cur_s)
         self._router_cur_s = 0.0
+        if self.metrics is not None:
+            m = self.metrics
+            m.counter("fleet_ticks", "fleet heartbeats").inc()
+            m.gauge("fleet_active_requests",
+                    "non-terminal requests in flight"
+                    ).set(len(self._active))
+            m.gauge("fleet_unplaced",
+                    "parked requests awaiting capacity"
+                    ).set(len(self._unplaced))
+            m.gauge("fleet_pending_handoffs",
+                    "KV packages in the fleet-owned handoff ledger"
+                    ).set(len(self._pending_handoffs))
+            m.histogram("fleet_router_ms",
+                        "host-side placement cost per fleet tick (ms)"
+                        ).observe(self._router_tick_s[-1] * 1000.0)
         self.ticks += 1
 
     def outstanding(self) -> bool:
@@ -1526,9 +1637,21 @@ class ServingFleet:
 
     def _transport_totals(self) -> Dict[str, int]:
         """Fleet-wide transport failure counters summed over process
-        replicas (all zeros for an in-process fleet)."""
+        replicas (all zeros for an in-process fleet). With the registry
+        on, the totals READ THROUGH it (satellite 2) — the per-link
+        counters are incremented at the exact sites the attribute
+        counters are, so both paths agree; the attribute fallback stays
+        the dark-mode source of truth."""
         tot = {"errors": 0, "retransmits": 0, "timeouts": 0,
                "corrupt_replies": 0}
+        if self.metrics is not None:
+            for row in self.metrics.snapshot():
+                name = row["name"]
+                if (name.startswith("transport_")
+                        and row["type"] == "counter"
+                        and name[len("transport_"):] in tot):
+                    tot[name[len("transport_"):]] += int(row["value"])
+            return tot
         for w in self.workers:
             ts = w.transport_stats()
             if ts:
@@ -1550,6 +1673,12 @@ class ServingFleet:
         if self.slo is not None:
             rec["slo"] = self.slo.report()
         self._emit(rec)
+        if self.metrics is not None:
+            # the registry rides the telemetry stream as its own record
+            # kind — obs.report/obs.top read it back offline without a
+            # live hub (the fleet record's schema is untouched)
+            self._emit({"kind": "metrics", "tick": self.ticks,
+                        "metrics": self.metrics.snapshot()})
         return rec
 
     # -- reporting ---------------------------------------------------------

@@ -241,17 +241,27 @@ def _build(spec: Dict[str, Any]):
         from ..obs.trace import Tracer
         tracer = Tracer(clock=clock)
         engine.tracer = tracer
+    metrics = None
+    if spec.get("metrics"):
+        # fleet metrics (ISSUE 19): the child grows its OWN registry,
+        # stamped by the same message-carried fleet clock; deltas ship
+        # on tick replies (the span-batch move) and the parent merges
+        # them under a replica=<id> label
+        from ..obs.metrics import MetricsHub
+        metrics = MetricsHub(clock=clock)
+        engine.metrics = metrics
     sched = ContinuousBatchingScheduler(
         engine, telemetry=buf, order=spec.get("order", "fcfs"),
         shed=False, est_tick_s=spec.get("est_tick_s"), clock=clock,
-        tracer=tracer, role=spec.get("role", "both"))
-    return engine, sched, buf, clock, startup
+        tracer=tracer, role=spec.get("role", "both"), metrics=metrics)
+    return engine, sched, buf, clock, startup, metrics
 
 
 def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
                root: str, replica_id: int,
                reply_cache_size: int = 16,
-               startup: Optional[Dict[str, Any]] = None) -> int:
+               startup: Optional[Dict[str, Any]] = None,
+               metrics=None) -> int:
     """The child's message loop (transport-layer concerns only — the
     handler logic is inline because it IS the replica). Returns the exit
     code; EOF on stdin is a clean shutdown (the parent died or closed
@@ -400,6 +410,14 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
                 # work already uses (no side-channel files; a SIGKILL
                 # loses at most one tick's worth)
                 reply["spans"] = tracer.drain_events()
+            if metrics is not None:
+                # registry deltas piggyback the same way: whatever
+                # changed since the last drain rides this reply, and a
+                # batch undelivered at SIGKILL honestly dies with the
+                # process (the drained watermark died too)
+                deltas = metrics.drain_delta()
+                if deltas:
+                    reply["metrics"] = deltas
             return reply
         if op == "drain":
             draining = True
@@ -415,6 +433,14 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
             # replica is live again and must admit
             draining = False
             return {"ok": True, "load": load_report()}
+        if op == "metrics":
+            # remote scrape (ISSUE 19): the full local registry as
+            # Prometheus text. A read, not a drain — the tick-reply
+            # delta watermarks are untouched, so scraping never steals
+            # increments from the parent's merge.
+            if metrics is None:
+                return {"ok": False, "error": "metrics not enabled"}
+            return {"ok": True, "exposition": metrics.render()}
         if op == "stats":
             return {"ok": True, "load": load_report(),
                     "compile_counts": engine.compile_counts(),
@@ -539,11 +565,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         with open(raw[1:]) as f:
             raw = f.read()
     spec = json.loads(raw)
-    engine, sched, buf, clock, startup = _build(spec)
+    engine, sched, buf, clock, startup, metrics = _build(spec)
     return serve_loop(
         read_file, out, engine=engine, sched=sched, buf=buf,
         clock=clock, root=spec["root"],
-        replica_id=int(spec["replica_id"]), startup=startup)
+        replica_id=int(spec["replica_id"]), startup=startup,
+        metrics=metrics)
 
 
 if __name__ == "__main__":

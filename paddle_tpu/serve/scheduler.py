@@ -161,7 +161,7 @@ class ContinuousBatchingScheduler:
                  order: str = "fcfs", shed: bool = False,
                  est_tick_s: Optional[float] = None,
                  clock=time.perf_counter, tracer=None,
-                 role: str = "both"):
+                 role: str = "both", metrics=None):
         if policy not in ("continuous", "static"):
             raise ValueError(f"policy must be 'continuous'|'static', "
                              f"got {policy!r}")
@@ -179,6 +179,12 @@ class ContinuousBatchingScheduler:
         # chunks, and decode ticks across replicas. None = zero
         # overhead (every call site guards on it).
         self.tracer = tracer
+        # typed metrics registry handle (ISSUE 19): a MetricsHub or a
+        # replica-scoped facade. Queue-depth gauges per step, one
+        # labeled finished counter per terminal reason (shed included),
+        # one eviction counter per deadline sweep hit. Same contract as
+        # tracer: None = zero overhead.
+        self.metrics = metrics
         self.policy = policy
         self.order = order
         # prefill/decode disaggregation (ISSUE 18): a "prefill"-role
@@ -325,6 +331,11 @@ class ContinuousBatchingScheduler:
             self.prefilling.pop(slot, None)
             self.engine.evict(slot)            # blocks back to the pool
         self.completed.append(req)
+        if self.metrics is not None:
+            self.metrics.counter(
+                "sched_requests_finished",
+                "terminal requests by finish reason",
+                reason=reason).inc()
         if self.tracer is not None:
             self.tracer.complete("finish", req.finish_ts * 1e6,
                                  flow_step=req.rid, rid=req.rid,
@@ -339,6 +350,11 @@ class ContinuousBatchingScheduler:
         running-slot case and the queued-drop case are visible (ISSUE 11
         satellite: a queued request dying of backpressure starvation must
         show up in telemetry, not just slot evictions)."""
+        if self.metrics is not None:
+            self.metrics.counter(
+                "sched_evictions",
+                "deadline evictions by where the request was caught",
+                where=where).inc()
         if self.telemetry is not None:
             self.telemetry.emit_event({
                 "kind": "evict", "rid": req.rid, "where": where,
@@ -595,6 +611,16 @@ class ContinuousBatchingScheduler:
                 self.tracer.complete("decode_tick", t0,
                                      self.tracer.now_us(),
                                      active=active, tokens=n_tok)
+        if self.metrics is not None:
+            self.metrics.gauge("sched_queue_depth",
+                               "requests queued for admission").set(
+                len(self.queue))
+            self.metrics.gauge("sched_running",
+                               "requests holding a decode slot").set(
+                len(self.running))
+            self.metrics.gauge("sched_prefilling",
+                               "slots mid chunked prefill").set(
+                len(self.prefilling))
         self._was_busy = bool(self.queue or self.running
                               or self.prefilling)
         return self._was_busy

@@ -141,12 +141,15 @@ def encode_frame(obj: Dict[str, Any]) -> bytes:
     return _HEADER.pack(len(body)) + body
 
 
-def write_frame(fobj, obj: Dict[str, Any]) -> None:
-    """Write one frame and flush. Pipe failures raise
-    :class:`TransportClosed`."""
+def write_frame(fobj, obj: Dict[str, Any]) -> int:
+    """Write one frame and flush; returns the wire byte count (the
+    transport's tx-bytes metric wants the exact framed size, not the
+    payload estimate). Pipe failures raise :class:`TransportClosed`."""
     try:
-        fobj.write(encode_frame(obj))
+        frame = encode_frame(obj)
+        fobj.write(frame)
         fobj.flush()
+        return len(frame)
     except (BrokenPipeError, OSError, ValueError) as e:
         raise TransportClosed(f"write failed: {e}") from e
 
@@ -165,12 +168,14 @@ def encode_binary_frame(payload: bytes) -> bytes:
             + _HEADER.pack(crc) + payload)
 
 
-def write_binary_frame(fobj, payload: bytes) -> None:
-    """Write one binary frame and flush. Pipe failures raise
-    :class:`TransportClosed`."""
+def write_binary_frame(fobj, payload: bytes) -> int:
+    """Write one binary frame and flush; returns the wire byte count.
+    Pipe failures raise :class:`TransportClosed`."""
     try:
-        fobj.write(encode_binary_frame(payload))
+        frame = encode_binary_frame(payload)
+        fobj.write(frame)
         fobj.flush()
+        return len(frame)
     except (BrokenPipeError, OSError, ValueError) as e:
         raise TransportClosed(f"write failed: {e}") from e
 
@@ -184,6 +189,11 @@ class FrameReader:
         self._f = fobj
         self._fd = fobj.fileno()
         self._buf = bytearray()
+        # cumulative wire bytes consumed as COMPLETE frames (header
+        # included) — the transport's rx-bytes metric reads deltas of
+        # this; partial/buffered bytes don't count until the frame does
+        self.bytes_read = 0
+        self.frames_read = 0
 
     def read_frame(self, timeout_s: Optional[float] = None,
                    allow_binary: bool = False
@@ -215,6 +225,8 @@ class FrameReader:
             self._fill(_HEADER.size + n, deadline)
             body = bytes(self._buf[_HEADER.size:_HEADER.size + n])
             del self._buf[:_HEADER.size + n]
+            self.bytes_read += _HEADER.size + n
+            self.frames_read += 1
             (want,) = _HEADER.unpack(body[:4])
             payload = body[4:]
             got = zlib.crc32(payload) & 0xFFFFFFFF
@@ -238,6 +250,8 @@ class FrameReader:
         self._fill(_HEADER.size + n, deadline)
         body = bytes(self._buf[_HEADER.size:_HEADER.size + n])
         del self._buf[:_HEADER.size + n]
+        self.bytes_read += _HEADER.size + n
+        self.frames_read += 1
         try:
             return json.loads(body.decode("utf-8"))
         except (UnicodeDecodeError, ValueError) as e:
@@ -374,7 +388,7 @@ class ReplicaTransport:
 
     def __init__(self, read_file, write_file, *, proc=None,
                  timeout_s: float = 2.0, max_attempts: int = 3,
-                 on_event=None):
+                 on_event=None, metrics=None):
         # a pre-built FrameReader (e.g. SocketFrameReader) passes
         # through; anything else is assumed to be a readable file/fd
         self._reader = (read_file if isinstance(read_file, FrameReader)
@@ -394,6 +408,15 @@ class ReplicaTransport:
         # Best-effort by contract: an observer bug must never turn
         # into a transport failure.
         self.on_event = on_event
+        # optional metrics registry handle (ISSUE 19): a MetricsHub or
+        # a link-scoped facade (the fleet passes scoped(link=<id>)).
+        # When set, every classified failure increments a registry
+        # counter AT THE SAME SITE as the attribute counter (so the
+        # stats()/registry totals are equal by construction), wire
+        # bytes/frames are counted exactly, and each successful
+        # request/reply round trip lands in a per-link RTT histogram.
+        # None = the attribute counters alone, unchanged.
+        self.metrics = metrics
 
     def _notify(self, event: str, op: str) -> None:
         if self.on_event is not None:
@@ -433,9 +456,14 @@ class ReplicaTransport:
             else int(max_attempts)
         wait = self.timeout_s if timeout_s is None else float(timeout_s)
         last_err: Optional[TransportError] = None
+        m = self.metrics
         for attempt in range(max(1, attempts)):
             if attempt:
                 self.retransmits += 1
+                if m is not None:
+                    m.counter("transport_retransmits",
+                              "same-seq retries after a classified "
+                              "delivery failure").inc()
                 self._notify("retransmit", op)
                 # the injected fault was the DELIVERY, not the work:
                 # the retransmit asks for the cached reply, clean
@@ -444,16 +472,46 @@ class ReplicaTransport:
                 _log.warning("retransmitting %s seq=%d (attempt %d: %s)",
                              op, seq, attempt + 1, last_err)
             try:
-                write_frame(self._w, msg)
+                t0 = time.monotonic()
+                rx0 = self._reader.bytes_read
+                fx0 = self._reader.frames_read
+                sent = write_frame(self._w, msg)
+                nframes = 1
                 for b in blobs or ():
-                    write_binary_frame(self._w, b)
-                return self._recv_matching(seq, wait)
+                    sent += write_binary_frame(self._w, b)
+                    nframes += 1
+                reply = self._recv_matching(seq, wait)
+                if m is not None:
+                    m.counter("transport_bytes_out",
+                              "framed request bytes written").inc(sent)
+                    m.counter("transport_frames_out",
+                              "request frames written").inc(nframes)
+                    m.counter("transport_bytes_in",
+                              "framed reply bytes consumed").inc(
+                        self._reader.bytes_read - rx0)
+                    m.counter("transport_frames_in",
+                              "reply frames consumed").inc(
+                        self._reader.frames_read - fx0)
+                    # per-connection wire health: the write→matching-
+                    # reply round trip, host wall time (real seconds —
+                    # RTT is a wire property, not a SimClock one)
+                    m.histogram("transport_rtt_ms",
+                                "request round-trip time (ms)").observe(
+                        (time.monotonic() - t0) * 1e3)
+                return reply
             except TransportTimeout as e:
                 self.timeouts += 1
+                if m is not None:
+                    m.counter("transport_timeouts",
+                              "requests with no matching reply in "
+                              "time").inc()
                 self._notify("timeout", op)
                 last_err = e
             except TransportCorrupt as e:
                 self.corrupt_replies += 1
+                if m is not None:
+                    m.counter("transport_corrupt_replies",
+                              "replies classified corrupt").inc()
                 self._notify("corrupt", op)
                 last_err = e
             except TransportClosed:

@@ -249,7 +249,7 @@ class Trainer:
                  steps_per_call: int = 1, grad_accum: int = 1,
                  grad_sync: Optional[str] = None, bucket_mb: float = 4.0,
                  pipeline_depth: int = 1, telemetry=None, tracer=None,
-                 anomaly=None, faults=None):
+                 anomaly=None, faults=None, metrics=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -309,6 +309,11 @@ class Trainer:
                 "AnomalyDetector consumes telemetry step records — pass "
                 "telemetry=Telemetry(...) alongside anomaly=")
         self.anomaly = anomaly
+        # metrics: optional MetricsHub / scoped view (ISSUE 19) — the
+        # trainer publishes a step-time histogram and a tokens/sec
+        # gauge from each finalized step record. Same None doctrine as
+        # telemetry/tracer: off means the hot loop is untouched.
+        self.metrics = metrics
         # faults: None = the exact pre-faults hot loop (every injection
         # point is behind a host-side `is not None` check — no traced-step
         # or dispatch-count change; pinned by tests/test_resilience.py).
@@ -394,7 +399,26 @@ class Trainer:
         run it watches, so failures log and training continues. Verdicts
         are echoed into the telemetry stream as ``kind="anomaly"``
         records (ISSUE 6: the run's JSONL is self-contained — the report
-        CLI counts anomalies without reading bundle directories)."""
+        CLI counts anomalies without reading bundle directories). The
+        metrics registry (ISSUE 19) feeds from the same finalized
+        records — every emit_step site already flows through here."""
+        if (self.metrics is not None and rec is not None
+                and rec.get("kind") == "step"):
+            m = self.metrics
+            k = rec.get("k_steps") or 1
+            m.counter("train_steps", "optimizer steps completed").inc(k)
+            total_ms = ((rec.get("device_ms") or 0.0)
+                        + (rec.get("dispatch_ms") or 0.0))
+            if total_ms > 0:
+                m.histogram("train_step_ms",
+                            "per-step wall (device+dispatch) ms"
+                            ).observe(total_ms / k)
+            if rec.get("tokens_per_sec") is not None:
+                m.gauge("train_tokens_per_sec",
+                        "training token throughput"
+                        ).set(rec["tokens_per_sec"])
+            if rec.get("loss") is not None:
+                m.gauge("train_loss", "last step loss").set(rec["loss"])
         if self.anomaly is None or rec is None:
             return
         try:

@@ -1582,8 +1582,9 @@ def test_proc_spec_ships_mesh_and_single_device_roundtrip(
                             mesh_axes={"model": 2})
     assert meshy["mesh"] == {"model": 2}
     assert {k: v for k, v in meshy.items() if k != "mesh"} == plain
-    eng, sched, buf, clock, startup = replica_proc._build(
+    eng, sched, buf, clock, startup, metrics = replica_proc._build(
         dict(meshy, engine={"max_slots": 2, "block_size": BS}))
+    assert metrics is None              # absent spec key = no registry
     assert eng.tp_degree == 2
     assert eng.cache.kv_bytes_per_token * 2 == 512      # per-shard
     # ISSUE 16: startup breakdown exists even with warmup off — the
