@@ -1799,6 +1799,16 @@ def run_fleet_child():
       tokens/tick within 25% when heavy prefill-only load is added) and
       the int8 path (identical tokens to colocated int8, ~2.7x fewer
       wire bytes per block than f32).
+    - **chaos drill** (ISSUE 20): the disagg socket fleet again, under
+      a seeded :class:`NetworkChaos` plane — an asymmetric partition
+      cuts the prefill replica's reply direction (false death → fence
+      by epoch → disagg degrades to colocated prefill on the decoders)
+      and a one-shot link flap fences a decode replica. Asserts every
+      request terminal with oracle tokens and a single lineage, zero
+      tokens from any fenced epoch, both zombies re-admitted on heal,
+      the degradation engaged AND released, survivors leak-free, and
+      the chaos fleet's ``stats()`` keyset differing from the chaos-off
+      socket fleet's (leg 5a — the dark twin) by exactly ``{"chaos"}``.
 
     Prints the verdict as one JSON line."""
     import collections
@@ -2245,10 +2255,105 @@ def run_fleet_child():
         "int8_wire_ratio_vs_f32": quant_wire_ratio,
     }
 
+    # -- leg 6: partition + flap chaos gate (ISSUE 20). The leg-5a
+    # disagg socket fleet re-run under a seeded NetworkChaos plane:
+    # link 0 (the only prefill) loses its REPLY direction for two fleet
+    # seconds — the asymmetric partition: the child hears every frame,
+    # the parent hears nothing — which manufactures a false death,
+    # an epoch fence, and the disagg→colocated degradation; link 2
+    # takes a single flap window that drops one tick exchange outright
+    # and fences a decode replica the same way. Both zombies must be
+    # re-admitted on heal having generated ZERO tokens under their
+    # fenced epochs, every rid must keep exactly one terminal record
+    # with oracle tokens, and the chaos-off leg-5a fleet is the dark
+    # twin: same stats schema plus exactly the "chaos" ledger.
+    from paddle_tpu.serve import LinkChaos, NetworkChaos
+    chaos_plane = NetworkChaos(20, links={
+        0: LinkChaos(partitions=[(0.25, 2.5, "recv")]),
+        2: LinkChaos(flap=(50.0, 0.12, 0.9))})
+    mem6 = InMemorySink()
+    fleet6 = ServingFleet.from_model(
+        model, vs, 3, engine_kwargs=dict(max_slots=2, block_size=4),
+        replica_mode="socket", roles=["prefill", "decode", "decode"],
+        chaos=chaos_plane, clock=SimClock(),
+        heartbeat_timeout_s=0.25, est_tick_s=0.1, warmup=True,
+        transport_timeout_s=0.75, readmit_grace_s=100.0,
+        telemetry=Telemetry(sinks=[mem6]),
+        root=tempfile.mkdtemp(prefix="paddle_tpu_fleet_chaos_"))
+    rng6 = np.random.RandomState(6)
+    try:
+        frs6 = [fleet6.submit(list(rng6.randint(1, V, int(p))), 8)
+                for p in rng6.randint(2, 8, 6)]
+        late6 = []
+        for _ in range(400):
+            if not late6 and fleet6.clock() >= 1.5:
+                # mid-degradation arrivals: routed straight to the
+                # colocated decode path, no prefill replica alive
+                late6 = [fleet6.submit(list(rng6.randint(1, V, 4)), 6)
+                         for _ in range(2)]
+            if (not fleet6.outstanding()
+                    and fleet6.readmitted >= fleet6.fences
+                    and not fleet6.degraded):
+                break
+            fleet6.tick()
+            fleet6.clock.advance(0.1)
+        frs6 += late6
+        stats6 = fleet6.stats()
+        mb6 = stats6["membership"]
+        ch6 = stats6["chaos"]
+        chaos_terminal = all(fr.record is not None for fr in frs6)
+        chaos_oracle = all(
+            fr.finish_reason == "length"
+            and fr.tokens == greedy_oracle(fr.prompt, fr.max_new_tokens)
+            for fr in frs6)
+        term6 = collections.Counter(
+            r["rid"] for r in mem6.by_kind("request")
+            if r["finish_reason"] != "retried")
+        chaos_lineage = (set(term6) == {fr.rid for fr in frs6}
+                         and all(v == 1 for v in term6.values()))
+        fenced6 = [w for w in fleet6.workers if w.readmit_info]
+        zero_zombie_tokens = (
+            len(fenced6) == fleet6.fences
+            and all(w.readmit_info["tokens_while_fenced"] == 0
+                    for w in fenced6))
+        live6 = [w for w in fleet6.workers if w.state == "live"]
+        chaos_no_leak = (len(live6) == 3 and all(
+            w.engine.free_blocks == w.engine.num_blocks - 1
+            for w in live6))
+        degrade_cycle = (mb6["degradations"] >= 1
+                         and mb6["degrade_releases"] >= 1
+                         and not mb6["degraded"])
+        chaos_evidence = (
+            ch6["frames_dropped"] > 0
+            and ch6["drop_reasons"].get("partition", 0) > 0
+            and ch6["drop_reasons"].get("flap", 0) > 0)
+        dark_twin_keys = set(stats6) - set(stats5) == {"chaos"}
+    finally:
+        fleet6.shutdown()
+    chaos6 = {
+        "ok": bool(chaos_terminal and chaos_oracle and chaos_lineage
+                   and zero_zombie_tokens and chaos_no_leak
+                   and degrade_cycle and chaos_evidence
+                   and dark_twin_keys and fleet6.fences >= 2
+                   and fleet6.readmitted >= fleet6.fences),
+        "all_terminal": bool(chaos_terminal),
+        "oracle_tokens": bool(chaos_oracle),
+        "single_lineage": bool(chaos_lineage),
+        "fences": fleet6.fences,
+        "readmitted": fleet6.readmitted,
+        "zero_tokens_while_fenced": bool(zero_zombie_tokens),
+        "survivors_leak_free": bool(chaos_no_leak),
+        "degradation_engaged_and_released": bool(degrade_cycle),
+        "membership": mb6,
+        "network": ch6,
+        "stats_keys_vs_dark_twin": sorted(set(stats6) - set(stats5)),
+    }
+
     ok = (all_terminal and lineage_ok and no_leak and no_retrace
           and p99_finite and shed_bounded and stats["resubmits"] >= 1
           and stats["stale_completions"] == 0 and sjf_wins
-          and proc["ok"] and tracing["ok"] and disagg["ok"])
+          and proc["ok"] and tracing["ok"] and disagg["ok"]
+          and chaos6["ok"])
     print(json.dumps({
         "child": "fleet", "ok": bool(ok),
         "workload": workload_stats(wl),
@@ -2266,6 +2371,7 @@ def run_fleet_child():
         "process": proc,
         "tracing": tracing,
         "disagg": disagg,
+        "chaos": chaos6,
         "device": jax.devices()[0].device_kind,
     }))
     return 0 if ok else 1

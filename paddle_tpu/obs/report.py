@@ -192,6 +192,37 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     # fleet record when the monitor was on
     if fleet_rec is not None and fleet_rec.get("slo") is not None:
         out.setdefault("serving", {})["slo"] = fleet_rec["slo"]
+    # epoch-fenced membership (ISSUE 20): prefer the fleet record's
+    # counters — a fence can appear TWICE in the raw stream (the
+    # parent's authoritative record plus the child's own forensics
+    # record, tagged source="replica" and shipped post-readmit), so
+    # raw kind="fence" counting is the dark fallback only, restricted
+    # to the parent-side records.
+    fences = [r for r in records if r.get("kind") == "fence"]
+    degrades = [r for r in records if r.get("kind") == "degrade"]
+    membership: Optional[Dict[str, Any]] = None
+    if fleet_rec is not None and fleet_rec.get("membership") is not None:
+        membership = dict(fleet_rec["membership"])
+    elif fences or degrades:
+        membership = {
+            "fences": sum(1 for r in fences
+                          if r.get("source") != "replica"),
+            "readmitted": 0, "false_deaths_averted": 0,
+            "degradations": sum(1 for r in degrades
+                                if r.get("event") == "engaged"),
+        }
+    if membership is not None:
+        membership["fence_records"] = len(fences)
+        reasons = collections.Counter(
+            r.get("reason") or "?" for r in fences
+            if r.get("source") != "replica")
+        if reasons:
+            membership["fence_reasons"] = dict(reasons)
+        if degrades:
+            membership["degrade_events"] = len(degrades)
+        if fleet_rec is not None and fleet_rec.get("chaos") is not None:
+            membership["chaos"] = fleet_rec["chaos"]
+        out.setdefault("serving", {})["membership"] = membership
     return out
 
 
@@ -349,6 +380,44 @@ def format_summary(s: Dict[str, Any]) -> str:
         if tr.get("events"):
             lines.append(f"  {'transport events in stream':<28}"
                          f"{tr['events']}")
+    # epoch-fenced membership + chaos plane (ISSUE 20) — rendered
+    # whenever fences, re-admissions or degradations happened
+    mb = (sv or {}).get("membership")
+    if mb and (mb.get("fences") or mb.get("readmitted")
+               or mb.get("false_deaths_averted")
+               or mb.get("degradations") or mb.get("chaos")):
+        lines.append("membership")
+        lines.append(f"  {'fences / readmitted':<28}"
+                     f"{mb.get('fences', 0)} / {mb.get('readmitted', 0)}")
+        if mb.get("false_deaths_averted"):
+            lines.append(f"  {'false deaths averted':<28}"
+                         f"{mb['false_deaths_averted']}")
+        stale = (mb.get("stale_epoch_replies", 0)
+                 + mb.get("stale_epoch_handoffs", 0)
+                 + mb.get("stale_metric_deltas", 0))
+        if stale:
+            lines.append(f"  {'stale-epoch discards':<28}{stale} "
+                         f"(replies {mb.get('stale_epoch_replies', 0)}, "
+                         f"handoffs {mb.get('stale_epoch_handoffs', 0)}, "
+                         f"metrics {mb.get('stale_metric_deltas', 0)})")
+        reasons = mb.get("fence_reasons") or {}
+        if reasons:
+            lines.append(f"  {'fence reasons':<28}"
+                         + ", ".join(f"{k}={v}" for k, v in
+                                     sorted(reasons.items())))
+        if mb.get("degradations"):
+            lines.append(f"  {'degradations (engaged/rel.)':<28}"
+                         f"{mb.get('degradations', 0)}/"
+                         f"{mb.get('degrade_releases', 0)}"
+                         + (" [degraded now]" if mb.get("degraded")
+                            else ""))
+        ch = mb.get("chaos")
+        if ch:
+            lines.append(f"  {'chaos frames drop/delay':<28}"
+                         f"{ch.get('frames_dropped', 0)} / "
+                         f"{ch.get('frames_delayed', 0)} "
+                         f"({ch.get('bytes_dropped', 0)} bytes dropped, "
+                         f"{ch.get('delay_injected_s', 0)}s injected)")
     slo = (sv or {}).get("slo")
     if slo:
         lines.append("slo (streaming)")

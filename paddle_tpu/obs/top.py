@@ -170,6 +170,9 @@ def render(root: Optional[str] = None, jsonl: Optional[str] = None, *,
     if jsonl:
         mon = SLOMonitor(targets=targets, window=window)
         transport = 0
+        fences = 0
+        degrades = 0
+        fleet_rec: Optional[Dict[str, Any]] = None
         try:
             records = load_records(jsonl)
         except OSError:
@@ -178,6 +181,15 @@ def render(root: Optional[str] = None, jsonl: Optional[str] = None, *,
             mon.observe(rec)
             if rec.get("kind") == "transport":
                 transport += 1
+            elif (rec.get("kind") == "fence"
+                    and rec.get("source") != "replica"):
+                # the child re-emits its own fence record post-readmit
+                # (source="replica") — count the parent's verdicts only
+                fences += 1
+            elif rec.get("kind") == "degrade":
+                degrades += 1
+            elif rec.get("kind") == "fleet":
+                fleet_rec = rec
             elif (rec.get("kind") == "metrics"
                     and rec.get("metrics") is not None):
                 snapshots.append(rec["metrics"])
@@ -200,6 +212,25 @@ def render(root: Optional[str] = None, jsonl: Optional[str] = None, *,
             reasons = " ".join(f"{k}={v}" for k, v in
                                sorted(rep["finish_reasons"].items()))
             lines.append(f"  finish: {reasons}")
+        mb = (fleet_rec or {}).get("membership") or {}
+        if fences or degrades or mb.get("readmitted") \
+                or mb.get("false_deaths_averted"):
+            # epoch-fenced membership (ISSUE 20): the fence/readmit
+            # ledger plus the partition-degradation state, one line
+            lines.append(
+                f"  membership: fences={fences} "
+                f"readmitted={mb.get('readmitted', 0)} "
+                f"false_deaths_averted="
+                f"{mb.get('false_deaths_averted', 0)} "
+                f"degrade_events={degrades}"
+                + (" [DEGRADED]" if mb.get("degraded") else ""))
+        ch = (fleet_rec or {}).get("chaos") or {}
+        if ch.get("frames_dropped") or ch.get("frames_delayed"):
+            lines.append(
+                f"  chaos: dropped={ch.get('frames_dropped', 0)} "
+                f"delayed={ch.get('frames_delayed', 0)} "
+                f"bytes_dropped={ch.get('bytes_dropped', 0)} "
+                f"delay_s={ch.get('delay_injected_s', 0)}")
     if hub is not None or snapshots:
         mlines = _metrics_lines(hub=hub, snapshots=snapshots)
         if mlines:

@@ -53,6 +53,18 @@ doctrine):
   ``adopt`` op), which continues from the first generated token —
   bit-identical to colocated serving, with the handoff rid-keyed
   through the reconcile ledger so mid-transfer death resubmits cleanly.
+- :mod:`.chaos` + epoch-fenced membership (ISSUE 20) —
+  :class:`NetworkChaos`/:class:`LinkChaos`, the SimClock-deterministic
+  network fault plane at the frame seam (per-link delay distributions,
+  bandwidth throttle, drop probability, asymmetric partition windows,
+  link flap schedules — seeded and ``describe()``-able), driving the
+  fleet's partition-tolerant membership: every replica holds a
+  monotonically-increasing epoch lease stamped on every frame, a
+  declared-dead replica is fenced BY EPOCH (not by kill — no signal
+  needs to reach it), a fenced child self-fences on its first stale
+  rejection, and a healed partition re-admits the zombie under a fresh
+  lease; a disagg fleet that lost every prefill replica degrades to
+  colocated prefill on its decoders instead of to stuck.
 - :mod:`.autoscaler` — the supervised elastic-capacity policy loop on
   top of ``drain()`` and ``spawn_replica()``, an M/M/c queueing-model
   controller per role (ISSUE 18): Erlang-C predicted delay from an
@@ -76,6 +88,7 @@ from .fleet import (FleetRequest, ProcReplicaWorker, ReplicaWorker,
 from .loadgen import (GenRequest, SimClock, hostile_workload,
                       make_workload, workload_stats)
 from .autoscaler import Autoscaler, AutoscalerGaveUp, erlang_c_wait
+from .chaos import LinkChaos, NetworkChaos
 from .transport import (BINARY_FLAG, ReplicaTransport,
                         SocketFrameReader, SocketWriter,
                         TransportClosed, TransportCorrupt,
@@ -95,6 +108,7 @@ __all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "PrefixMatch",
            "build_proc_spec",
            "pages_to_blobs", "blobs_to_pages",
            "Autoscaler", "AutoscalerGaveUp", "erlang_c_wait",
+           "LinkChaos", "NetworkChaos",
            "ReplicaTransport", "TransportError", "TransportTimeout",
            "TransportCorrupt", "TransportClosed",
            "SocketFrameReader", "SocketWriter", "listen", "connect",

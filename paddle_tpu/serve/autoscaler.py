@@ -312,7 +312,8 @@ class Autoscaler:
         # is replaced IN ITS ROLE — a disaggregated fleet that lost its
         # prefill replica needs a prefill replica back, not a spare
         # decoder.
-        deficit_role = self._role_deficit(live, draining)
+        fenced = getattr(fleet, "readmit_pending", lambda: [])()
+        deficit_role = self._role_deficit(live, draining, fenced)
         if (deficit_role is not None
                 and len(live) + len(draining) < self.max_replicas):
             if self.replacements >= self.max_replacements:
@@ -420,12 +421,16 @@ class Autoscaler:
                        replica=victim.replica_id, role=vrole,
                        **evidence)
 
-    def _role_deficit(self, live, draining) -> Optional[str]:
+    def _role_deficit(self, live, draining, fenced=()) -> Optional[str]:
         """The first role short of its desired count (None = envelope
         healthy). Draining replicas still count — the replacement
-        branch must not double-heal a scale-down in progress."""
+        branch must not double-heal a scale-down in progress. Fenced
+        replicas within their re-admission grace window count too
+        (ISSUE 20): fenced ≠ dead for capacity math — a zombie behind a
+        partition is expected back, and spawning a replacement AND
+        re-admitting the original would over-provision the role."""
         have: Dict[str, int] = {}
-        for w in list(live) + list(draining):
+        for w in list(live) + list(draining) + list(fenced):
             r = getattr(w, "role", "both")
             have[r] = have.get(r, 0) + 1
         for r in sorted(self.desired_by_role):
@@ -433,6 +438,6 @@ class Autoscaler:
                 return r
         # legacy guard: totals disagree without a per-role deficit
         # (e.g. desired bumped externally) — heal with a "both" spawn
-        if len(live) + len(draining) < (self.desired or 0):
+        if len(live) + len(draining) + len(fenced) < (self.desired or 0):
             return "both"
         return None

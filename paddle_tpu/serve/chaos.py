@@ -1,0 +1,428 @@
+"""Network chaos plane for the serving fleet (ISSUE 20).
+
+The fleet's fault drills so far injected one-shot failures *inside* the
+protocol (``inject_drop_reply``, ``inject_corrupt_reply``) — the child
+does the work and loses the reply. Real networks misbehave *between*
+the endpoints instead: frames are delayed, rate-limited, dropped, or
+cut off entirely for a window, often in ONE direction only. This module
+is a fault shim at the exact frame seam the transport already defines —
+:class:`~paddle_tpu.serve.transport.SocketWriter` on the way out,
+:class:`~paddle_tpu.serve.transport.FrameReader` on the way in — so
+every pathology is enacted on real wire bytes and surfaces through the
+real evidence chain (reply timeout → retransmit → exhausted retries →
+``transport_down`` → heartbeat staleness → death verdict), never as a
+parent-side simulation.
+
+Composable per-link impairments (:class:`LinkChaos`):
+
+- **one-way delay**: a constant or uniform-sampled hold per message,
+  plus jitter — enacted as a bounded real sleep at the seam (the
+  transport is synchronous request/reply, so holding the frame and
+  holding the caller are the same latency);
+- **bandwidth throttle**: serialization time ``bytes*8/bps`` added to
+  the hold — big KV-page blobs pay proportionally more than ticks;
+- **drop probability**: the frame is consumed and never delivered;
+- **partition windows**: ``(start_s, end_s[, direction])`` intervals of
+  100% loss, per direction — the asymmetric ("I can hear you but you
+  cannot hear me") case that manufactures false deaths;
+- **link flap**: a ``(period_s, down_s[, start_s])`` square wave of
+  short outages — the heartbeat-damping drill's signal.
+
+Determinism: window/flap verdicts are evaluated against the FLEET
+clock (``SimClock`` in drills — :meth:`NetworkChaos.bind`), and random
+verdicts (drop draws, delay samples) come from per-``(link,
+direction)`` :class:`random.Random` streams derived from one seed.
+Because the fleet is single-threaded and synchronous, two runs with the
+same seed, schedule and workload draw identical verdict sequences —
+:meth:`NetworkChaos.stats` is assertable across runs. Frames are
+protocol-coherent units: a dropped JSON message takes its declared
+binary payload frames down with it (on both seams), so chaos never
+desynchronizes the stream — it only loses exchanges, exactly like a
+lossy network under a framing protocol.
+
+Like :class:`~paddle_tpu.train.faults.FaultSchedule`, a
+:class:`NetworkChaos` is ``describe()``-able: the full per-link
+configuration plus the verdict counters, for drill provenance records.
+
+``ServingFleet(replica_mode="socket", chaos=NetworkChaos(...))`` wraps
+each replica link at spawn; ``chaos=None`` (the default) constructs the
+stock reader/writer classes — the chaos-off fleet is byte-identical to
+the pre-chaos transport.
+"""
+
+from __future__ import annotations
+
+import random
+import struct
+import time
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from . import transport as transport_lib
+
+__all__ = ["LinkChaos", "NetworkChaos", "ChaosWriter", "ChaosFrameReader"]
+
+_HEADER = struct.Struct(">I")
+
+# direction names are from the PARENT's point of view: "send" impairs
+# parent→child frames (requests), "recv" impairs child→parent frames
+# (replies and their payload blobs)
+_DIRECTIONS = ("send", "recv", "both")
+
+
+def _norm_direction(d: str, what: str) -> str:
+    if d not in _DIRECTIONS:
+        raise ValueError(f"{what} direction must be one of "
+                         f"{_DIRECTIONS}, got {d!r}")
+    return d
+
+
+class LinkChaos:
+    """The impairment profile of ONE parent↔replica link. All fields
+    compose; everything defaults off, so ``LinkChaos()`` is a transparent
+    link.
+
+    Args:
+      delay_s: one-way delay per message — a float, or a ``(lo, hi)``
+        pair sampled uniformly per message.
+      jitter_s: extra uniform ``[0, jitter_s)`` delay per message.
+      drop_p: per-message drop probability.
+      bandwidth_bps: link rate; each frame adds ``len*8/bps``
+        serialization time to its hold (None = infinite).
+      partitions: iterable of ``(start_s, end_s)`` or ``(start_s,
+        end_s, direction)`` windows of total loss, in fleet-clock
+        seconds. Direction defaults to ``"both"``.
+      flap: ``(period_s, down_s)`` or ``(period_s, down_s, start_s)``
+        — from ``start_s`` on, the link is down for the first
+        ``down_s`` of every ``period_s``.
+      direction: which direction the delay/drop/bandwidth impairments
+        apply to (partitions carry their own; the flap follows this).
+    """
+
+    def __init__(self, *, delay_s: Union[float, Tuple[float, float]]
+                 = 0.0, jitter_s: float = 0.0, drop_p: float = 0.0,
+                 bandwidth_bps: Optional[float] = None,
+                 partitions=(), flap: Optional[Tuple] = None,
+                 direction: str = "both"):
+        self.delay_s = delay_s
+        self.jitter_s = float(jitter_s)
+        self.drop_p = float(drop_p)
+        if not 0.0 <= self.drop_p <= 1.0:
+            raise ValueError(f"drop_p must be in [0, 1], got {drop_p}")
+        self.bandwidth_bps = (None if bandwidth_bps is None
+                              else float(bandwidth_bps))
+        self.direction = _norm_direction(direction, "link")
+        self.partitions: List[Tuple[float, float, str]] = []
+        for win in partitions:
+            if len(win) == 2:
+                s, e = win
+                d = "both"
+            else:
+                s, e, d = win
+            self.partitions.append(
+                (float(s), float(e), _norm_direction(d, "partition")))
+        self.flap: Optional[Tuple[float, float, float]] = None
+        if flap is not None:
+            period = float(flap[0])
+            down = float(flap[1])
+            start = float(flap[2]) if len(flap) > 2 else 0.0
+            if period <= 0 or not 0 <= down <= period:
+                raise ValueError(
+                    f"flap needs period_s > 0 and 0 <= down_s <= "
+                    f"period_s, got {flap!r}")
+            self.flap = (period, down, start)
+
+    def applies(self, direction: str) -> bool:
+        return self.direction in ("both", direction)
+
+    def down_reason(self, direction: str,
+                    now: float) -> Optional[str]:
+        """Why the link is cut for ``direction`` at ``now`` (None =
+        up). Partitions win over flaps in the counters — a window is
+        the deliberate drill, the flap is background weather."""
+        for s, e, d in self.partitions:
+            if s <= now < e and d in ("both", direction):
+                return "partition"
+        if self.flap is not None and self.applies(direction):
+            period, down, start = self.flap
+            if now >= start and (now - start) % period < down:
+                return "flap"
+        return None
+
+    def sample_delay(self, rng: random.Random) -> float:
+        if isinstance(self.delay_s, (tuple, list)):
+            lo, hi = self.delay_s
+            d = rng.uniform(float(lo), float(hi))
+        else:
+            d = float(self.delay_s)
+        if self.jitter_s > 0.0:
+            d += rng.uniform(0.0, self.jitter_s)
+        return d
+
+    def describe(self) -> Dict[str, Any]:
+        return {"delay_s": self.delay_s, "jitter_s": self.jitter_s,
+                "drop_p": self.drop_p,
+                "bandwidth_bps": self.bandwidth_bps,
+                "partitions": [list(w) for w in self.partitions],
+                "flap": list(self.flap) if self.flap else None,
+                "direction": self.direction}
+
+
+class NetworkChaos:
+    """The fleet-wide chaos plane: per-link :class:`LinkChaos` profiles,
+    one seed, one verdict ledger.
+
+    Args:
+      seed: master seed; each ``(link, direction)`` random stream is
+        derived from it deterministically.
+      links: ``{replica_id: LinkChaos}`` — links without an entry fall
+        back to ``default`` (or pass through untouched).
+      default: profile for unlisted links (None = transparent).
+      max_sleep_s: cap on any single REAL sleep enacted for a delay
+        hold (the full sampled hold is still accounted in the stats —
+        the cap protects CI wall time, not the model).
+    """
+
+    def __init__(self, seed: int = 0, *,
+                 links: Optional[Dict[int, LinkChaos]] = None,
+                 default: Optional[LinkChaos] = None,
+                 max_sleep_s: float = 0.05):
+        self.seed = int(seed)
+        self.links = dict(links or {})
+        self.default = default
+        self.max_sleep_s = float(max_sleep_s)
+        self.clock = None
+        self._rngs: Dict[Tuple[int, str], random.Random] = {}
+        # the verdict ledger: everything the plane did, per link and
+        # per direction — the determinism drill asserts equality of
+        # this whole dict across two same-seed runs
+        self.frames_dropped = 0
+        self.bytes_dropped = 0
+        self.frames_delayed = 0
+        self.delay_injected_s = 0.0
+        self.drop_reasons: Dict[str, int] = {}
+        self.per_link: Dict[int, Dict[str, Any]] = {}
+
+    # -- wiring ------------------------------------------------------------
+
+    def bind(self, clock) -> None:
+        """Adopt the fleet's clock (``SimClock`` in drills) — window
+        and flap verdicts are evaluated against it."""
+        self.clock = clock
+
+    def _now(self) -> float:
+        return float(self.clock()) if self.clock is not None else 0.0
+
+    def link(self, link_id: int) -> Optional[LinkChaos]:
+        return self.links.get(int(link_id), self.default)
+
+    def _rng(self, link_id: int, direction: str) -> random.Random:
+        key = (int(link_id), direction)
+        rng = self._rngs.get(key)
+        if rng is None:
+            # distinct deterministic streams per link and direction, so
+            # the draw sequence on one link never depends on traffic
+            # interleaving with another
+            rng = random.Random(
+                (self.seed * 1000003 + key[0] * 8191
+                 + (17 if direction == "send" else 31)) & 0x7FFFFFFF)
+            self._rngs[key] = rng
+        return rng
+
+    def wrap_writer(self, link_id: int, writer):
+        """The outbound seam: a :class:`ChaosWriter` when this link has
+        a profile, the writer untouched otherwise (chaos-off links are
+        byte-identical)."""
+        if self.link(link_id) is None:
+            return writer
+        return ChaosWriter(writer, self, int(link_id))
+
+    # -- verdicts ----------------------------------------------------------
+
+    def _bucket(self, link_id: int) -> Dict[str, Any]:
+        b = self.per_link.get(int(link_id))
+        if b is None:
+            b = {"dropped_send": 0, "dropped_recv": 0,
+                 "delayed": 0, "delay_s": 0.0, "bytes_dropped": 0}
+            self.per_link[int(link_id)] = b
+        return b
+
+    def verdict(self, link_id: int, direction: str,
+                nbytes: int) -> Tuple[str, float]:
+        """One message verdict: ``("drop", 0.0)`` or ``("deliver",
+        hold_s)``. Counters are recorded here so both seams share one
+        ledger. Binary payload frames do not get their own verdict —
+        they inherit their message's (see the seam classes) — but their
+        bytes do pay the bandwidth serialization time via
+        :meth:`serialization_s`."""
+        lc = self.link(link_id)
+        if lc is None:
+            return ("deliver", 0.0)
+        now = self._now()
+        down = lc.down_reason(direction, now)
+        reason = down
+        if reason is None and lc.applies(direction) and lc.drop_p > 0.0:
+            if self._rng(link_id, direction).random() < lc.drop_p:
+                reason = "drop"
+        if reason is not None:
+            self.frames_dropped += 1
+            self.bytes_dropped += int(nbytes)
+            self.drop_reasons[reason] = \
+                self.drop_reasons.get(reason, 0) + 1
+            b = self._bucket(link_id)
+            b[f"dropped_{direction}"] += 1
+            b["bytes_dropped"] += int(nbytes)
+            return ("drop", 0.0)
+        hold = 0.0
+        if lc.applies(direction):
+            hold = lc.sample_delay(self._rng(link_id, direction))
+            hold += self.serialization_s(link_id, direction, nbytes)
+        if hold > 0.0:
+            self.frames_delayed += 1
+            self.delay_injected_s += hold
+            b = self._bucket(link_id)
+            b["delayed"] += 1
+            b["delay_s"] += hold
+        return ("deliver", hold)
+
+    def serialization_s(self, link_id: int, direction: str,
+                        nbytes: int) -> float:
+        lc = self.link(link_id)
+        if (lc is None or lc.bandwidth_bps is None
+                or not lc.applies(direction)):
+            return 0.0
+        return (int(nbytes) * 8.0) / lc.bandwidth_bps
+
+    def hold(self, hold_s: float) -> None:
+        """Enact a delay hold as a bounded real sleep (the synchronous
+        transport makes holding the frame and holding the caller the
+        same observable latency)."""
+        if hold_s > 0.0:
+            time.sleep(min(hold_s, self.max_sleep_s))
+
+    # -- provenance --------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        return {"frames_dropped": self.frames_dropped,
+                "bytes_dropped": self.bytes_dropped,
+                "frames_delayed": self.frames_delayed,
+                "delay_injected_s": round(self.delay_injected_s, 9),
+                "drop_reasons": dict(sorted(self.drop_reasons.items())),
+                "per_link": {k: {**v, "delay_s": round(v["delay_s"], 9)}
+                             for k, v in sorted(self.per_link.items())}}
+
+    def describe(self) -> Dict[str, Any]:
+        """Full provenance, FaultSchedule-style: the configuration that
+        was armed plus the verdicts it produced."""
+        return {"seed": self.seed,
+                "max_sleep_s": self.max_sleep_s,
+                "default": (self.default.describe()
+                            if self.default else None),
+                "links": {k: v.describe()
+                          for k, v in sorted(self.links.items())},
+                "stats": self.stats()}
+
+
+class ChaosWriter:
+    """Outbound ("send") seam: wraps the duck-typed writer the frame
+    writers use. Each ``write()`` call is exactly one wire frame
+    (:func:`~paddle_tpu.serve.transport.write_frame` /
+    ``write_binary_frame`` write whole frames); a JSON frame draws a
+    fresh verdict, a binary frame (the high bit of its length prefix)
+    inherits the verdict of the message it rides behind — the protocol
+    invariant that blobs immediately follow their declaring message
+    makes the exchange one coherent unit, delivered or lost whole."""
+
+    def __init__(self, inner, chaos: NetworkChaos, link_id: int):
+        self.inner = inner
+        self.chaos = chaos
+        self.link_id = int(link_id)
+        self._blob_verdict = "deliver"   # verdict blobs inherit
+
+    def write(self, data: bytes) -> None:
+        binary = False
+        if len(data) >= _HEADER.size:
+            (n,) = _HEADER.unpack(bytes(data[:_HEADER.size]))
+            binary = bool(n & transport_lib.BINARY_FLAG)
+        if binary:
+            if self._blob_verdict == "drop":
+                self.chaos.bytes_dropped += len(data)
+                self.chaos._bucket(self.link_id)["bytes_dropped"] += \
+                    len(data)
+                return
+            # payload frames pay their own serialization time (the
+            # throttle is what makes big KV blobs slower than ticks)
+            ser = self.chaos.serialization_s(self.link_id, "send",
+                                             len(data))
+            if ser > 0.0:
+                self.chaos.delay_injected_s += ser
+                self.chaos._bucket(self.link_id)["delay_s"] += ser
+                self.chaos.hold(ser)
+            self.inner.write(data)
+            return
+        action, hold = self.chaos.verdict(self.link_id, "send",
+                                          len(data))
+        self._blob_verdict = action
+        if action == "drop":
+            return
+        self.chaos.hold(hold)
+        self.inner.write(data)
+
+    def flush(self) -> None:
+        self.inner.flush()
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+class ChaosFrameReader(transport_lib.SocketFrameReader):
+    """Inbound ("recv") seam: a :class:`~paddle_tpu.serve.transport.
+    SocketFrameReader` whose message reads pass through the chaos
+    verdict. A dropped message consumes its declared payload blobs too
+    (stream sync survives — only the exchange is lost); a delayed one
+    holds before delivery. Blob reads (``allow_binary``) pass through
+    untouched: they belong to an already-delivered message."""
+
+    def __init__(self, sock, chaos: NetworkChaos, link_id: int):
+        super().__init__(sock)
+        self.chaos = chaos
+        self.link_id = int(link_id)
+
+    def read_frame(self, timeout_s: Optional[float] = None,
+                   allow_binary: bool = False):
+        if allow_binary:
+            # a declared payload of a message that was already
+            # delivered: chaos judged the exchange at the message
+            return super().read_frame(timeout_s=timeout_s,
+                                      allow_binary=True)
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        while True:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise transport_lib.TransportTimeout(
+                        "no frame within timeout (chaos)")
+            rx0 = self.bytes_read
+            msg = super().read_frame(timeout_s=remaining)
+            nbytes = self.bytes_read - rx0
+            action, hold = self.chaos.verdict(self.link_id, "recv",
+                                              nbytes)
+            if action == "deliver":
+                self.chaos.hold(hold)
+                return msg
+            # dropped: consume the declared blobs so the next read
+            # starts on a frame boundary, then keep waiting
+            for _ in range(int(msg.get("nblobs") or 0)):
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise transport_lib.TransportTimeout(
+                            "no frame within timeout (chaos)")
+                blob = super().read_frame(timeout_s=remaining,
+                                          allow_binary=True)
+                self.chaos.bytes_dropped += (len(blob) + _HEADER.size
+                                             if isinstance(
+                                                 blob, (bytes,
+                                                        bytearray))
+                                             else 0)

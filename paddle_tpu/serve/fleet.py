@@ -71,6 +71,7 @@ import dataclasses
 import itertools
 import logging
 import os
+import random
 import signal
 import tempfile
 import time
@@ -474,7 +475,8 @@ class ProcReplicaWorker:
     def __init__(self, replica_id: int, spec: Dict[str, Any], root: str,
                  *, faults=None, telemetry=None, timeout_s: float = 2.0,
                  spawn_timeout_s: float = 300.0, stderr=None,
-                 mode: str = "process", role: str = "both"):
+                 mode: str = "process", role: str = "both",
+                 chaos=None):
         self.replica_id = int(replica_id)
         self.root = root
         self.state = "live"
@@ -489,6 +491,25 @@ class ProcReplicaWorker:
         self.engine = _RemoteEngineView()
         self.transport_down = False
         self.transport_errors = 0
+        self._mode = mode
+        # the epoch lease (ISSUE 20): granted by the fleet before the
+        # hello, bumped on declare-dead. Every op is stamped with it;
+        # every reply from a different epoch is discarded wholesale.
+        self.lease_epoch = 0
+        self.revoked_epoch: Optional[int] = None
+        self.fence_reply: Optional[Dict[str, Any]] = None
+        self.readmit_info: Optional[Dict[str, Any]] = None
+        self.stale_epoch_replies = 0
+        self.stale_metric_deltas = 0
+        self.readmits = 0
+        # readmit probing state (socket mode): capped exponential tick
+        # backoff with seeded jitter, so a healed partition doesn't see
+        # every fenced replica probed on the same tick
+        self._fenced_tick: Optional[int] = None
+        self._fenced_at: Optional[float] = None
+        self._readmit_attempts = 0
+        self._next_readmit_tick = 0
+        self._readmit_rng = random.Random(0xFE0CE + self.replica_id)
         # trace events shipped piggybacked on tick replies (ISSUE 17),
         # buffered here until the fleet's per-tick span drain
         self._spans: List[Dict[str, Any]] = []
@@ -519,15 +540,26 @@ class ProcReplicaWorker:
                 raise
             finally:
                 srv.close()
+            reader: Any = transport_lib.SocketFrameReader(sock)
+            writer: Any = transport_lib.SocketWriter(sock)
+            if chaos is not None and chaos.link(self.replica_id) \
+                    is not None:
+                # the chaos plane (ISSUE 20) sits at the frame seam:
+                # impairments are enacted on real wire bytes, so every
+                # pathology surfaces through the real timeout →
+                # retransmit → transport_down → heartbeat chain
+                from .chaos import ChaosFrameReader
+                reader = ChaosFrameReader(sock, chaos, self.replica_id)
+                writer = chaos.wrap_writer(self.replica_id, writer)
             self.transport = transport_lib.ReplicaTransport(
-                transport_lib.SocketFrameReader(sock),
-                transport_lib.SocketWriter(sock), proc=proc,
-                timeout_s=timeout_s)
+                reader, writer, proc=proc, timeout_s=timeout_s,
+                backoff_seed=self.replica_id)
         else:
             proc = transport_lib.spawn_replica_process(spec,
                                                        stderr=stderr)
             self.transport = transport_lib.ReplicaTransport(
-                proc.stdout, proc.stdin, proc=proc, timeout_s=timeout_s)
+                proc.stdout, proc.stdin, proc=proc, timeout_s=timeout_s,
+                backoff_seed=self.replica_id)
 
     @property
     def pid(self) -> Optional[int]:
@@ -555,13 +587,23 @@ class ProcReplicaWorker:
         # corpse rots) and let the heartbeat verdict make the call
         self.transport_down = True
 
+    def _request(self, op: str, **kw) -> Dict[str, Any]:
+        """Every op stamped with this worker's lease epoch (ISSUE 20) —
+        the wire half of the fence. A worker never granted an epoch
+        (legacy drivers) sends unstamped, unchanged."""
+        if self.lease_epoch:
+            kw.setdefault("epoch", self.lease_epoch)
+        return self.transport.request(op, **kw)
+
     # -- lifecycle ---------------------------------------------------------
 
     def join(self, now: float) -> None:
         """Blocking hello handshake: waits for the child to finish its
         jax bring-up, records the engine geometry, and confirms the
-        first heartbeat landed (the child beats on hello)."""
-        reply = self.transport.request(
+        first heartbeat landed (the child beats on hello). The hello is
+        also the lease GRANT: it carries the epoch the fleet issued at
+        spawn."""
+        reply = self._request(
             "hello", now=now, timeout_s=self._spawn_timeout_s,
             max_attempts=1)
         self.engine.set_geometry(reply)
@@ -605,8 +647,89 @@ class ProcReplicaWorker:
         """Fence-by-kill: the process analog of the PR-11 zombie
         self-fence. A declared-dead replica whose process still runs (a
         stall, a partition) must never complete a re-homed request —
-        SIGKILL makes that structural."""
+        SIGKILL makes that structural. This is the PIPE-mode fence
+        (same host, so the signal always lands — the strongest fence
+        available); socket-mode workers are fenced BY EPOCH instead
+        (:meth:`fence`), because a kill signal cannot cross hosts."""
         self._terminate(signal.SIGKILL)
+
+    def fence(self, new_epoch: int, now: float,
+              tick_idx: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Epoch fence (ISSUE 20): revoke this worker's lease. The OLD
+        epoch becomes invalid the moment the parent adopts the new one
+        — every subsequent reply, handoff or metric delta stamped with
+        it is discarded, and the child itself rejects ops carrying it —
+        so the fence holds even if the revocation NOTICE below never
+        arrives (the point of fencing by epoch, not by reachability).
+        The notice is one best-effort short-timeout attempt: when the
+        send direction is up (asymmetric partition) the child evicts
+        its slots immediately instead of at first rejected op."""
+        self.revoked_epoch = self.lease_epoch or None
+        self.lease_epoch = int(new_epoch)
+        self.fence_reply = None
+        self.readmit_info = None
+        self._fenced_tick = tick_idx
+        self._fenced_at = now
+        self._readmit_attempts = 0
+        self._next_readmit_tick = (tick_idx or 0) + 1
+        if (self.transport.closed or self.killed
+                or self.transport.proc is None
+                or self.transport.proc.poll() is not None):
+            return None
+        try:
+            reply = self._request(
+                "fence", now=now, max_attempts=1,
+                timeout_s=min(self.transport.timeout_s, 0.5))
+        except transport_lib.TransportError:
+            return None             # unreachable: the epoch IS the fence
+        if reply.get("ok"):
+            self.fence_reply = reply.get("fence")
+        return self.fence_reply
+
+    def try_readmit(self, new_epoch: int, now: float) -> bool:
+        """One readmit probe (partition heal): offer the fenced child a
+        FRESH lease (strictly newer than the fence epoch — the child
+        rejects a readmit that does not outrank what it holds). On
+        success the worker rejoins as an EMPTY live replica —
+        parent-side rid bookkeeping is reset, the child already evicted
+        everything at fence time, and the reply's fence report
+        (tokens_while_fenced, stale_epoch_rejects) is kept as drill
+        evidence. A failed probe burns its epoch; the counter is
+        monotone, not dense."""
+        if (self.transport.closed or self.killed
+                or self.transport.proc is None
+                or self.transport.proc.poll() is not None):
+            return False
+        self._readmit_attempts += 1
+        try:
+            reply = self.transport.request(
+                "readmit", epoch=int(new_epoch), now=now,
+                max_attempts=1,
+                timeout_s=min(self.transport.timeout_s, 0.5))
+        except transport_lib.TransportError:
+            return False
+        if not reply.get("ok"):
+            return False
+        self.lease_epoch = int(new_epoch)
+        self.readmits += 1
+        self.readmit_info = {
+            "epoch": self.lease_epoch,
+            "fence": reply.get("fence"),
+            "tokens_while_fenced": reply.get("tokens_while_fenced"),
+            "stale_epoch_rejects": reply.get("stale_epoch_rejects")}
+        if self.fence_reply is None:
+            self.fence_reply = reply.get("fence")
+        # clean slate on BOTH sides: the child cleared its rid/dedupe
+        # state at fence; any rid we still track for it lives elsewhere
+        # now (resubmitted when it was declared dead)
+        self.known.clear()
+        self.scheduler.by_rid.clear()
+        self.state = "live"
+        self.transport_down = False
+        load = reply.get("load") or {}
+        self.scheduler.update(load)
+        self.engine.update(load)
+        return True
 
     def shutdown(self) -> None:
         """Graceful stop (release path / fleet teardown): ask the child
@@ -627,7 +750,7 @@ class ProcReplicaWorker:
         if self.transport_down:
             return None                 # don't pay timeouts to a corpse
         try:
-            reply = self.transport.request(
+            reply = self._request(
                 "submit", rid=fr.rid, prompt=list(fr.prompt),
                 max_new_tokens=fr.max_new_tokens, eos_id=fr.eos_id,
                 deadline_s=fr.deadline_s, priority=fr.priority,
@@ -643,6 +766,14 @@ class ProcReplicaWorker:
             deadline_s=fr.deadline_s, priority=fr.priority,
             retries=fr.retries, submit_ts=fr.submit_ts)
         self.scheduler.by_rid[fr.rid] = req
+        # optimistic load accounting: the child's shadow view otherwise
+        # refreshes only on tick replies, so a burst of submits between
+        # ticks would all read this replica at its pre-burst load and
+        # pile onto one worker (in-process workers account admission
+        # immediately — this keeps socket placement consistent with
+        # that). The next real report overwrites the estimate.
+        self.scheduler._pending += fr.max_new_tokens
+        self.scheduler._prefill_backlog += len(fr.prompt)
         return req
 
     def tick(self, now: float, tick_idx: int) -> None:
@@ -661,14 +792,24 @@ class ProcReplicaWorker:
                                                 self.replica_id):
                 flags["inject_corrupt_reply"] = True
         try:
-            reply = self.transport.request("tick", now=now,
-                                           tick=tick_idx, **flags)
+            reply = self._request("tick", now=now,
+                                  tick=tick_idx, **flags)
         except transport_lib.TransportError as e:
             self._transport_error("tick", e)
             return
         self._absorb(reply)
 
     def _absorb(self, reply: Dict[str, Any]) -> None:
+        rep_ep = reply.get("epoch")
+        if (rep_ep is not None and self.lease_epoch
+                and int(rep_ep) != self.lease_epoch):
+            # a reply stamped with a revoked lease (ISSUE 20): a
+            # fenced-then-superseded child's late work. Discard it
+            # WHOLESALE — its completions were resubmitted elsewhere,
+            # its load view is of an evicted scheduler, its metric
+            # deltas would double-count against the readmitted epoch.
+            self.stale_epoch_replies += 1
+            return
         load = reply.get("load") or {}
         self.scheduler.update(load)
         self.engine.update(load)
@@ -679,7 +820,9 @@ class ProcReplicaWorker:
             self._spans.extend(sp)
         md = reply.get("metrics")
         if md:
-            self._metrics_deltas.extend(md)
+            # tagged with the epoch they arrived under: a fence between
+            # absorb and the fleet's drain sweep must still kill them
+            self._metrics_deltas.append((self.lease_epoch, md))
         for item in reply.get("completed") or ():
             rec = item.get("record") or {}
             rid = rec.get("rid")
@@ -706,7 +849,12 @@ class ProcReplicaWorker:
                 rid = int(h["rid"])
                 self._handoffs.append({
                     "rid": rid, "meta": h["meta"],
-                    "blobs": blobs[off:off + nb]})
+                    "blobs": blobs[off:off + nb],
+                    # the epoch this package arrived under: the fleet's
+                    # handoff sweep discards it if the lease was revoked
+                    # before placement (a stale prefill must not be
+                    # adopted alongside its resubmitted twin)
+                    "epoch": self.lease_epoch})
                 off += nb
                 # the request now lives between replicas; the child
                 # forgot it too, so a later re-delivery must not dedupe
@@ -714,7 +862,7 @@ class ProcReplicaWorker:
 
     def begin_drain(self, now: float) -> List[int]:
         try:
-            reply = self.transport.request("drain", now=now)
+            reply = self._request("drain", now=now)
         except transport_lib.TransportError as e:
             self._transport_error("drain", e)
             return []
@@ -731,7 +879,7 @@ class ProcReplicaWorker:
         if self.transport_down:
             return
         try:
-            self.transport.request("resume")
+            self._request("resume")
         except transport_lib.TransportError as e:
             self._transport_error("resume", e)
 
@@ -746,7 +894,7 @@ class ProcReplicaWorker:
         if self.transport_down:
             return None
         try:
-            reply = self.transport.request(
+            reply = self._request(
                 "adopt", rid=fr.rid, meta=pkg["meta"],
                 blobs=pkg["blobs"], now=now)
         except transport_lib.TransportError as e:
@@ -781,7 +929,7 @@ class ProcReplicaWorker:
                 or self.killed or self.state in ("dead", "released")):
             return None
         try:
-            return self.transport.request("stats", now=now)
+            return self._request("stats", now=now)
         except transport_lib.TransportError as e:
             self._transport_error("stats", e)
             return None
@@ -801,9 +949,17 @@ class ProcReplicaWorker:
     def drain_metrics(self) -> List[Dict[str, Any]]:
         """Pop the child's shipped registry deltas (no transport round
         — they already rode the tick replies; deltas a SIGKILL ate
-        simply never land here)."""
-        md, self._metrics_deltas = self._metrics_deltas, []
-        return md
+        simply never land here). Deltas tagged with a revoked epoch are
+        discarded, not merged (ISSUE 20) — a fenced replica's late
+        counters must not pollute the fleet registry."""
+        tagged, self._metrics_deltas = self._metrics_deltas, []
+        out: List[Dict[str, Any]] = []
+        for ep, md in tagged:
+            if ep == self.lease_epoch:
+                out.extend(md)
+            else:
+                self.stale_metric_deltas += 1
+        return out
 
     def scrape_metrics(self, now: float) -> Optional[str]:
         """One ``metrics`` op round-trip: the child's full registry as
@@ -814,7 +970,7 @@ class ProcReplicaWorker:
                 or self.killed or self.state in ("dead", "released")):
             return None
         try:
-            reply = self.transport.request("metrics", now=now)
+            reply = self._request("metrics", now=now)
         except transport_lib.TransportError as e:
             self._transport_error("metrics", e)
             return None
@@ -886,13 +1042,22 @@ class ServingFleet:
                  spawn_timeout_s: float = 300.0,
                  autoscaler=None, trace: bool = False, slo=None,
                  anomaly=None, roles: Optional[List[str]] = None,
-                 metrics=None):
+                 metrics=None, chaos=None,
+                 death_confirmations: int = 2,
+                 lease_timeout_s: Optional[float] = None,
+                 degrade_grace_s: Optional[float] = None,
+                 readmit_grace_s: Optional[float] = None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         if replica_mode not in ("inprocess", "process", "socket"):
             raise ValueError(
                 f"replica_mode must be 'inprocess'|'process'|'socket', "
                 f"got {replica_mode!r}")
+        if chaos is not None and replica_mode != "socket":
+            # the chaos plane impairs WIRE frames at the socket seam;
+            # pipes/in-process have no link to impair — fail loudly
+            # rather than run a drill with the chaos silently off
+            raise ValueError("chaos requires replica_mode='socket'")
         if replica_mode in ("process", "socket") and proc_spec is None:
             raise ValueError(
                 f"replica_mode={replica_mode!r} needs proc_spec — use "
@@ -953,13 +1118,32 @@ class ServingFleet:
             # burn rate as gauges into the same registry (satellite 3)
             self.slo.metrics = self.metrics
         self.anomaly = anomaly
+        # the network chaos plane (ISSUE 20): bound to the fleet clock
+        # so partition/flap windows are SimClock-deterministic; wired
+        # per link inside _spawn_worker. None = stock reader/writer —
+        # byte-identical to the pre-chaos transport.
+        self.chaos = chaos
+        if chaos is not None:
+            chaos.bind(self.clock)
+        # epoch leases (ISSUE 20): one fleet-global monotone counter —
+        # every grant (spawn, readmit) is a fresh epoch, so "newer
+        # epoch" is a total order across the whole membership history.
+        # Must exist BEFORE the spawn loop (spawn grants the first
+        # epoch; the hello delivers it).
+        self._epochs = itertools.count(1)
+        if lease_timeout_s is not None:
+            # the child-side half of the lease: absent from the spec
+            # (and from child behavior) unless explicitly armed
+            self._proc_spec["lease_timeout_s"] = float(lease_timeout_s)
         self.workers: List[Any] = []
         for i in range(n_replicas):       # Popen-spawn (or build) all…
             self._spawn_worker(roles[i] if roles else "both")
         self.router = FleetRouter(
             self.workers, self.root,
             heartbeat_timeout_s=heartbeat_timeout_s, clock=self.clock,
-            affinity=affinity, shed=shed, tracer=self.tracer)
+            affinity=affinity, shed=shed, tracer=self.tracer,
+            death_confirmations=death_confirmations,
+            metrics=self.metrics)
         now = self.clock()
         for w in self.workers:            # …then join: children paid
             w.join(now)                   # their jax bring-up in parallel
@@ -989,6 +1173,26 @@ class ServingFleet:
         self.handoff_wire_bytes = 0
         self.handoff_blocks = 0
         self.stale_handoffs = 0
+        # membership accounting (ISSUE 20)
+        self.fences = 0
+        self.readmitted = 0
+        self.stale_epoch_handoffs = 0
+        # partition degradation (ISSUE 20): when a disagg fleet loses
+        # every prefill-capable replica for longer than the grace
+        # window, decode replicas temporarily serve colocated prefill
+        # (slower, not stuck); heal releases it. Grace defaults to two
+        # heartbeat timeouts — long enough that an ordinary death +
+        # replacement never engages it.
+        self.degraded = False
+        self.degrade_grace_s = (float(degrade_grace_s)
+                                if degrade_grace_s is not None
+                                else 2.0 * float(heartbeat_timeout_s))
+        self.readmit_grace_s = (float(readmit_grace_s)
+                                if readmit_grace_s is not None
+                                else 8.0 * float(heartbeat_timeout_s))
+        self._prefill_lost_at: Optional[float] = None
+        self.degradations = 0
+        self.degrade_releases = 0
         # host-side router/reconcile cost (satellite 1): wall seconds
         # (perf_counter, NEVER the injectable clock — SimClock would
         # report zero) accumulated around placement work, bucketed per
@@ -1019,7 +1223,11 @@ class ServingFleet:
                 telemetry=self.telemetry,
                 timeout_s=self._transport_timeout_s,
                 spawn_timeout_s=self._spawn_timeout_s,
-                mode=self.replica_mode, role=role)
+                mode=self.replica_mode, role=role,
+                chaos=self.chaos)
+            # the lease grant: the hello (join) carries this epoch to
+            # the child, every later op is stamped with it
+            w.lease_epoch = next(self._epochs)
             if self.tracer is not None:
                 # retransmit/timeout/corrupt verdicts land as instants
                 # on the ROUTER lane — the child can't see them (a lost
@@ -1123,6 +1331,13 @@ class ServingFleet:
         rec.update(extra)
         return rec
 
+    def _route_role(self) -> Optional[str]:
+        """The submit-path role filter: prefill-first in a disagg
+        fleet, EXCEPT while degraded (every prefill replica unreachable
+        past the grace window) — then requests place on decode-capable
+        replicas, which serve colocated prefill until the heal."""
+        return "prefill" if (self.disagg and not self.degraded) else None
+
     # -- submission --------------------------------------------------------
 
     def submit(self, prompt: List[int], max_new_tokens: int,
@@ -1164,8 +1379,7 @@ class ServingFleet:
         dec = self.router.route(
             prompt_len=len(fr.prompt), max_new_tokens=max_new_tokens,
             deadline_s=deadline_s, session_id=session_id,
-            submit_ts=now, now=now,
-            role="prefill" if self.disagg else None)
+            submit_ts=now, now=now, role=self._route_role())
         self._router_cur_s += time.perf_counter() - _w0
         if self.tracer is not None:
             # the rid's flow BEGINS here (phase "s"); every later hop —
@@ -1258,8 +1472,7 @@ class ServingFleet:
             prompt_len=len(fr.prompt),
             max_new_tokens=fr.max_new_tokens, deadline_s=fr.deadline_s,
             session_id=fr.session_id, submit_ts=fr.submit_ts, now=now,
-            allow_shed=False,
-            role="prefill" if self.disagg else None)
+            allow_shed=False, role=self._route_role())
         if dec.worker is None:
             self._unplaced.append(fr)
         else:
@@ -1270,11 +1483,23 @@ class ServingFleet:
         held by a live replica that knows its rid. Parked requests
         retry placement first (capacity may have appeared)."""
         self._place_parked(now)
+        for fr in list(self._active.values()):
+            if fr.record is not None or fr.replica is None:
+                continue
+            w = self._worker(fr.replica)
+            if w.state in ("dead", "released"):
+                self._resubmit(fr, now, f"replica-{w.state}")
+            elif fr.local is None and w.state in ("live", "draining"):
+                self._resubmit(fr, now, "lost-submit")
         if self._unplaced and not self.router.candidates():
             # capacity emergency: parked work and zero live replicas.
             # The drain guard can be raced (a replica killed just before
             # the drain is only OBSERVED dead later), so scale-down
-            # yields: cancel a drain rather than strand requests.
+            # yields: cancel a drain rather than strand requests. This
+            # check runs AFTER the orphan sweep above: a death verdict
+            # (K-confirmed, so one refresh later than it used to be)
+            # may park its orphans in this very tick, and the drainer
+            # must be recalled before it goes idle and is released.
             w = next((w for w in self.workers if w.state == "draining"),
                      None)
             if w is not None:
@@ -1286,14 +1511,6 @@ class ServingFleet:
                 self._replica_event("drain-cancelled", w,
                                     parked=len(self._unplaced))
                 self._place_parked(now)
-        for fr in list(self._active.values()):
-            if fr.record is not None or fr.replica is None:
-                continue
-            w = self._worker(fr.replica)
-            if w.state in ("dead", "released"):
-                self._resubmit(fr, now, f"replica-{w.state}")
-            elif fr.local is None and w.state in ("live", "draining"):
-                self._resubmit(fr, now, "lost-submit")
 
     def _place_parked(self, now: float) -> None:
         for fr in list(self._unplaced):
@@ -1311,7 +1528,7 @@ class ServingFleet:
                 max_new_tokens=fr.max_new_tokens,
                 deadline_s=fr.deadline_s, session_id=fr.session_id,
                 submit_ts=fr.submit_ts, now=now, allow_shed=False,
-                role="prefill" if self.disagg else None)
+                role=self._route_role())
             if dec.worker is not None:
                 self._unplaced.remove(fr)
                 self._deliver(fr, dec.worker)
@@ -1355,6 +1572,15 @@ class ServingFleet:
                 continue
             for pkg in pop():
                 rid = int(pkg["rid"])
+                pkg_ep = pkg.get("epoch")
+                if (pkg_ep is not None
+                        and getattr(w, "lease_epoch", 0)
+                        and pkg_ep != w.lease_epoch):
+                    # the package arrived under a lease that has since
+                    # been revoked (ISSUE 20): its rid was resubmitted
+                    # — adopting it would race the retry's own prefill
+                    self.stale_epoch_handoffs += 1
+                    continue
                 fr = self.requests.get(rid)
                 if (fr is None or fr.record is not None
                         or fr.replica != w.replica_id):
@@ -1458,6 +1684,101 @@ class ServingFleet:
                 self._resubmit(fr, now, "drain")
         return w.state
 
+    # -- partition tolerance (ISSUE 20) ------------------------------------
+
+    def readmit_pending(self) -> List[Any]:
+        """Fenced socket workers whose process is still alive and whose
+        fence is recent enough (``readmit_grace_s``) that a readmit may
+        rescue them. The autoscaler counts these toward role fill —
+        fenced is NOT just dead for capacity math, or a heal would land
+        a readmitted replica on top of its own replacement."""
+        if self.replica_mode != "socket":
+            return []
+        now = self.clock()
+        out = []
+        for w in self.workers:
+            if (w.state == "dead" and getattr(w, "is_process", False)
+                    and not w.killed and not w.transport.closed):
+                proc = w.transport.proc
+                if (proc is not None and proc.poll() is None
+                        and (w._fenced_at is None
+                             or now - w._fenced_at
+                             <= self.readmit_grace_s)):
+                    out.append(w)
+        return out
+
+    def _probe_readmits(self, now: float) -> None:
+        """Offer every readmit-eligible fenced worker a fresh lease,
+        on a capped exponential tick backoff with seeded jitter (a
+        healed partition must not see every fenced replica probed on
+        the same tick). One short-timeout attempt per probe — cheap
+        while the partition holds, immediate once it heals."""
+        t = self.ticks
+        for w in self.readmit_pending():
+            if t < w._next_readmit_tick:
+                continue
+            if w.try_readmit(next(self._epochs), now):
+                self.readmitted += 1
+                info = w.readmit_info or {}
+                self._replica_event(
+                    "readmitted", w, epoch=w.lease_epoch,
+                    tokens_while_fenced=info.get("tokens_while_fenced"),
+                    stale_epoch_rejects=info.get("stale_epoch_rejects"))
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "fleet_readmitted_total",
+                        "fenced replicas re-admitted after heal").inc()
+                if self.tracer is not None:
+                    self.tracer.instant("replica_readmitted",
+                                        replica=w.replica_id,
+                                        epoch=w.lease_epoch)
+                # the death verdict is spent: a fresh staleness streak
+                # must start from zero for the new incarnation
+                self.router._stale_streak.pop(w.replica_id, None)
+            else:
+                step = 1 << min(w._readmit_attempts, 4)
+                w._next_readmit_tick = (
+                    t + step + w._readmit_rng.randrange(0, step + 1))
+
+    def _update_degradation(self, now: float) -> None:
+        """Disagg partition degradation: zero reachable prefill-capable
+        replicas past the grace window flips the fleet to degraded —
+        the submit path routes to decode-capable replicas, whose
+        schedulers serve colocated prefill (slower, not stuck). Any
+        prefill candidate reappearing (heal, readmit, autoscaler
+        replacement) releases it immediately."""
+        if not self.disagg:
+            return
+        if self.router.candidates("prefill"):
+            self._prefill_lost_at = None
+            if self.degraded:
+                self.degraded = False
+                self.degrade_releases += 1
+                self._emit({"kind": "degrade", "event": "released",
+                            "t": now, "tick": self.ticks})
+                if self.tracer is not None:
+                    self.tracer.instant("degrade_released")
+            return
+        if self._prefill_lost_at is None:
+            self._prefill_lost_at = now
+            return
+        if (not self.degraded
+                and now - self._prefill_lost_at >= self.degrade_grace_s):
+            self.degraded = True
+            self.degradations += 1
+            _log.warning("no reachable prefill replica for %.2fs: "
+                         "degrading to colocated prefill on decode "
+                         "replicas", now - self._prefill_lost_at)
+            self._emit({"kind": "degrade", "event": "engaged",
+                        "t": now, "tick": self.ticks,
+                        "grace_s": self.degrade_grace_s})
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "fleet_degraded_total",
+                    "disagg→colocated degradation engagements").inc()
+            if self.tracer is not None:
+                self.tracer.instant("degrade_engaged")
+
     # -- the fleet tick ----------------------------------------------------
 
     def tick(self) -> None:
@@ -1479,10 +1800,41 @@ class ServingFleet:
                 self._worker(rep).stall(t + n)
         for w in self.router.refresh_health(now):
             self._replica_event("dead", w, orphans=w.orphan_count())
-            w.on_declared_dead()         # proc replicas fence by kill
+            if (getattr(w, "is_process", False)
+                    and getattr(w, "_mode", None) == "socket"):
+                # fence BY EPOCH (ISSUE 20): a socket replica may live
+                # on a host our signals cannot reach — revoke its lease
+                # instead of killing. The revocation holds even if the
+                # notice below never arrives: every op/reply/handoff/
+                # metric-delta of the old epoch is now discarded on
+                # both sides of the wire.
+                old_ep = w.lease_epoch
+                info = w.fence(next(self._epochs), now, tick_idx=t)
+                self.fences += 1
+                rec = {"kind": "fence", "replica": w.replica_id,
+                       "t": now, "tick": t, "reason": "declared-dead",
+                       "epoch": old_ep, "new_epoch": w.lease_epoch,
+                       "acked": info is not None}
+                if info:
+                    rec["slots_evicted"] = info.get("slots_evicted")
+                    rec["blocks_freed"] = info.get("blocks_freed")
+                self._emit(rec)
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "fleet_fence_total",
+                        "lease revocations on declare-dead").inc()
+                if self.tracer is not None:
+                    self.tracer.instant("replica_fenced",
+                                        replica=w.replica_id,
+                                        epoch=old_ep)
+            else:
+                w.on_declared_dead()     # pipe/in-process: fence by
+                #                          kill (same host — stronger)
             # retire the ghost's beat (quarantine rename, never delete):
             # watchdogs scanning the root must not re-report it forever
             multihost.retire_heartbeat(self.root, w.replica_id)
+        self._probe_readmits(now)
+        self._update_degradation(now)
         if self.autoscaler is not None:
             # policy BEFORE reconcile: a cold-spawned replacement is
             # placeable in the same tick that needs it
@@ -1550,6 +1902,24 @@ class ServingFleet:
             m.histogram("fleet_router_ms",
                         "host-side placement cost per fleet tick (ms)"
                         ).observe(self._router_tick_s[-1] * 1000.0)
+            m.gauge("fleet_degraded",
+                    "1 while serving colocated prefill on decode "
+                    "replicas (disagg partition degradation)"
+                    ).set(1 if self.degraded else 0)
+            if self.chaos is not None:
+                cs = self.chaos.stats()
+                m.gauge("chaos_frames_dropped",
+                        "frames discarded by the chaos plane"
+                        ).set(cs["frames_dropped"])
+                m.gauge("chaos_frames_delayed",
+                        "frames held by the chaos plane"
+                        ).set(cs["frames_delayed"])
+                m.gauge("chaos_bytes_dropped",
+                        "wire bytes discarded by the chaos plane"
+                        ).set(cs["bytes_dropped"])
+                m.gauge("chaos_delay_injected_s",
+                        "cumulative injected delay (s)"
+                        ).set(cs["delay_injected_s"])
         self.ticks += 1
 
     def outstanding(self) -> bool:
@@ -1659,6 +2029,28 @@ class ServingFleet:
                     tot[k] += int(ts.get(k) or 0)
         return tot
 
+    def _membership_stats(self) -> Dict[str, Any]:
+        """The epoch-lease membership counters (ISSUE 20): fences
+        issued, zombies re-admitted, stale-epoch traffic discarded at
+        each merge seam, flap verdicts averted, and the degradation
+        state — one dict shared by ``stats()`` and the fleet record."""
+        return {
+            "fences": self.fences,
+            "readmitted": self.readmitted,
+            "false_deaths_averted": self.router.false_deaths_averted,
+            "stale_epoch_replies": sum(
+                getattr(w, "stale_epoch_replies", 0)
+                for w in self.workers),
+            "stale_epoch_handoffs": self.stale_epoch_handoffs,
+            "stale_metric_deltas": sum(
+                getattr(w, "stale_metric_deltas", 0)
+                for w in self.workers),
+            "readmit_pending": len(self.readmit_pending()),
+            "degraded": self.degraded,
+            "degradations": self.degradations,
+            "degrade_releases": self.degrade_releases,
+        }
+
     def emit_stats(self) -> Dict[str, Any]:
         """Emit one ``kind="fleet"`` summary record into the telemetry
         stream (transport totals, recovery counters, the SLO snapshot
@@ -1669,7 +2061,10 @@ class ServingFleet:
             "resubmits": self.resubmits, "shed": self.shed_count,
             "duplicates_dropped": self.duplicates_dropped,
             "stale_completions": self.stale_completions,
-            "transport": self._transport_totals()}
+            "transport": self._transport_totals(),
+            "membership": self._membership_stats()}
+        if self.chaos is not None:
+            rec["chaos"] = self.chaos.stats()
         if self.slo is not None:
             rec["slo"] = self.slo.report()
         self._emit(rec)
@@ -1711,6 +2106,12 @@ class ServingFleet:
             ts = w.transport_stats()
             if ts is not None:
                 row["transport"] = ts
+            if getattr(w, "lease_epoch", 0):
+                row["epoch"] = w.lease_epoch
+                if getattr(w, "revoked_epoch", None) is not None:
+                    row["revoked_epoch"] = w.revoked_epoch
+                if getattr(w, "readmits", 0):
+                    row["readmits"] = w.readmits
             per_replica[w.replica_id] = row
         scale = ({"scale_events": len(self.autoscaler.events),
                   "desired_replicas": self.autoscaler.desired,
@@ -1741,7 +2142,14 @@ class ServingFleet:
             "stale_handoffs": self.stale_handoffs,
             "pending_handoffs": len(self._pending_handoffs),
             "router_ms": self._router_ms(),
+            # the membership block is UNCONDITIONAL: a dark twin with
+            # chaos off must expose the same key set (bench leg 4 pins
+            # instrumented-vs-dark stats symmetry) — only "chaos" below
+            # is gated on the plane actually being attached
+            "membership": self._membership_stats(),
         }
+        if self.chaos is not None:
+            out["chaos"] = self.chaos.stats()
         if self.slo is not None:
             # burn rate and the rolling percentiles ride the stats dict
             # (ISSUE 17) — the dashboard's one-call snapshot

@@ -261,11 +261,28 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
                root: str, replica_id: int,
                reply_cache_size: int = 16,
                startup: Optional[Dict[str, Any]] = None,
-               metrics=None) -> int:
+               metrics=None,
+               lease_timeout_s: Optional[float] = None) -> int:
     """The child's message loop (transport-layer concerns only — the
     handler logic is inline because it IS the replica). Returns the exit
     code; EOF on stdin is a clean shutdown (the parent died or closed
-    us)."""
+    us).
+
+    **Epoch leases (ISSUE 20).** The hello grants this replica a
+    monotonically-increasing epoch; every stamped op must carry it.
+    A ``fence`` op (or an op stamped with a NEWER epoch, or — with
+    ``lease_timeout_s`` set — a contact gap longer than the lease) makes
+    the child **self-fence**: evict every slot, free the blocks, drop
+    all bookkeeping, stop heartbeating, and from then on REJECT every
+    op carrying the revoked epoch (``error="stale_epoch"``) — so a
+    replica the router falsely declared dead behind a partition can
+    never double-execute a rid that was resubmitted elsewhere, even
+    though no kill signal can reach its host. A ``readmit`` op grants a
+    fresh epoch and a clean slate. Unstamped ops (legacy/fake drivers)
+    pass unchecked on an unfenced child; a fenced child rejects them
+    too — the fence is the stronger invariant. Every reply is stamped
+    with the child's lease epoch so the parent can discard replies from
+    an epoch it already revoked."""
     from ..parallel import multihost
     from . import transport as tp
 
@@ -280,6 +297,53 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
     collected = 0                      # sched.completed cursor
     hb_seq = 0
     draining = False
+    # the lease (ISSUE 20): epoch 0 = never granted (unstamped legacy
+    # drivers); last_contact tracks the message-carried fleet clock so
+    # lease expiry is SimClock-deterministic like everything else
+    lease = {"epoch": 0, "timeout_s": lease_timeout_s,
+             "last_contact": None}
+    fstate: Dict[str, Any] = {"fenced": False, "info": None,
+                              "stale_rejects": 0}
+
+    def _self_fence(reason: str) -> Dict[str, Any]:
+        """Evict everything, free the blocks, stop beating — the child
+        half of the membership protocol. Idempotent; returns the fence
+        record. Mirrors the in-process zombie fence
+        (``ReplicaWorker.reset``)."""
+        if fstate["fenced"]:
+            return fstate["info"]
+        free_before = engine.cache.free_blocks
+        slots = 0
+        for slot in list(sched.running):
+            engine.evict(slot)
+            slots += 1
+        for slot in list(sched.prefilling):
+            engine.evict(slot)
+            slots += 1
+        sched.running.clear()
+        sched.prefilling.clear()
+        sched.queue.clear()
+        if getattr(sched, "handoffs", None) is not None:
+            sched.handoffs.clear()
+        known.clear()
+        info = {"kind": "fence", "replica": replica_id,
+                "t": clock(), "reason": reason,
+                "epoch": lease["epoch"], "slots_evicted": slots,
+                "blocks_freed": engine.cache.free_blocks - free_before,
+                # the split-brain oracle: tokens generated AFTER this
+                # point are zombie work — the drill asserts zero
+                "tokens_at_fence": engine.tokens_generated,
+                "source": "replica"}
+        fstate["fenced"] = True
+        fstate["info"] = info
+        # forensics: lands in the child's local JSONL immediately and
+        # ships to the parent stream on the first post-readmit tick
+        buf.emit_event(info)
+        if metrics is not None:
+            metrics.counter("fleet_fence_total",
+                            "self-fence events on this replica",
+                            reason=reason).inc()
+        return info
 
     def load_report() -> Dict[str, Any]:
         rep = sched.load_report()
@@ -302,6 +366,10 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
 
     def beat(now: Optional[float]) -> None:
         nonlocal hb_seq
+        if fstate["fenced"]:
+            # a fenced replica is out of the membership: beating would
+            # advertise capacity the router must not route to
+            return
         hb_seq += 1
         multihost.write_heartbeat(
             root, host_id=replica_id, seq=hb_seq, now=now,
@@ -311,19 +379,104 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
                       if not k.endswith("_rids")
                       and k != "compile_counts"}})
 
+    def _geometry() -> Dict[str, Any]:
+        return {"pid": os.getpid(),
+                "context_width": engine.context_width,
+                "max_slots": engine.max_slots,
+                "block_size": engine.cache.block_size,
+                "num_blocks": engine.cache.num_blocks}
+
     def handle(msg: Dict[str, Any]) -> Dict[str, Any]:
         nonlocal collected, draining
         op = msg.get("op")
         clock.set(msg.get("now"))
+        ep = msg.get("epoch")
         if op == "hello":
+            # the initial lease grant — handled BEFORE the epoch guard
+            # (the granted epoch is necessarily newer than the zero
+            # lease, which must not read as a supersession)
+            if ep is not None and int(ep) > lease["epoch"]:
+                lease["epoch"] = int(ep)
+            lease["last_contact"] = clock()
             beat(msg.get("now"))
-            return {"ok": True, "pid": os.getpid(),
-                    "context_width": engine.context_width,
-                    "max_slots": engine.max_slots,
-                    "block_size": engine.cache.block_size,
-                    "num_blocks": engine.cache.num_blocks,
-                    "startup_ms": startup,
-                    "load": load_report()}
+            return {"ok": True, "startup_ms": startup,
+                    "load": load_report(), **_geometry()}
+        if op == "fence":
+            # revocation notice: the router declared us dead and bumped
+            # the epoch. Fence NOW (recording the revoked epoch), then
+            # adopt the new one so zombie-driven ops carrying the old
+            # epoch classify as stale, not merely "fenced".
+            info = _self_fence("revoked")
+            if ep is not None and int(ep) > lease["epoch"]:
+                lease["epoch"] = int(ep)
+            return {"ok": True, "fenced": True, "fence": info}
+        if op == "readmit":
+            # a fresh lease + a clean slate: the partition healed and
+            # the router is re-admitting this (empty, fenced) replica
+            new_ep = int(msg["epoch"])
+            if new_ep <= lease["epoch"] and lease["epoch"]:
+                return {"ok": False, "error": "stale_epoch",
+                        "epoch": lease["epoch"]}
+            info = fstate["info"]
+            report = {
+                "ok": True, "epoch": new_ep, "fence": info,
+                "stale_epoch_rejects": fstate["stale_rejects"],
+                # zombie-work oracle: tokens generated between the
+                # fence and this readmit (the drill asserts 0)
+                "tokens_while_fenced": (
+                    engine.tokens_generated - info["tokens_at_fence"]
+                    if info else 0),
+                **_geometry()}
+            lease["epoch"] = new_ep
+            lease["last_contact"] = clock()
+            fstate["fenced"] = False
+            known.clear()
+            draining = False
+            beat(msg.get("now"))
+            report["load"] = load_report()
+            return report
+        if op == "stop":
+            # the shutdown path must work regardless of lease state —
+            # a fenced child still exits cleanly
+            return {"ok": True, "stopping": True}
+        if fstate["fenced"]:
+            # THE fence: no op carrying the revoked epoch (or none at
+            # all) executes on a fenced replica — a falsely-declared-
+            # dead zombie cannot double-run a resubmitted rid
+            if ep is not None and int(ep) < lease["epoch"]:
+                fstate["stale_rejects"] += 1
+                return {"ok": False, "error": "stale_epoch",
+                        "epoch": lease["epoch"]}
+            return {"ok": False, "error": "fenced",
+                    "epoch": lease["epoch"]}
+        if ep is not None:
+            ep = int(ep)
+            if ep > lease["epoch"]:
+                if lease["epoch"] == 0:
+                    lease["epoch"] = ep     # implicit grant (no hello)
+                else:
+                    # someone holds a NEWER lease for this replica id:
+                    # this process was superseded — fence, don't race
+                    _self_fence("superseded")
+                    lease["epoch"] = ep
+                    return {"ok": False, "error": "fenced",
+                            "epoch": lease["epoch"]}
+            elif ep < lease["epoch"]:
+                fstate["stale_rejects"] += 1
+                return {"ok": False, "error": "stale_epoch",
+                        "epoch": lease["epoch"]}
+            now_t = clock()
+            lt = lease["timeout_s"]
+            if (lt is not None and lease["last_contact"] is not None
+                    and now_t - lease["last_contact"] > float(lt)):
+                # the router has been silent longer than the lease: our
+                # epoch may be revoked on the other side of a partition
+                # — fence unilaterally rather than keep decoding rids
+                # that are being resubmitted elsewhere
+                _self_fence("lease-expired")
+                return {"ok": False, "error": "fenced",
+                        "epoch": lease["epoch"]}
+            lease["last_contact"] = now_t
         if op == "submit":
             rid = int(msg["rid"])
             if rid in known:
@@ -447,9 +600,10 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
                     "free_blocks": engine.cache.free_blocks,
                     "num_blocks": engine.cache.num_blocks,
                     "ticks": engine.ticks,
-                    "tokens_generated": engine.tokens_generated}
-        if op == "stop":
-            return {"ok": True, "stopping": True}
+                    "tokens_generated": engine.tokens_generated,
+                    "fenced": fstate["fenced"],
+                    "fence": fstate["info"],
+                    "stale_epoch_rejects": fstate["stale_rejects"]}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
     while True:
@@ -495,6 +649,9 @@ def serve_loop(read_file, write_file, *, engine, sched, buf, clock,
             reply = {"ok": False,
                      "error": f"{type(e).__name__}: {e}"}
         reply["seq"] = seq
+        # every reply carries the child's lease epoch: the parent
+        # discards whole replies from an epoch it already revoked
+        reply.setdefault("epoch", lease["epoch"])
         out_blobs = reply.pop("_blobs", None) or []
         if out_blobs:
             reply["nblobs"] = len(out_blobs)
@@ -570,7 +727,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         read_file, out, engine=engine, sched=sched, buf=buf,
         clock=clock, root=spec["root"],
         replica_id=int(spec["replica_id"]), startup=startup,
-        metrics=metrics)
+        metrics=metrics, lease_timeout_s=spec.get("lease_timeout_s"))
 
 
 if __name__ == "__main__":

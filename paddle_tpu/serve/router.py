@@ -68,7 +68,8 @@ class FleetRouter:
                  heartbeat_timeout_s: float = 3.0,
                  clock=time.perf_counter, affinity: bool = True,
                  shed: bool = True, max_sessions: int = 4096,
-                 tracer=None):
+                 tracer=None, death_confirmations: int = 2,
+                 metrics=None):
         # held BY REFERENCE, not copied: the autoscaler (ISSUE 13)
         # appends newly spawned replicas to the fleet's worker list and
         # the router must see them become placeable immediately
@@ -87,6 +88,15 @@ class FleetRouter:
         # optional fleet Tracer (ISSUE 17): death verdicts become
         # timeline instants on the router lane
         self.tracer = tracer
+        # flap damping (ISSUE 20): a replica must look stale K times IN
+        # A ROW before the death verdict lands — one beat arriving a
+        # hair late (GC pause, loaded host, brief link flap) costs one
+        # grace observation instead of a full fence/resubmit cycle.
+        # K=1 restores the old single-observation behavior.
+        self.death_confirmations = max(1, int(death_confirmations))
+        self._stale_streak: Dict[int, int] = {}
+        self.false_deaths_averted = 0
+        self.metrics = metrics
 
     # -- health ------------------------------------------------------------
 
@@ -105,17 +115,37 @@ class FleetRouter:
             expected_hosts=expected, now=now))
         newly = []
         for w in self.workers:
-            if w.replica_id in stale and w.state in ("live", "draining"):
-                w.state = "dead"
-                newly.append(w)
-                if self.tracer is not None:
-                    self.tracer.instant("replica_dead",
-                                        replica=w.replica_id)
-                # unpin this replica's sessions: they re-pin wherever
-                # their next request lands
-                for sid in [s for s, r in self.sessions.items()
-                            if r == w.replica_id]:
-                    del self.sessions[sid]
+            if w.state not in ("live", "draining"):
+                continue
+            rid = w.replica_id
+            if rid not in stale:
+                if self._stale_streak.pop(rid, 0):
+                    # it came back before the verdict: a flap absorbed,
+                    # not a death — the damping satellite's payoff
+                    self.false_deaths_averted += 1
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            "fleet_false_death_averted",
+                            "stale-looking replicas that beat again "
+                            "before K confirmations").inc()
+                    if self.tracer is not None:
+                        self.tracer.instant("false_death_averted",
+                                            replica=rid)
+                continue
+            streak = self._stale_streak.get(rid, 0) + 1
+            self._stale_streak[rid] = streak
+            if streak < self.death_confirmations:
+                continue
+            del self._stale_streak[rid]
+            w.state = "dead"
+            newly.append(w)
+            if self.tracer is not None:
+                self.tracer.instant("replica_dead", replica=rid)
+            # unpin this replica's sessions: they re-pin wherever
+            # their next request lands
+            for sid in [s for s, r in self.sessions.items()
+                        if r == rid]:
+                del self.sessions[sid]
         return newly
 
     def candidates(self, role: Optional[str] = None) -> List[Any]:

@@ -67,6 +67,7 @@ import itertools
 import json
 import logging
 import os
+import random
 import select
 import socket as socket_lib
 import struct
@@ -359,12 +360,24 @@ def accept_connection(srv: socket_lib.socket,
 
 
 def connect(host: str, port: int, timeout_s: float = 30.0,
-            retry_interval_s: float = 0.05) -> socket_lib.socket:
+            retry_interval_s: float = 0.05, *,
+            backoff_cap_s: float = 0.5,
+            rng: Optional[random.Random] = None,
+            sleep=time.sleep) -> socket_lib.socket:
     """Dial the fleet's listener (child side), retrying connection
     refusals until ``timeout_s`` — the parent always listens before
-    spawning, but a remote-host child may race a slow accept loop."""
+    spawning, but a remote-host child may race a slow accept loop.
+
+    Retries back off with full jitter (ISSUE 20): attempt ``k`` sleeps
+    ``uniform(0, min(backoff_cap_s, retry_interval_s * 2**k))``, so a
+    healed partition does not get every waiting dialer knocking in the
+    same millisecond. ``rng``/``sleep`` are injectable for deterministic
+    drills (the default rng is process-seeded — dial desynchronization
+    WANTS per-process randomness)."""
     deadline = time.monotonic() + float(timeout_s)
+    rng = rng if rng is not None else random.Random()
     last: Optional[Exception] = None
+    attempt = 0
     while time.monotonic() < deadline:
         try:
             sock = socket_lib.create_connection(
@@ -376,7 +389,10 @@ def connect(host: str, port: int, timeout_s: float = 30.0,
             return sock
         except OSError as e:
             last = e
-            time.sleep(retry_interval_s)
+            cap = min(float(backoff_cap_s),
+                      float(retry_interval_s) * (2.0 ** min(attempt, 32)))
+            sleep(rng.uniform(0.0, cap))
+            attempt += 1
     raise TransportClosed(f"could not connect to {host}:{port}: {last}")
 
 
@@ -388,7 +404,11 @@ class ReplicaTransport:
 
     def __init__(self, read_file, write_file, *, proc=None,
                  timeout_s: float = 2.0, max_attempts: int = 3,
-                 on_event=None, metrics=None):
+                 on_event=None, metrics=None,
+                 backoff_base_s: float = 0.02,
+                 backoff_cap_s: float = 0.25,
+                 backoff_seed: Optional[int] = None,
+                 sleep=time.sleep):
         # a pre-built FrameReader (e.g. SocketFrameReader) passes
         # through; anything else is assumed to be a readable file/fd
         self._reader = (read_file if isinstance(read_file, FrameReader)
@@ -402,6 +422,18 @@ class ReplicaTransport:
         self.timeouts = 0
         self.corrupt_replies = 0
         self.closed = False
+        # retransmit backoff (ISSUE 20): attempt k waits
+        # uniform(0, min(cap, base * 2**(k-1))) before resending, so a
+        # fleet's links healing together don't retransmit in lockstep.
+        # Seeded per link by the fleet (backoff_seed=replica_id) — the
+        # delays a drill observes are reproducible.
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self._backoff_rng = random.Random(
+            0x5EED if backoff_seed is None else int(backoff_seed))
+        self._sleep = sleep
+        self.backoffs = 0
+        self.backoff_s = 0.0
         # optional observer hook (ISSUE 17): called as
         # on_event(event, op) for "retransmit"/"timeout"/"corrupt" —
         # the fleet pins these onto the merged trace as instants.
@@ -459,6 +491,21 @@ class ReplicaTransport:
         m = self.metrics
         for attempt in range(max(1, attempts)):
             if attempt:
+                # full-jitter capped exponential backoff BEFORE the
+                # retransmit: a transient outage healing under load
+                # must not see every link's retry at once
+                delay = self._backoff_rng.uniform(
+                    0.0, min(self.backoff_cap_s,
+                             self.backoff_base_s
+                             * (2.0 ** (attempt - 1))))
+                if delay > 0.0:
+                    self.backoffs += 1
+                    self.backoff_s += delay
+                    if m is not None:
+                        m.counter("transport_backoff_seconds",
+                                  "seconds slept in retransmit "
+                                  "backoff").inc(delay)
+                    self._sleep(delay)
                 self.retransmits += 1
                 if m is not None:
                     m.counter("transport_retransmits",

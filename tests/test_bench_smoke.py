@@ -268,6 +268,30 @@ def test_bench_smoke_cpu_green_and_equal():
     assert dg["int8_tokens_identical_to_colocated"] is True
     assert dg["int8_wire_bytes_exact"] is True
     assert dg["int8_wire_ratio_vs_f32"] == pytest.approx(8 / 3)
+    # ISSUE 20: the chaos leg — the disagg socket fleet under a seeded
+    # NetworkChaos plane. An asymmetric partition (child hears the
+    # parent, parent hears nothing) falsely kills the only prefill
+    # replica -> epoch fence -> disagg degrades to colocated prefill on
+    # the decoders and RELEASES on heal; a one-shot flap window fences
+    # a decode replica the same way. Both zombies re-admit under fresh
+    # leases having generated ZERO tokens under their revoked epochs,
+    # every rid keeps exactly one terminal record with oracle tokens,
+    # survivors are leak-free, and the chaos-off leg-5a socket fleet is
+    # the dark twin: its stats() schema differs by exactly {"chaos"}
+    cz = fl["chaos"]
+    assert cz["ok"] is True, cz
+    assert cz["all_terminal"] is True and cz["single_lineage"] is True
+    assert cz["oracle_tokens"] is True
+    assert cz["fences"] >= 2
+    assert cz["readmitted"] >= cz["fences"]
+    assert cz["zero_tokens_while_fenced"] is True
+    assert cz["survivors_leak_free"] is True
+    assert cz["degradation_engaged_and_released"] is True
+    assert cz["membership"]["degradations"] >= 1
+    assert cz["network"]["frames_dropped"] > 0
+    assert cz["network"]["drop_reasons"]["partition"] > 0
+    assert cz["network"]["drop_reasons"]["flap"] > 0
+    assert cz["stats_keys_vs_dark_twin"] == ["chaos"]
     # ISSUE 16: the cold-vs-warm spawn gate ran — two fresh replica
     # children against one cache root. The cold child pays >= 1 autotune
     # trial and misses both persistent caches; the warm child runs ZERO

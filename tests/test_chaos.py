@@ -1,0 +1,614 @@
+"""Partition-tolerant membership + network chaos tests (ISSUE 20).
+
+The fleet's safety story across an unreliable network: epoch leases
+stamped on every frame, fence-by-epoch on declare-dead (a zombie on an
+unreachable host rejects its revoked epoch child-side), flap damping
+(K consecutive stale observations before the death verdict), jittered
+capped backoff on dials and retransmits, partition-heal re-admission,
+and disagg→colocated degradation when every prefill replica is gone.
+The chaos plane itself — per-link delay/throttle/drop/partition/flap at
+the frame seam — is drilled for determinism (two same-seed runs draw
+identical verdict ledgers) and frame coherence (a dropped message takes
+its declared blobs with it; the stream never desynchronizes).
+
+Protocol-level tests drive ``serve_loop`` with fakes over pipes (no jax
+child); the split-brain drill pays for real socket children because the
+asymmetric-partition evidence chain (timeout → transport_down → stale
+heartbeat → fence → readmit) only exists end-to-end.
+"""
+
+import collections
+import os
+import random
+import socket
+import tempfile
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.parallel import multihost
+from paddle_tpu.serve import transport as tp
+from paddle_tpu.serve.chaos import (ChaosFrameReader, ChaosWriter,
+                                    LinkChaos, NetworkChaos)
+from paddle_tpu.serve.replica_proc import (EventBuffer, SettableClock,
+                                           serve_loop)
+from paddle_tpu.serve.router import FleetRouter
+
+V, W = 64, 24
+DT, HB = 0.1, 0.25
+
+
+# ---------------------------------------------------------------------------
+# LinkChaos: validation, windows, flap schedule
+# ---------------------------------------------------------------------------
+
+def test_link_chaos_validation_and_down_windows():
+    with pytest.raises(ValueError):
+        LinkChaos(drop_p=1.5)
+    with pytest.raises(ValueError):
+        LinkChaos(flap=(0.0, 0.1))
+    with pytest.raises(ValueError):
+        LinkChaos(flap=(1.0, 2.0))          # down > period
+    with pytest.raises(ValueError):
+        LinkChaos(direction="sideways")
+    with pytest.raises(ValueError):
+        LinkChaos(partitions=[(0.0, 1.0, "up")])
+    # asymmetric partition: recv cut, send up — and partitions WIN over
+    # the flap in the reason (the window is the deliberate drill)
+    lc = LinkChaos(partitions=[(1.0, 2.0, "recv")],
+                   flap=(1.0, 0.25, 0.0))
+    assert lc.down_reason("recv", 1.5) == "partition"
+    assert lc.down_reason("send", 1.5) == "flap" or \
+        lc.down_reason("send", 1.5) is None
+    assert lc.down_reason("recv", 2.0) in ("flap", None)  # half-open end
+    # flap square wave: down for the first down_s of every period
+    fl = LinkChaos(flap=(1.0, 0.25, 2.0))
+    assert fl.down_reason("send", 1.9) is None      # before start
+    assert fl.down_reason("send", 2.1) == "flap"
+    assert fl.down_reason("send", 2.5) is None
+    assert fl.down_reason("send", 3.2) == "flap"    # next period
+    # direction gating: a send-only profile never impairs recv
+    so = LinkChaos(drop_p=1.0, direction="send")
+    assert so.applies("send") and not so.applies("recv")
+    d = LinkChaos().describe()
+    assert d["drop_p"] == 0.0 and d["flap"] is None
+
+
+def test_chaos_verdicts_deterministic_across_two_runs():
+    """The determinism satellite: two same-seed planes fed the same
+    clocked message sequence produce the identical verdict ledger —
+    delay samples, drop draws, flap windows and all."""
+    def run(seed):
+        clock = SettableClock()
+        ch = NetworkChaos(seed, links={
+            1: LinkChaos(delay_s=(0.001, 0.004), jitter_s=0.001,
+                         drop_p=0.3, bandwidth_bps=8e6,
+                         flap=(0.5, 0.1)),
+            2: LinkChaos(drop_p=0.5, direction="recv")},
+            max_sleep_s=0.0)                # account, never sleep
+        ch.bind(clock)
+        verdicts = []
+        for i in range(200):
+            clock.set(i * 0.01)
+            verdicts.append(ch.verdict(1, "send", 100 + i))
+            verdicts.append(ch.verdict(1, "recv", 50))
+            verdicts.append(ch.verdict(2, "recv", 200))
+        return verdicts, ch.stats()
+    v1, s1 = run(7)
+    v2, s2 = run(7)
+    assert v1 == v2 and s1 == s2
+    assert s1["frames_dropped"] > 0 and s1["frames_delayed"] > 0
+    assert "flap" in s1["drop_reasons"] and "drop" in s1["drop_reasons"]
+    # the throttle is visible: link 1's sends pay bytes*8/bps on top of
+    # the sampled delay, so its ledger carries real injected seconds
+    assert s1["per_link"][1]["delay_s"] > 0.0
+    v3, s3 = run(8)
+    assert s3 != s1                         # a different seed diverges
+    # link 2 is recv-only: its send direction never dropped anything
+    assert s1["per_link"][2]["dropped_send"] == 0
+    assert s1["per_link"][2]["dropped_recv"] > 0
+    # describe() is full provenance: config + the verdict ledger
+    ch = NetworkChaos(3, default=LinkChaos(drop_p=0.1))
+    d = ch.describe()
+    assert d["seed"] == 3 and d["default"]["drop_p"] == 0.1
+    assert d["stats"]["frames_dropped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the frame seams: drops are frame-coherent, blobs inherit verdicts
+# ---------------------------------------------------------------------------
+
+def test_chaos_reader_drop_consumes_blobs_and_keeps_sync():
+    """A dropped message takes its declared binary payloads down with
+    it: the reader consumes them off the wire (accounted as dropped
+    bytes) and the NEXT message is delivered intact — chaos loses
+    exchanges, never desynchronizes the stream."""
+    a, b = socket.socketpair()
+    try:
+        clock = SettableClock()
+        ch = NetworkChaos(0, links={
+            5: LinkChaos(partitions=[(0.0, 1.0, "recv")])},
+            max_sleep_s=0.0)
+        ch.bind(clock)
+        reader = ChaosFrameReader(b, ch, 5)
+        w = tp.SocketWriter(a)
+        blob = b"\x42" * 64
+        tp.write_frame(w, {"seq": 1, "nblobs": 1, "op": "adopt"})
+        tp.write_binary_frame(w, blob)
+        tp.write_frame(w, {"seq": 2, "op": "tick"})
+        # inside the window: seq 1 dropped WITH its blob, seq 2 is the
+        # next coherent frame... but the window drops it too; advance
+        # the clock between reads to watch the partition lift
+        clock.set(0.5)
+        with pytest.raises(tp.TransportTimeout):
+            reader.read_frame(timeout_s=0.2)
+        dropped_before = ch.bytes_dropped
+        assert ch.frames_dropped == 2       # seq 1 and seq 2
+        assert dropped_before > len(blob)   # the blob bytes counted too
+        clock.set(1.5)                      # healed
+        tp.write_frame(w, {"seq": 3, "nblobs": 1, "op": "adopt"})
+        tp.write_binary_frame(w, blob)
+        got = reader.read_frame(timeout_s=1.0)
+        assert got == {"seq": 3, "nblobs": 1, "op": "adopt"}
+        # the delivered message's blob passes through untouched
+        assert reader.read_frame(timeout_s=1.0,
+                                 allow_binary=True) == blob
+        assert ch.bytes_dropped == dropped_before
+    finally:
+        a.close(), b.close()
+
+
+def test_chaos_writer_blob_inherits_message_verdict():
+    """Outbound seam: a JSON frame draws the verdict; the binary frames
+    riding behind it inherit it — dropped whole or delivered whole."""
+    a, b = socket.socketpair()
+    try:
+        clock = SettableClock()
+        ch = NetworkChaos(0, links={
+            3: LinkChaos(partitions=[(0.0, 1.0, "send")])},
+            max_sleep_s=0.0)
+        ch.bind(clock)
+        cw = ChaosWriter(tp.SocketWriter(a), ch, 3)
+        blob = b"\x77" * 128
+        clock.set(0.5)                      # partitioned: both vanish
+        tp.write_frame(cw, {"seq": 1, "nblobs": 1})
+        tp.write_binary_frame(cw, blob)
+        clock.set(2.0)                      # healed: both delivered
+        tp.write_frame(cw, {"seq": 2, "nblobs": 1})
+        tp.write_binary_frame(cw, blob)
+        reader = tp.SocketFrameReader(b)
+        assert reader.read_frame(timeout_s=1.0) == {"seq": 2,
+                                                    "nblobs": 1}
+        assert reader.read_frame(timeout_s=1.0,
+                                 allow_binary=True) == blob
+        assert ch.frames_dropped == 1       # one message verdict
+        assert ch.bytes_dropped > len(blob)  # its blob went with it
+        # a profile-less link is returned UNWRAPPED — the chaos-off
+        # fleet runs the stock classes, byte-identical
+        w = tp.SocketWriter(a)
+        assert ch.wrap_writer(9, w) is w
+    finally:
+        a.close(), b.close()
+
+
+# ---------------------------------------------------------------------------
+# backoff satellites: seeded jitter on retransmits and dials
+# ---------------------------------------------------------------------------
+
+def _pipe_pair():
+    r, w = os.pipe()
+    return os.fdopen(r, "rb"), os.fdopen(w, "wb")
+
+
+def test_retransmit_backoff_capped_jittered_seeded():
+    def run(seed):
+        slept = []
+        c2p_r, _w = _pipe_pair()
+        _r, p2c_w = _pipe_pair()
+        tr = tp.ReplicaTransport(c2p_r, p2c_w, timeout_s=0.02,
+                                 max_attempts=4, backoff_seed=seed,
+                                 sleep=slept.append)
+        with pytest.raises(tp.TransportTimeout):
+            tr.request("tick", now=0.0, tick=0)
+        stats = (tr.backoffs, tr.backoff_s)
+        tr.close()
+        return slept, stats
+    slept, (n, total) = run(11)
+    # attempts 2..4 back off before resending: uniform(0, base * 2^k)
+    # capped — never a sleep beyond the cap, growth bounded per attempt
+    assert len(slept) == 3 and n == len([s for s in slept if s > 0])
+    for k, s in enumerate(slept):
+        assert 0.0 <= s <= min(0.25, 0.02 * (2.0 ** k))
+    assert total == pytest.approx(sum(slept))
+    # seeded: the same link draws the same delays every run
+    assert run(11)[0] == slept
+    assert run(12)[0] != slept
+
+
+def test_connect_dial_backoff_jittered_and_injectable():
+    def run(seed):
+        slept = []
+        with pytest.raises(tp.TransportClosed):
+            tp.connect("127.0.0.1", 1, timeout_s=0.25,
+                       retry_interval_s=0.05,
+                       rng=random.Random(seed), sleep=slept.append)
+        return slept
+    slept = run(5)
+    assert len(slept) >= 2
+    for k, s in enumerate(slept):
+        assert 0.0 <= s <= min(0.5, 0.05 * (2.0 ** min(k, 10)))
+    # injectable rng == replay of the jitter draws; the attempt COUNT
+    # is real-deadline-bounded, so compare the common prefix
+    again = run(5)
+    n = min(len(slept), len(again))
+    assert n >= 2 and again[:n] == slept[:n]
+
+
+# ---------------------------------------------------------------------------
+# flap damping: K stale observations before the death verdict
+# ---------------------------------------------------------------------------
+
+class _RWorker:
+    def __init__(self, rid):
+        self.replica_id = rid
+        self.state = "live"
+
+
+def test_flap_damping_one_late_beat_is_not_death(tmp_path):
+    root = str(tmp_path)
+    w0, w1 = _RWorker(0), _RWorker(1)
+    router = FleetRouter([w0, w1], root, heartbeat_timeout_s=HB,
+                         death_confirmations=2)
+    for rid in (0, 1):
+        multihost.write_heartbeat(root, rid, now=0.0)
+    assert router.refresh_health(0.1) == []
+    # replica 1's beat arrives one observation late: first stale look
+    # starts the streak but must NOT declare death (K=2)
+    multihost.write_heartbeat(root, 0, now=1.0)
+    assert router.refresh_health(1.0) == []
+    assert w1.state == "live" and router._stale_streak[1] == 1
+    # the late beat lands before the second look: flap absorbed
+    multihost.write_heartbeat(root, 1, now=1.1)
+    multihost.write_heartbeat(root, 0, now=1.2)
+    assert router.refresh_health(1.2) == []
+    assert router.false_deaths_averted == 1
+    assert 1 not in router._stale_streak
+    # sustained staleness IS death — at exactly the K'th observation
+    multihost.write_heartbeat(root, 0, now=2.0)
+    assert router.refresh_health(2.0) == []          # streak 1
+    multihost.write_heartbeat(root, 0, now=2.1)
+    newly = router.refresh_health(2.1)               # streak 2 → dead
+    assert [w.replica_id for w in newly] == [1]
+    assert w1.state == "dead"
+    # K=1 restores the old single-observation verdict
+    r1 = FleetRouter([_RWorker(7)], root, heartbeat_timeout_s=HB,
+                     death_confirmations=1)
+    multihost.write_heartbeat(root, 7, now=0.0)
+    assert [w.replica_id for w in r1.refresh_health(5.0)] == [7]
+
+
+# ---------------------------------------------------------------------------
+# child-side lease protocol over pipes (fakes, no jax child)
+# ---------------------------------------------------------------------------
+
+class _FakeCache:
+    free_blocks = 7
+    num_blocks = 8
+    block_size = 4
+    prefix_hit_blocks = 0
+    cow_forks = 0
+
+
+class _FakeEngine:
+    max_slots = 2
+    ticks = 0
+    tokens_generated = 0
+    cache = _FakeCache()
+    context_width = W
+
+    def free_slots(self):
+        return [0, 1]
+
+    def compile_counts(self):
+        return {"prefill": 1, "tick": 1}
+
+    def evict(self, slot):
+        pass
+
+
+class _FakeScheduler:
+    def __init__(self):
+        self.steps = 0
+        self.est_tick_s = 0.1
+        self.queue, self.running, self.prefilling = [], {}, {}
+        self.completed = []
+        self.submitted = []
+
+    def step(self):
+        self.steps += 1
+        return False
+
+    def submit(self, prompt, max_new_tokens, **kw):
+        self.submitted.append((list(prompt), max_new_tokens, kw))
+
+    def pending_new_tokens(self):
+        return 0
+
+    def load_report(self):
+        return {"pending_new_tokens": 0, "running": 0, "queued": 0,
+                "prefilling": 0}
+
+
+def _loopback(tmpdir, **kw):
+    c2p_r, c2p_w = _pipe_pair()
+    p2c_r, p2c_w = _pipe_pair()
+    eng, sched = _FakeEngine(), _FakeScheduler()
+    t = threading.Thread(
+        target=serve_loop, args=(p2c_r, c2p_w),
+        kwargs=dict(engine=eng, sched=sched, buf=EventBuffer(),
+                    clock=SettableClock(), root=tmpdir, replica_id=0,
+                    **kw),
+        daemon=True)
+    t.start()
+    tr = tp.ReplicaTransport(c2p_r, p2c_w, timeout_s=1.0)
+    return tr, eng, sched, t
+
+
+def test_child_lease_fence_reject_and_readmit(tmp_path):
+    tr, eng, sched, t = _loopback(str(tmp_path))
+    # hello is the grant: the child adopts epoch 1 and stamps replies
+    hello = tr.request("hello", now=0.0, epoch=1)
+    assert hello["ok"] and hello["epoch"] == 1
+    assert tr.request("tick", now=0.1, tick=0, epoch=1)["ok"]
+    assert sched.steps == 1
+    # the revocation notice: the child self-fences and adopts epoch 2
+    r = tr.request("fence", now=0.2, epoch=2)
+    assert r["ok"] and r["fenced"]
+    assert r["fence"]["reason"] == "revoked" and r["fence"]["epoch"] == 1
+    assert r["fence"]["tokens_at_fence"] == 0
+    # THE fence: the zombie's op with the revoked epoch never executes
+    z = tr.request("tick", now=0.3, tick=1, epoch=1)
+    assert z["ok"] is False and z["error"] == "stale_epoch"
+    assert z["epoch"] == 2 and sched.steps == 1
+    # even the CURRENT epoch is refused while fenced — only a readmit
+    # (strictly newer lease) re-opens the membership
+    f = tr.request("tick", now=0.4, tick=2, epoch=2)
+    assert f["ok"] is False and f["error"] == "fenced"
+    stale = tr.request("readmit", now=0.5, epoch=2)
+    assert stale["ok"] is False and stale["error"] == "stale_epoch"
+    ok = tr.request("readmit", now=0.6, epoch=3)
+    assert ok["ok"] and ok["epoch"] == 3
+    assert ok["tokens_while_fenced"] == 0
+    assert ok["stale_epoch_rejects"] == 1
+    assert ok["fence"]["reason"] == "revoked"
+    # re-admitted: ops under the fresh lease execute again
+    assert tr.request("tick", now=0.7, tick=3, epoch=3)["ok"]
+    assert sched.steps == 2
+    st = tr.request("stats", now=0.8, epoch=3)
+    assert st["fenced"] is False and st["stale_epoch_rejects"] == 1
+    tr.request("stop")
+    t.join(timeout=5.0)
+    tr.close()
+
+
+def test_child_superseded_and_lease_expiry(tmp_path):
+    tr, eng, sched, t = _loopback(str(tmp_path), lease_timeout_s=5.0)
+    assert tr.request("hello", now=0.0, epoch=1)["ok"]
+    assert tr.request("tick", now=0.1, tick=0, epoch=1)["ok"]
+    # a NEWER epoch on a plain op means someone else holds this
+    # replica's lease now: fence, don't race the successor
+    sup = tr.request("tick", now=0.2, tick=1, epoch=4)
+    assert sup["ok"] is False and sup["error"] == "fenced"
+    assert sched.steps == 1
+    ok = tr.request("readmit", now=0.3, epoch=5)
+    assert ok["ok"] and ok["fence"]["reason"] == "superseded"
+    # lease expiry: a contact gap beyond lease_timeout_s makes the
+    # child fence UNILATERALLY — its lease may have been reissued
+    # during a partition it cannot see
+    assert tr.request("tick", now=0.4, tick=2, epoch=5)["ok"]
+    exp = tr.request("tick", now=99.0, tick=3, epoch=5)
+    assert exp["ok"] is False and exp["error"] == "fenced"
+    assert sched.steps == 2
+    re = tr.request("readmit", now=99.1, epoch=6)
+    assert re["ok"] and re["fence"]["reason"] == "lease-expired"
+    tr.request("stop")
+    t.join(timeout=5.0)
+    tr.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet-level drills (real model): degradation + split brain
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    from paddle_tpu.models import TransformerLM
+    model = TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
+                          ffn_hidden=64, max_len=W)
+    vs = model.init(jax.random.PRNGKey(0), jnp.zeros((1, W), jnp.int32))
+    return model, vs
+
+
+def _greedy_oracle(model, vs, prompt, n_new):
+    fwd = jax.jit(lambda v, i: model.apply(v, i))
+    seq, out = list(prompt), []
+    for _ in range(n_new):
+        pad = np.zeros((1, W), np.int32)
+        pad[0, :len(seq)] = seq
+        logits = fwd(vs, jnp.asarray(pad))
+        tok = int(np.argmax(np.asarray(logits[0, len(seq) - 1])))
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+def test_chaos_requires_socket_mode(model_and_vars):
+    from paddle_tpu.serve import ServingFleet, SimClock
+    model, vs = model_and_vars
+    with pytest.raises(ValueError, match="socket"):
+        ServingFleet.from_model(
+            model, vs, 1, engine_kwargs=dict(max_slots=2, block_size=4),
+            clock=SimClock(), chaos=NetworkChaos(0))
+
+
+def test_disagg_degradation_to_colocated_and_release(model_and_vars,
+                                                     nprng):
+    """Partition degradation, in-process: the only prefill replica
+    dies; after the grace window the fleet degrades — decode replicas
+    serve colocated prefill (identical tokens, just no handoff) — and
+    a prefill replica rejoining releases it immediately."""
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    from paddle_tpu.serve import ServingFleet, SimClock
+    from paddle_tpu.train import FaultSchedule
+    model, vs = model_and_vars
+    mem = InMemorySink()
+    fleet = ServingFleet.from_model(
+        model, vs, 2, engine_kwargs=dict(max_slots=2, block_size=4,
+                                         num_blocks=24),
+        roles=["prefill", "decode"], clock=SimClock(),
+        heartbeat_timeout_s=HB, est_tick_s=DT,
+        telemetry=Telemetry(sinks=[mem]),
+        faults=FaultSchedule(kill_replica_at_tick=(1, 0)),
+        root=tempfile.mkdtemp(prefix="paddle_tpu_chaos_degrade_"))
+    jobs = [(list(nprng.randint(1, V, 4)), 5) for _ in range(4)]
+    frs = [fleet.submit(p, n) for p, n in jobs]
+    for _ in range(400):
+        if not fleet.outstanding():
+            break
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert not fleet.outstanding()
+    # all requests completed COLOCATED on the decode replica, token-
+    # identical to the oracle — degraded means slower, never stuck
+    assert fleet.degraded and fleet.degradations == 1
+    for (p, n), fr in zip(jobs, frs):
+        assert fr.finish_reason == "length"
+        assert fr.replica == 1
+        assert fr.tokens == _greedy_oracle(model, vs, p, n)
+    assert fleet.stats()["membership"]["degraded"] is True
+    degs = [r for r in mem.records if r.get("kind") == "degrade"]
+    assert [d["event"] for d in degs] == ["engaged"]
+    # a prefill replica rejoining releases the degradation at once,
+    # and fresh requests hand off again
+    fleet.spawn_replica("prefill")
+    fleet.tick()
+    fleet.clock.advance(DT)
+    assert not fleet.degraded and fleet.degrade_releases == 1
+    before = fleet.handoff_count
+    p2 = list(nprng.randint(1, V, 4))
+    fr2 = fleet.submit(p2, 4)
+    for _ in range(200):
+        if not fleet.outstanding():
+            break
+        fleet.tick()
+        fleet.clock.advance(DT)
+    assert fr2.finish_reason == "length"
+    assert fr2.tokens == _greedy_oracle(model, vs, p2, 4)
+    assert fleet.handoff_count == before + 1
+    degs = [r for r in mem.records if r.get("kind") == "degrade"]
+    assert [d["event"] for d in degs] == ["engaged", "released"]
+    summ_membership = fleet.stats()["membership"]
+    assert summ_membership["degradations"] == 1
+    assert summ_membership["degrade_releases"] == 1
+
+
+def test_split_brain_asymmetric_partition_fence_and_readmit(
+        model_and_vars, nprng):
+    """THE acceptance drill: an asymmetric partition (child hears us,
+    we cannot hear it) manufactures a false death. The fenced zombie
+    must contribute ZERO tokens under its revoked epoch — asserted
+    child-side via a crafted stale-epoch op AND the readmit report —
+    every rid keeps exactly one terminal record with oracle tokens, and
+    the healed replica rejoins under a fresh lease."""
+    from paddle_tpu.obs import InMemorySink, Telemetry
+    from paddle_tpu.serve import ServingFleet, SimClock
+    model, vs = model_and_vars
+    # the window opens at 0.25 — before ANY job can finish (8 new
+    # tokens ≈ 8+ DT-ticks) — so the partitioned replica is guaranteed
+    # to hold in-flight rids when it is declared dead
+    heal_at = 2.0
+    chaos = NetworkChaos(13, links={
+        1: LinkChaos(partitions=[(0.25, heal_at, "recv")])})
+    mem = InMemorySink()
+    fleet = ServingFleet.from_model(
+        model, vs, 2, engine_kwargs=dict(max_slots=2, block_size=4),
+        replica_mode="socket", chaos=chaos, clock=SimClock(),
+        heartbeat_timeout_s=HB, est_tick_s=DT,
+        # warm children: a COLD first tick compiles for seconds, which
+        # would trip the deliberately-short transport timeout on the
+        # HEALTHY link and fence both replicas — the drill needs the
+        # timeout to mean "partition", not "compiling"
+        warmup=True,
+        transport_timeout_s=0.75, readmit_grace_s=100.0,
+        telemetry=Telemetry(sinks=[mem]),
+        root=tempfile.mkdtemp(prefix="paddle_tpu_chaos_split_"))
+    try:
+        # the chaos-off link runs the STOCK seam classes (byte-identity
+        # doctrine); the impaired link runs the chaos ones
+        w0, w1 = fleet.workers
+        assert type(w0.transport._reader) is tp.SocketFrameReader
+        assert type(w1.transport._reader) is ChaosFrameReader
+        assert w0.lease_epoch == 1 and w1.lease_epoch == 2
+        jobs = [(list(nprng.randint(1, V, int(nprng.randint(2, 6)))), 8)
+                for _ in range(6)]
+        frs = [fleet.submit(p, n) for p, n in jobs]
+        old_ep = w1.lease_epoch
+        poke = None
+        for _ in range(400):
+            if poke is None and fleet.clock() >= heal_at \
+                    and w1.state == "dead":
+                # the partition healed but the parent hasn't readmitted
+                # yet: poke the zombie DIRECTLY with its revoked epoch —
+                # the child itself must refuse it
+                poke = w1.transport.request(
+                    "tick", now=fleet.clock(), tick=-1, epoch=old_ep,
+                    max_attempts=1, timeout_s=1.0)
+            if not fleet.outstanding() and fleet.readmitted >= 1:
+                break
+            fleet.tick()
+            fleet.clock.advance(DT)
+        assert not fleet.outstanding()
+        # the false death happened and was fenced by epoch, not by kill
+        assert fleet.fences == 1 and not w1.killed
+        assert w1.transport.proc.poll() is None      # the zombie lives
+        assert poke is not None
+        assert poke["ok"] is False and poke["error"] == "stale_epoch"
+        assert poke["epoch"] > old_ep
+        # partition heal → re-admission under a fresh lease
+        assert fleet.readmitted == 1 and w1.state == "live"
+        assert w1.lease_epoch > old_ep
+        info = w1.readmit_info
+        assert info["tokens_while_fenced"] == 0
+        assert info["stale_epoch_rejects"] >= 1
+        # every request: exactly one terminal record, oracle tokens
+        by_rid = collections.defaultdict(list)
+        for r in mem.records:
+            if r.get("kind") == "request":
+                by_rid[r["rid"]].append(r)
+        for (p, n), fr in zip(jobs, frs):
+            assert fr.finish_reason == "length"
+            assert fr.tokens == _greedy_oracle(model, vs, p, n)
+            terminal = [r for r in by_rid[fr.rid]
+                        if r["finish_reason"] != "retried"]
+            assert len(terminal) == 1, (fr.rid, by_rid[fr.rid])
+        # the in-flight work on the partitioned replica was resubmitted
+        assert any(fr.retries > 0 for fr in frs)
+        # membership + chaos evidence in stats and the record stream
+        st = fleet.stats()
+        assert st["membership"]["fences"] == 1
+        assert st["membership"]["readmitted"] == 1
+        assert st["chaos"]["frames_dropped"] > 0
+        assert st["chaos"]["drop_reasons"].get("partition", 0) > 0
+        fences = [r for r in mem.records if r.get("kind") == "fence"]
+        assert any(r.get("reason") == "declared-dead"
+                   and r.get("epoch") == old_ep for r in fences)
+        readmits = [r for r in mem.records
+                    if r.get("kind") == "replica"
+                    and r.get("event") == "readmitted"]
+        assert len(readmits) == 1
+        assert readmits[0]["tokens_while_fenced"] == 0
+    finally:
+        fleet.shutdown()
